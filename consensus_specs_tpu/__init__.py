@@ -1,0 +1,18 @@
+"""tpu-consensus-specs: a TPU-native framework with the capabilities of the
+Ethereum consensus-specs executable pyspec.
+
+Layer map (mirrors SURVEY.md):
+  ssz/        SSZ type system + persistent-Merkle-tree hashing (remerkleable-equivalent,
+              reference seam: tests/core/pyspec/eth2spec/utils/ssz/ssz_impl.py:8-25)
+  crypto/     BLS12-381 (pure-Python oracle, reference seam: eth2spec/utils/bls.py)
+              and SHA-256 backends
+  ops/        JAX/XLA/Pallas kernels: layer-batched SHA-256 merkleization,
+              vmapped BLS field arithmetic, sharded G1 MSM
+  parallel/   jax.sharding Mesh / shard_map utilities (ICI collectives)
+  specs/      executable fork specs phase0 -> altair -> bellatrix -> capella (+eip4844)
+  config/     presets (mainnet/minimal) + runtime configs
+  test_infra/ decorator DSL + helper library (reference: eth2spec/test/context.py)
+  gen/        cross-client test-vector generators (reference: eth2spec/gen_helpers)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,93 @@
+"""Generalized indices over SSZ types (reference: ssz/merkle-proofs.md:58-189).
+
+Provides ``get_generalized_index(type, *path)`` used by the altair light
+client sync protocol (FINALIZED_ROOT_INDEX / NEXT_SYNC_COMMITTEE_INDEX)
+and merkle-proof test helpers, plus single-branch proof construction and
+verification against a view's backing.
+"""
+from __future__ import annotations
+
+from typing import List as PyList
+
+from .node import BranchNode, Node, get_subtree, merkle_root
+from .types import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    _HomogeneousBase,
+    ceil_log2,
+    is_basic_type,
+)
+
+GeneralizedIndex = int
+
+
+def get_generalized_index(typ, *path) -> GeneralizedIndex:
+    """Walk `path` (field names / element indices / '__len__') from `typ`."""
+    gindex = 1
+    for p in path:
+        if p == "__len__":
+            assert isinstance(typ, type) and issubclass(typ, (List, Bitlist, ByteList))
+            gindex = gindex * 2 + 1
+            typ = None
+            continue
+        if isinstance(typ, type) and issubclass(typ, Container):
+            idx = typ._field_index[p]
+            gindex = (gindex << typ._depth) | idx
+            typ = typ._field_types[idx]
+        elif isinstance(typ, type) and issubclass(typ, (List, Vector, Bitlist, Bitvector, ByteList, ByteVector)):
+            i = int(p)
+            if issubclass(typ, (List, Bitlist, ByteList)):
+                gindex = gindex * 2  # contents side of the length mixin
+            if issubclass(typ, _HomogeneousBase):
+                depth = typ.contents_depth()
+                if typ._is_packed():
+                    per = typ._elems_per_chunk()
+                    gindex = (gindex << depth) | (i // per)
+                    typ = None
+                else:
+                    gindex = (gindex << depth) | i
+                    typ = typ.ELEM_TYPE
+            elif issubclass(typ, (Bitlist, Bitvector)):
+                n_chunks_depth = ceil_log2((typ.LENGTH + 255) // 256)
+                gindex = (gindex << n_chunks_depth) | (i // 256)
+                typ = None
+            else:  # ByteVector / ByteList
+                byte_len = typ.TYPE_BYTE_LENGTH if issubclass(typ, ByteVector) else typ.LIMIT
+                n_chunks_depth = ceil_log2((byte_len + 31) // 32)
+                gindex = (gindex << n_chunks_depth) | (i // 32)
+                typ = None
+        else:
+            raise TypeError(f"cannot index into {typ} with {p}")
+    return gindex
+
+
+def get_generalized_index_length(index: GeneralizedIndex) -> int:
+    """Depth of a generalized index (ssz/merkle-proofs.md)."""
+    return index.bit_length() - 1
+
+
+def get_subtree_at_gindex(node: Node, gindex: GeneralizedIndex) -> Node:
+    depth = gindex.bit_length() - 1
+    return get_subtree(node, depth, gindex - (1 << depth))
+
+
+def build_proof(node: Node, gindex: GeneralizedIndex) -> PyList[bytes]:
+    """Sibling hashes along the branch, leaf-side first (matches
+    is_valid_merkle_branch ordering, phase0/beacon-chain.md:742-753)."""
+    depth = gindex.bit_length() - 1
+    index = gindex - (1 << depth)
+    proof: PyList[bytes] = []
+    cur = node
+    for k in range(depth - 1, -1, -1):
+        assert isinstance(cur, BranchNode)
+        bit = (index >> k) & 1
+        sibling = cur.left if bit else cur.right
+        proof.append(merkle_root(sibling))
+        cur = cur.right if bit else cur.left
+    return list(reversed(proof))

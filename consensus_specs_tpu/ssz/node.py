@@ -1,0 +1,187 @@
+"""Persistent (structurally shared) binary Merkle tree nodes.
+
+This is the remerkleable-equivalent backing layer (reference seam:
+eth2spec/utils/ssz/ssz_impl.py:8-13 routes ``hash_tree_root`` through
+``View.get_backing().merkle_root()``).  Key properties kept from the
+reference design, because the test framework's zero-copy state cache
+depends on them (reference: eth2spec/test/context.py:105-125):
+
+  * nodes are immutable; updates copy the path from root to leaf
+  * every node memoizes its Merkle root, so unchanged subtrees are never
+    re-hashed (incremental ``hash_tree_root``)
+  * zero-subtrees of every depth are globally shared singletons
+
+TPU-first difference: root computation is *layer-batched*.  Instead of
+recursive child-then-parent hashing, all unhashed nodes are collected and
+hashed in ready-waves through ``hashing.hash_layer`` — one device dispatch
+per tree level — so a dirty 400k-validator registry becomes a handful of
+large SHA-256 batches instead of ~10^5 single hashes.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .hashing import ZERO_HASHES, hash_layer, sha256
+
+
+class Node:
+    __slots__ = ("_root",)
+
+
+class LeafNode(Node):
+    __slots__ = ()
+
+    def __init__(self, root: bytes):
+        assert len(root) == 32
+        self._root = root
+
+    @property
+    def root(self) -> bytes:
+        return self._root
+
+    def __repr__(self) -> str:
+        return f"Leaf({self._root.hex()[:16]})"
+
+
+class BranchNode(Node):
+    __slots__ = ("left", "right")
+
+    def __init__(self, left: Node, right: Node):
+        self.left = left
+        self.right = right
+        self._root: Optional[bytes] = None
+
+    def __repr__(self) -> str:
+        return f"Branch(root={'?' if self._root is None else self._root.hex()[:16]})"
+
+
+ZERO_LEAF = LeafNode(b"\x00" * 32)
+
+# zero_node(d): root of a fully-zero subtree of depth d, globally shared.
+_ZERO_NODES: List[Node] = [ZERO_LEAF]
+for _d in range(1, 64):
+    _b = BranchNode(_ZERO_NODES[-1], _ZERO_NODES[-1])
+    _b._root = ZERO_HASHES[_d]
+    _ZERO_NODES.append(_b)
+
+
+def zero_node(depth: int) -> Node:
+    return _ZERO_NODES[depth]
+
+
+def merkle_root(node: Node) -> bytes:
+    """Compute (and memoize) the root, hashing whole ready-waves at once."""
+    if node._root is not None:
+        return node._root
+    # Collect every unhashed branch reachable from `node` (deduped: the tree
+    # is a DAG under structural sharing).
+    pending: List[BranchNode] = []
+    seen = set()
+    stack: List[Node] = [node]
+    while stack:
+        n = stack.pop()
+        if n._root is not None or id(n) in seen:
+            continue
+        seen.add(id(n))
+        pending.append(n)  # type: ignore[arg-type]
+        if n.left._root is None:  # type: ignore[union-attr]
+            stack.append(n.left)  # type: ignore[union-attr]
+        if n.right._root is None:  # type: ignore[union-attr]
+            stack.append(n.right)  # type: ignore[union-attr]
+    # Ready-wave hashing: a node is ready once both children have roots.
+    while pending:
+        ready: List[BranchNode] = []
+        later: List[BranchNode] = []
+        for n in pending:
+            if n.left._root is not None and n.right._root is not None:
+                ready.append(n)
+            else:
+                later.append(n)
+        digests = hash_layer([n.left._root + n.right._root for n in ready])
+        for n, d in zip(ready, digests):
+            n._root = d
+        pending = later
+    return node._root  # type: ignore[return-value]
+
+
+def get_subtree(node: Node, depth: int, index: int) -> Node:
+    """Descend `depth` levels; bit k of `index` (MSB first) picks the child."""
+    for k in range(depth - 1, -1, -1):
+        assert isinstance(node, BranchNode), "descended past a leaf"
+        node = node.right if (index >> k) & 1 else node.left
+    return node
+
+
+def with_subtree(node: Node, depth: int, index: int, subtree: Node) -> Node:
+    """Return a new tree with the subtree at (depth, index) replaced (path copy)."""
+    if depth == 0:
+        return subtree
+    assert isinstance(node, BranchNode)
+    bit = (index >> (depth - 1)) & 1
+    if bit:
+        return BranchNode(node.left, with_subtree(node.right, depth - 1, index, subtree))
+    return BranchNode(with_subtree(node.left, depth - 1, index, subtree), node.right)
+
+
+def with_updated_subtrees(
+    node: Node, depth: int, updates: Sequence[Tuple[int, Node]]
+) -> Node:
+    """Bulk path-copy update: `updates` is a sorted list of (index, subtree).
+
+    Untouched subtrees are returned by identity, preserving their memoized
+    roots — this is what keeps epoch-boundary registry updates incremental.
+    """
+    if not updates:
+        return node
+    if depth == 0:
+        assert len(updates) == 1
+        return updates[0][1]
+    half = 1 << (depth - 1)
+    split = 0
+    while split < len(updates) and updates[split][0] < half:
+        split += 1
+    left_updates = updates[:split]
+    right_updates = [(i - half, n) for i, n in updates[split:]]
+    if isinstance(node, BranchNode):
+        left, right = node.left, node.right
+    else:
+        raise AssertionError("descended past a leaf")
+    new_left = with_updated_subtrees(left, depth - 1, left_updates) if left_updates else left
+    new_right = (
+        with_updated_subtrees(right, depth - 1, right_updates) if right_updates else right
+    )
+    if new_left is left and new_right is right:
+        return node
+    return BranchNode(new_left, new_right)
+
+
+def subtree_fill_to_contents(nodes: Sequence[Node], depth: int) -> Node:
+    """Build a depth-`depth` subtree whose first len(nodes) leaves are `nodes`,
+    zero-padded on the right (shared zero subtrees)."""
+    n = len(nodes)
+    assert n <= (1 << depth)
+    if n == 0:
+        return zero_node(depth)
+    if depth == 0:
+        return nodes[0]
+    layer: List[Node] = list(nodes)
+    for d in range(depth):
+        odd = len(layer) & 1
+        pairs = len(layer) >> 1
+        nxt: List[Node] = [BranchNode(layer[2 * i], layer[2 * i + 1]) for i in range(pairs)]
+        if odd:
+            nxt.append(BranchNode(layer[-1], zero_node(d)))
+        layer = nxt
+    assert len(layer) == 1
+    return layer[0]
+
+
+def pack_chunks(data: bytes) -> List[LeafNode]:
+    """Split serialized bytes into zero-padded 32-byte chunk leaves."""
+    if len(data) % 32:
+        data = data + b"\x00" * (32 - len(data) % 32)
+    return [LeafNode(data[i : i + 32]) for i in range(0, len(data), 32)]
+
+
+def uint_to_leaf(value: int) -> LeafNode:
+    return LeafNode(value.to_bytes(32, "little"))

@@ -1,0 +1,14 @@
+"""Test-session configuration.
+
+Tests run on a virtual 8-device CPU mesh (multi-chip sharding is validated
+without real chips; the driver's dryrun_multichip does the same).  Must be
+set before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,386 @@
+"""SSZ type-system tests: serialization, merkleization (vs the standalone
+merkle_minimal oracle), mutation/dirty propagation, copy-on-write.
+Behavioral model: ssz/simple-serialize.md in the reference.
+"""
+import pytest
+
+from consensus_specs_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes32,
+    Bytes48,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    hash_tree_root,
+    serialize,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint256,
+)
+from consensus_specs_tpu.ssz.merkle_minimal import (
+    merkleize_chunks,
+    mix_in_length,
+    mix_in_selector,
+)
+
+
+def chunkify(data: bytes):
+    if len(data) % 32:
+        data = data + b"\x00" * (32 - len(data) % 32)
+    return [data[i : i + 32] for i in range(0, len(data), 32)] or [b"\x00" * 32]
+
+
+# -- basic types -------------------------------------------------------------
+
+
+def test_uint_serialization():
+    assert serialize(uint8(5)) == b"\x05"
+    assert serialize(uint16(0x4566)) == b"\x66\x45"
+    assert serialize(uint32(0x01020304)) == b"\x04\x03\x02\x01"
+    assert serialize(uint64(2**64 - 1)) == b"\xff" * 8
+    assert serialize(uint256(1)) == b"\x01" + b"\x00" * 31
+
+
+def test_uint_bounds_checked():
+    with pytest.raises(ValueError):
+        uint8(256)
+    with pytest.raises(ValueError):
+        uint64(-1)
+    with pytest.raises(ValueError):
+        uint64(2**64)
+
+
+def test_uint_checked_arithmetic():
+    a = uint64(10)
+    assert a + 5 == 15 and type(a + 5) is uint64
+    assert a - 10 == 0
+    with pytest.raises(ValueError):
+        a - 11  # underflow is invalid
+    with pytest.raises(ValueError):
+        uint64(2**63) * 2  # overflow is invalid
+    assert a // 3 == 3
+    assert a % 3 == 1
+    assert uint8(3) * uint8(4) == 12
+
+
+def test_uint_hash_tree_root():
+    assert hash_tree_root(uint64(7)) == (7).to_bytes(32, "little")
+    assert hash_tree_root(boolean(1)) == (1).to_bytes(32, "little")
+
+
+# -- byte vectors/lists ------------------------------------------------------
+
+
+def test_bytes32_root_is_identity():
+    b = Bytes32(b"\x01" * 32)
+    assert hash_tree_root(b) == b"\x01" * 32
+    assert serialize(b) == b"\x01" * 32
+
+
+def test_bytes48_root():
+    b = Bytes48(b"\xab" * 48)
+    assert hash_tree_root(b) == merkleize_chunks(chunkify(b"\xab" * 48))
+
+
+def test_bytelist_root_mixes_length():
+    BL = ByteList[2**5]
+    b = BL(b"hello")
+    expected = mix_in_length(merkleize_chunks(chunkify(b"hello"), limit=1), 5)
+    assert hash_tree_root(b) == expected
+    assert serialize(b) == b"hello"
+
+
+def test_bytelist_empty():
+    BL = ByteList[64]
+    assert hash_tree_root(BL(b"")) == mix_in_length(merkleize_chunks([], limit=2), 0)
+
+
+# -- bitvector / bitlist -----------------------------------------------------
+
+
+def test_bitvector_serialization():
+    bv = Bitvector[10](1, 0, 1, 0, 1, 0, 1, 0, 1, 1)
+    # bits little-endian within bytes: 0b01010101, 0b00000011
+    assert serialize(bv) == bytes([0b01010101, 0b00000011])
+    assert hash_tree_root(bv) == merkleize_chunks(chunkify(serialize(bv)))
+
+
+def test_bitlist_serialization_delimiter():
+    bl = Bitlist[8](1, 1, 0)
+    # 3 bits -> 0b011 plus delimiter at position 3 -> 0b1011
+    assert serialize(bl) == bytes([0b1011])
+    empty = Bitlist[8]()
+    assert serialize(empty) == bytes([0b1])
+
+
+def test_bitlist_root():
+    bl = Bitlist[2048](*([1] * 10))
+    contents = merkleize_chunks(chunkify(bytes([0xFF, 0x03])), limit=(2048 + 255) // 256)
+    assert hash_tree_root(bl) == mix_in_length(contents, 10)
+
+
+def test_bitlist_decode_roundtrip():
+    BL = Bitlist[16]
+    for bits in ([], [1], [0, 1, 1, 0, 1, 0, 0, 1], [1] * 16):
+        bl = BL(*bits)
+        assert BL.decode_bytes(serialize(bl)) == bl
+
+
+# -- vectors / lists ---------------------------------------------------------
+
+
+def test_vector_uint64_root():
+    v = Vector[uint64, 4](1, 2, 3, 4)
+    data = b"".join(int(x).to_bytes(8, "little") for x in (1, 2, 3, 4))
+    assert serialize(v) == data
+    assert hash_tree_root(v) == merkleize_chunks(chunkify(data))
+
+
+def test_vector_default_is_zero():
+    v = Vector[uint64, 8192]()
+    assert hash_tree_root(v) == merkleize_chunks([], limit=(8192 * 8) // 32)
+
+
+def test_list_uint64_root():
+    L = List[uint64, 1024]
+    l = L(5, 6, 7)
+    data = b"".join(int(x).to_bytes(8, "little") for x in (5, 6, 7))
+    contents = merkleize_chunks(chunkify(data), limit=(1024 * 8 + 31) // 32)
+    assert hash_tree_root(l) == mix_in_length(contents, 3)
+    assert serialize(l) == data
+
+
+def test_list_append_updates_root():
+    L = List[uint64, 64]
+    l = L()
+    roots = set()
+    for i in range(5):
+        l.append(i)
+        roots.add(bytes(hash_tree_root(l)))
+    assert len(roots) == 5
+    fresh = L(0, 1, 2, 3, 4)
+    assert hash_tree_root(l) == hash_tree_root(fresh)
+
+
+def test_large_packed_list_setitem_incremental():
+    L = List[uint64, 2**40]
+    l = L(list(range(1000)))
+    r1 = hash_tree_root(l)
+    l[500] = 123456
+    vals = list(range(1000))
+    vals[500] = 123456
+    assert hash_tree_root(l) == hash_tree_root(L(vals))
+    assert hash_tree_root(l) != r1
+
+
+# -- containers --------------------------------------------------------------
+
+
+class Checkpoint(Container):
+    epoch: uint64
+    root: Bytes32
+
+
+class Inner(Container):
+    a: uint64
+    b: Bytes32
+
+
+class Outer(Container):
+    x: uint64
+    inner: Inner
+    items: List[uint64, 32]
+    flags: Bitvector[4]
+
+
+def test_container_root_is_merkle_of_field_roots():
+    c = Checkpoint(epoch=3, root=Bytes32(b"\x05" * 32))
+    expected = merkleize_chunks(
+        [(3).to_bytes(32, "little"), b"\x05" * 32]
+    )
+    assert hash_tree_root(c) == expected
+
+
+def test_container_serialization():
+    c = Checkpoint(epoch=3, root=Bytes32(b"\x05" * 32))
+    assert serialize(c) == (3).to_bytes(8, "little") + b"\x05" * 32
+    assert Checkpoint.decode_bytes(serialize(c)) == c
+
+
+def test_container_variable_field_serialization():
+    o = Outer(x=7, items=List[uint64, 32](1, 2))
+    data = serialize(o)
+    o2 = Outer.decode_bytes(data)
+    assert o2 == o
+    assert list(o2.items) == [1, 2]
+
+
+def test_nested_mutation_propagates():
+    o = Outer()
+    r0 = hash_tree_root(o)
+    o.inner.a = uint64(9)
+    r1 = hash_tree_root(o)
+    assert r0 != r1
+    fresh = Outer(inner=Inner(a=9))
+    assert r1 == hash_tree_root(fresh)
+    # mutate deeper after a flush
+    o.inner.b = Bytes32(b"\x01" * 32)
+    assert hash_tree_root(o) == hash_tree_root(Outer(inner=Inner(a=9, b=Bytes32(b"\x01" * 32))))
+
+
+def test_list_element_mutation_propagates():
+    class Rec(Container):
+        v: uint64
+
+    class Holder(Container):
+        recs: List[Rec, 8]
+
+    h = Holder(recs=List[Rec, 8](Rec(v=1), Rec(v=2)))
+    h.recs[1].v = uint64(5)
+    expect = Holder(recs=List[Rec, 8](Rec(v=1), Rec(v=5)))
+    assert hash_tree_root(h) == hash_tree_root(expect)
+
+
+def test_copy_is_independent():
+    o = Outer(x=1)
+    c = o.copy()
+    o.x = uint64(2)
+    assert c.x == 1 and o.x == 2
+    assert hash_tree_root(c) != hash_tree_root(o)
+
+
+def test_assignment_copies_value():
+    o = Outer()
+    inner = Inner(a=4)
+    o.inner = inner
+    inner.a = uint64(99)  # must not leak into o
+    assert o.inner.a == 4
+
+
+def test_bitvector_field_mutation():
+    o = Outer()
+    o.flags[2] = True
+    assert hash_tree_root(o) == hash_tree_root(Outer(flags=Bitvector[4](0, 0, 1, 0)))
+
+
+# -- union -------------------------------------------------------------------
+
+
+def test_union():
+    U = Union[None, uint64, Bytes32]
+    u = U(1, uint64(7))
+    assert serialize(u) == b"\x01" + (7).to_bytes(8, "little")
+    expected = mix_in_selector((7).to_bytes(32, "little"), 1)
+    assert hash_tree_root(u) == expected
+    u0 = U(0, None)
+    assert serialize(u0) == b"\x00"
+    assert U.decode_bytes(serialize(u)) == u
+
+
+# -- incremental hashing sanity ---------------------------------------------
+
+
+def test_incremental_matches_bulk_on_registry_like_update():
+    class Validator(Container):
+        pubkey: Bytes48
+        balance: uint64
+
+    VL = List[Validator, 2**40]
+    n = 300
+    vals = [Validator(pubkey=Bytes48(bytes([i % 256]) * 48), balance=32) for i in range(n)]
+    l = VL(vals)
+    _ = hash_tree_root(l)
+    l[37].balance = uint64(31)
+    l.append(Validator(pubkey=Bytes48(b"\xaa" * 48), balance=1))
+    vals2 = [Validator(pubkey=Bytes48(bytes([i % 256]) * 48), balance=32) for i in range(n)]
+    vals2[37].balance = uint64(31)
+    vals2.append(Validator(pubkey=Bytes48(b"\xaa" * 48), balance=1))
+    assert hash_tree_root(l) == hash_tree_root(VL(vals2))
+
+
+# -- regression tests from review findings -----------------------------------
+
+
+def test_vector_of_composite_default():
+    v = Vector[Checkpoint, 4]()
+    assert v[0] == Checkpoint()
+    expected = merkleize_chunks([bytes(hash_tree_root(Checkpoint()))] * 4)
+    assert bytes(hash_tree_root(v)) == expected
+
+
+def test_union_as_container_field():
+    class C(Container):
+        u: Union[None, uint64]
+
+    c = C()
+    assert c.u.selector == 0
+    c.u = Union[None, uint64](1, uint64(7))
+    assert c.u.value == 7
+    assert C.decode_bytes(bytes(serialize(c))) == c
+
+
+def test_wrong_layout_container_store_rejected():
+    class Inner(Container):
+        a: uint64
+
+    class Other(Container):
+        b: Bytes32
+
+    class Outer(Container):
+        inner: Inner
+
+    o = Outer()
+    with pytest.raises(TypeError):
+        o.inner = Other()
+
+
+def test_crossfork_same_layout_container_store_allowed():
+    # fork-upgrade functions assign containers across fork namespaces;
+    # layout-identical (names+types) classes must interoperate
+    class CheckpointV2(Container):
+        epoch: uint64
+        root: Bytes32
+
+    class Holder(Container):
+        cp: Checkpoint
+
+    h = Holder()
+    h.cp = CheckpointV2(epoch=9, root=Bytes32(b"\x01" * 32))
+    assert h.cp.epoch == 9
+
+
+def test_garbage_decode_rejected():
+    class VarC(Container):
+        a: List[uint64, 4]
+        b: Bytes32
+
+    with pytest.raises(ValueError):
+        VarC.decode_bytes(b"\xff" * 40)
+
+
+def test_empty_bytevector_decode_rejected():
+    with pytest.raises(ValueError):
+        Bytes32.decode_bytes(b"")
+
+
+def test_merkleize_over_limit_raises():
+    with pytest.raises(AssertionError):
+        merkleize_chunks([b"\x00" * 32] * 3, limit=2)
+
+
+def test_composite_list_pop_restores_zero_chunk():
+    class Rec(Container):
+        v: uint64
+
+    L = List[Rec, 16]
+    l = L(Rec(v=1), Rec(v=2))
+    l.pop()
+    assert hash_tree_root(l) == hash_tree_root(L(Rec(v=1)))
+    assert len(l) == 1
