@@ -1,0 +1,122 @@
+"""BLS backend selector — the plugin seam the spec modules import.
+
+Behavioral twin of the reference's eth2spec/utils/bls.py:
+  * module-global backend rebinding (use_python / use_jax), mirroring
+    use_py_ecc/use_milagro (bls.py:17-30)
+  * ``bls_active`` kill-switch + ``only_with_bls`` decorator returning
+    stub values when off (bls.py:6, 33-44) — tests run 100x faster
+  * Verify-family wrappers catch every exception and return False
+    (bls.py:47-74): malformed inputs are invalid, never fatal
+
+Backends:
+  * "python": the from-scratch pure-Python oracle in this package
+  * "jax":    batched TPU pipeline (ops/bls_jax) — registered lazily
+"""
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from . import ciphersuite as _py_backend
+
+G2_POINT_AT_INFINITY = _py_backend.G2_POINT_AT_INFINITY
+
+STUB_SIGNATURE = b"\x11" * 96
+STUB_PUBKEY = b"\x22" * 48
+STUB_COORDINATES = G2_POINT_AT_INFINITY
+
+bls_active = True
+
+_backends = {"python": _py_backend}
+_backend_name = "python"
+bls = _py_backend
+
+
+def register_backend(name: str, module) -> None:
+    _backends[name] = module
+
+
+def use_backend(name: str) -> None:
+    global bls, _backend_name
+    if name == "jax" and "jax" not in _backends:
+        from consensus_specs_tpu.ops import bls_jax
+
+        register_backend("jax", bls_jax.backend())
+    bls = _backends[name]
+    _backend_name = name
+
+
+def use_python() -> None:
+    use_backend("python")
+
+
+def use_jax() -> None:
+    use_backend("jax")
+
+
+def backend_name() -> str:
+    return _backend_name
+
+
+def only_with_bls(alt_return=None):
+    """Decorator: skip the wrapped function when BLS is disabled
+    (reference: eth2spec/utils/bls.py:33-44)."""
+
+    def decorator(fn):
+        def wrapper(*args, **kwargs):
+            if not bls_active:
+                return alt_return
+            return fn(*args, **kwargs)
+
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
+    return decorator
+
+
+@only_with_bls(alt_return=True)
+def Verify(PK, message, signature):
+    try:
+        return bls.Verify(PK, message, signature)
+    except Exception:
+        return False
+
+
+@only_with_bls(alt_return=True)
+def AggregateVerify(pubkeys, messages, signature):
+    try:
+        return bls.AggregateVerify(pubkeys, messages, signature)
+    except Exception:
+        return False
+
+
+@only_with_bls(alt_return=True)
+def FastAggregateVerify(pubkeys, message, signature):
+    try:
+        return bls.FastAggregateVerify(pubkeys, message, signature)
+    except Exception:
+        return False
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Aggregate(signatures):
+    return bls.Aggregate(signatures)
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Sign(SK, message):
+    return bls.Sign(SK, message)
+
+
+@only_with_bls(alt_return=True)
+def KeyValidate(pubkey):
+    return bls.KeyValidate(pubkey)
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def AggregatePKs(pubkeys):
+    return bls.AggregatePKs(pubkeys)
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def SkToPk(SK):
+    return bls.SkToPk(SK)

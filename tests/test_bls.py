@@ -1,0 +1,178 @@
+"""BLS12-381 tests: RFC 9380 vectors, sign/verify/aggregate semantics,
+serialization, selector/stub behavior.
+Reference behavior model: eth2spec/utils/bls.py + py_ecc G2ProofOfPossession.
+"""
+import pytest
+
+import consensus_specs_tpu.crypto.bls as bls
+from consensus_specs_tpu.crypto.bls.curve import (
+    g1_from_bytes,
+    g1_generator,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_generator,
+    g2_to_bytes,
+)
+from consensus_specs_tpu.crypto.bls.fields import FQ12_ONE, Fq2, P
+from consensus_specs_tpu.crypto.bls.hash_to_curve import expand_message_xmd, hash_to_g2
+from consensus_specs_tpu.crypto.bls.pairing import pairing, pairings_are_identity
+
+
+@pytest.fixture(autouse=True)
+def _bls_on():
+    bls.bls_active = True
+    yield
+    bls.bls_active = True
+
+
+# -- external vectors --------------------------------------------------------
+
+
+def test_expand_message_xmd_rfc9380():
+    dst = b"QUUX-V01-CS02-with-expander-SHA256-128"
+    assert (
+        expand_message_xmd(b"", dst, 0x20).hex()
+        == "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+    )
+    assert (
+        expand_message_xmd(b"abc", dst, 0x20).hex()
+        == "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"
+    )
+
+
+def test_hash_to_g2_rfc9380_vector():
+    p = hash_to_g2(b"", b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_")
+    x, y = p.to_affine()
+    assert x.c0 == 0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A
+    assert x.c1 == 0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D
+    assert y.c0 == 0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92
+    assert y.c1 == 0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6
+
+
+def test_sk_to_pk_known_vectors():
+    assert (
+        bls.SkToPk(1).hex()
+        == "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac586c55e83ff97a1aeffb3af00adb22c6bb"
+    )
+    sk = 0x263DBD792F5B1BE47ED85F8938C0F29586AF0D3AC7B977F21C278FE1462040E3
+    assert (
+        bls.SkToPk(sk).hex()
+        == "a491d1b0ecd9bb917989f0e74f0dea0422eac4a873e5e2644f368dffb9a6e20fd6e10c1b77654d067c0618f6e5a7f79a"
+    )
+
+
+# -- pairing -----------------------------------------------------------------
+
+
+def test_pairing_bilinearity():
+    g1, g2 = g1_generator(), g2_generator()
+    e = pairing(g1, g2)
+    assert e != FQ12_ONE
+    assert pairing(g1.mul(2), g2) == e.pow(2)
+    assert pairing(g1, g2.mul(2)) == e.pow(2)
+    assert pairings_are_identity([(g1.mul(3), g2), (-g1, g2.mul(3))])
+
+
+# -- sign / verify -----------------------------------------------------------
+
+
+def test_sign_verify_roundtrip():
+    sk, msg = 42, b"test message"
+    pk = bls.SkToPk(sk)
+    sig = bls.Sign(sk, msg)
+    assert bls.Verify(pk, msg, sig)
+    assert not bls.Verify(pk, b"wrong", sig)
+    assert not bls.Verify(bls.SkToPk(43), msg, sig)
+
+
+def test_verify_malformed_inputs_return_false():
+    pk = bls.SkToPk(5)
+    sig = bls.Sign(5, b"m")
+    assert not bls.Verify(b"\x00" * 48, b"m", sig)
+    assert not bls.Verify(pk, b"m", b"\xFF" * 96)
+    assert not bls.Verify(b"short", b"m", sig)
+    assert not bls.Verify(pk, b"m", b"short")
+
+
+def test_aggregate_same_message():
+    sks = [1, 2, 3]
+    pks = [bls.SkToPk(s) for s in sks]
+    sigs = [bls.Sign(s, b"msg") for s in sks]
+    agg = bls.Aggregate(sigs)
+    assert bls.FastAggregateVerify(pks, b"msg", agg)
+    assert not bls.FastAggregateVerify(pks[:2], b"msg", agg)
+    assert not bls.FastAggregateVerify([], b"msg", agg)
+
+
+def test_aggregate_verify_distinct_messages():
+    sks = [7, 8]
+    msgs = [b"a", b"b"]
+    pks = [bls.SkToPk(s) for s in sks]
+    agg = bls.Aggregate([bls.Sign(s, m) for s, m in zip(sks, msgs)])
+    assert bls.AggregateVerify(pks, msgs, agg)
+    assert not bls.AggregateVerify(pks, [b"a", b"x"], agg)
+
+
+def test_aggregate_pks_matches_sum_of_sks():
+    pks = [bls.SkToPk(s) for s in (1, 2, 3)]
+    assert bls.AggregatePKs(pks) == bls.SkToPk(6)
+
+
+def test_aggregate_empty_raises():
+    with pytest.raises(ValueError):
+        bls.bls.Aggregate([])
+    with pytest.raises(ValueError):
+        bls.bls.AggregatePKs([])
+
+
+def test_key_validate():
+    assert bls.KeyValidate(bls.SkToPk(11))
+    assert not bls.KeyValidate(b"\x00" * 48)
+    # identity pubkey rejected
+    assert not bls.KeyValidate(bytes([0xC0]) + b"\x00" * 47)
+
+
+# -- serialization -----------------------------------------------------------
+
+
+def test_point_serialization_roundtrip():
+    pk = bls.SkToPk(77)
+    sig = bls.Sign(77, b"x")
+    assert g1_to_bytes(g1_from_bytes(pk)) == pk
+    assert g2_to_bytes(g2_from_bytes(sig)) == sig
+
+
+def test_infinity_serialization():
+    inf_g1 = bytes([0xC0]) + b"\x00" * 47
+    inf_g2 = bytes([0xC0]) + b"\x00" * 95
+    assert g1_to_bytes(g1_from_bytes(inf_g1)) == inf_g1
+    assert g2_to_bytes(g2_from_bytes(inf_g2)) == inf_g2
+
+
+def test_fq2_sqrt_property():
+    import random
+
+    rng = random.Random(1234)
+    for _ in range(20):
+        a = Fq2(rng.randrange(P), rng.randrange(P))
+        sq = a.square()
+        r = sq.sqrt()
+        assert r is not None and r.square() == sq
+
+
+# -- selector / stubbing -----------------------------------------------------
+
+
+def test_bls_active_stubbing():
+    bls.bls_active = False
+    assert bls.Verify(b"junk", b"m", b"junk") is True
+    assert bls.Sign(1, b"m") == bls.STUB_SIGNATURE
+    assert bls.SkToPk(1) == bls.STUB_PUBKEY
+    bls.bls_active = True
+    assert bls.Verify(b"junk", b"m", b"junk") is False
+
+
+def test_backend_selector():
+    assert bls.backend_name() == "python"
+    bls.use_python()
+    assert bls.backend_name() == "python"
