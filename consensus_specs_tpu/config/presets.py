@@ -1,0 +1,118 @@
+"""Compile-time preset values, mainnet and minimal, per fork.
+
+Protocol data (not code) transcribed from the reference preset tables
+(reference: presets/{mainnet,minimal}/{phase0,altair,bellatrix}.yaml and
+the capella markdown preset tables, specs/capella/beacon-chain.md:77-89).
+A preset is the union of all per-fork preset vars — exactly how the
+reference merges per-fork YAML files (setup.py:782-797) — so one preset
+dict serves every fork.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+PRESET_NAMES = ("mainnet", "minimal")
+
+_PHASE0_MAINNET = {
+    # Misc
+    "MAX_COMMITTEES_PER_SLOT": 64,
+    "TARGET_COMMITTEE_SIZE": 128,
+    "MAX_VALIDATORS_PER_COMMITTEE": 2048,
+    "SHUFFLE_ROUND_COUNT": 90,
+    "HYSTERESIS_QUOTIENT": 4,
+    "HYSTERESIS_DOWNWARD_MULTIPLIER": 1,
+    "HYSTERESIS_UPWARD_MULTIPLIER": 5,
+    # Fork choice
+    "SAFE_SLOTS_TO_UPDATE_JUSTIFIED": 8,
+    # Gwei values
+    "MIN_DEPOSIT_AMOUNT": 1_000_000_000,
+    "MAX_EFFECTIVE_BALANCE": 32_000_000_000,
+    "EFFECTIVE_BALANCE_INCREMENT": 1_000_000_000,
+    # Time parameters
+    "MIN_ATTESTATION_INCLUSION_DELAY": 1,
+    "SLOTS_PER_EPOCH": 32,
+    "MIN_SEED_LOOKAHEAD": 1,
+    "MAX_SEED_LOOKAHEAD": 4,
+    "EPOCHS_PER_ETH1_VOTING_PERIOD": 64,
+    "SLOTS_PER_HISTORICAL_ROOT": 8192,
+    "MIN_EPOCHS_TO_INACTIVITY_PENALTY": 4,
+    # State list lengths
+    "EPOCHS_PER_HISTORICAL_VECTOR": 65536,
+    "EPOCHS_PER_SLASHINGS_VECTOR": 8192,
+    "HISTORICAL_ROOTS_LIMIT": 16_777_216,
+    "VALIDATOR_REGISTRY_LIMIT": 2**40,
+    # Reward and penalty quotients
+    "BASE_REWARD_FACTOR": 64,
+    "WHISTLEBLOWER_REWARD_QUOTIENT": 512,
+    "PROPOSER_REWARD_QUOTIENT": 8,
+    "INACTIVITY_PENALTY_QUOTIENT": 2**26,
+    "MIN_SLASHING_PENALTY_QUOTIENT": 128,
+    "PROPORTIONAL_SLASHING_MULTIPLIER": 1,
+    # Max operations per block
+    "MAX_PROPOSER_SLASHINGS": 16,
+    "MAX_ATTESTER_SLASHINGS": 2,
+    "MAX_ATTESTATIONS": 128,
+    "MAX_DEPOSITS": 16,
+    "MAX_VOLUNTARY_EXITS": 16,
+}
+
+# minimal = mainnet with the [customized] keys overridden
+_PHASE0_MINIMAL = dict(
+    _PHASE0_MAINNET,
+    MAX_COMMITTEES_PER_SLOT=4,
+    TARGET_COMMITTEE_SIZE=4,
+    SHUFFLE_ROUND_COUNT=10,
+    SAFE_SLOTS_TO_UPDATE_JUSTIFIED=2,
+    SLOTS_PER_EPOCH=8,
+    EPOCHS_PER_ETH1_VOTING_PERIOD=4,
+    SLOTS_PER_HISTORICAL_ROOT=64,
+    EPOCHS_PER_HISTORICAL_VECTOR=64,
+    EPOCHS_PER_SLASHINGS_VECTOR=64,
+    INACTIVITY_PENALTY_QUOTIENT=2**25,
+    MIN_SLASHING_PENALTY_QUOTIENT=64,
+    PROPORTIONAL_SLASHING_MULTIPLIER=2,
+)
+
+_ALTAIR_MAINNET = {
+    "INACTIVITY_PENALTY_QUOTIENT_ALTAIR": 3 * 2**24,
+    "MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR": 64,
+    "PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR": 2,
+    "SYNC_COMMITTEE_SIZE": 512,
+    "EPOCHS_PER_SYNC_COMMITTEE_PERIOD": 256,
+    "MIN_SYNC_COMMITTEE_PARTICIPANTS": 1,
+    "UPDATE_TIMEOUT": 8192,
+}
+
+_ALTAIR_MINIMAL = dict(
+    _ALTAIR_MAINNET,
+    SYNC_COMMITTEE_SIZE=32,
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD=8,
+    UPDATE_TIMEOUT=64,
+)
+
+_BELLATRIX_BOTH = {
+    "INACTIVITY_PENALTY_QUOTIENT_BELLATRIX": 2**24,
+    "MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX": 32,
+    "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX": 3,
+    "MAX_BYTES_PER_TRANSACTION": 2**30,
+    "MAX_TRANSACTIONS_PER_PAYLOAD": 2**20,
+    "BYTES_PER_LOGS_BLOOM": 256,
+    "MAX_EXTRA_DATA_BYTES": 32,
+}
+
+# Capella preset vars live in the markdown tables in this snapshot
+# (specs/capella/beacon-chain.md:77-89); same for both presets.
+_CAPELLA_BOTH = {
+    "WITHDRAWALS_QUEUE_LIMIT": 2**40,
+    "MAX_BLS_TO_EXECUTION_CHANGES": 16,
+    "MAX_WITHDRAWALS_PER_PAYLOAD": 16,
+}
+
+_PRESETS: Dict[str, Dict[str, int]] = {
+    "mainnet": {**_PHASE0_MAINNET, **_ALTAIR_MAINNET, **_BELLATRIX_BOTH, **_CAPELLA_BOTH},
+    "minimal": {**_PHASE0_MINIMAL, **_ALTAIR_MINIMAL, **_BELLATRIX_BOTH, **_CAPELLA_BOTH},
+}
+
+
+def get_preset(name: str) -> Dict[str, int]:
+    return dict(_PRESETS[name])

@@ -14,7 +14,6 @@ from typing import Sequence
 
 from .curve import (
     DeserializationError,
-    Point,
     g1_generator,
     g1_infinity,
     g1_to_bytes,

@@ -8,9 +8,9 @@ BLSPubkey/BLSSignature types, phase0/beacon-chain.md:152-170).
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import Optional, Tuple, Union
+from typing import Optional, Tuple
 
-from .fields import Fq, Fq2, FQ2_ONE, FQ2_ZERO, P, R
+from .fields import Fq, Fq2, FQ2_ONE, P, R
 
 # curve coefficients
 B_G1 = Fq(4)

@@ -98,9 +98,6 @@ class Fq2:
         # karatsuba: c1 = (a0+a1)(b0+b1) - t0 - t1
         return Fq2(t0 - t1, (a0 + a1) * (b0 + b1) - t0 - t1)
 
-    def mul_int(self, k: int):
-        return Fq2(self.c0 * k, self.c1 * k)
-
     def square(self):
         a0, a1 = self.c0, self.c1
         # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u
@@ -341,10 +338,6 @@ class Fq12:
 
 FQ12_ZERO = Fq12(FQ6_ZERO, FQ6_ZERO)
 FQ12_ONE = Fq12(FQ6_ONE, FQ6_ZERO)
-
-
-def fq2_from_ints(c0: int, c1: int) -> Fq2:
-    return Fq2(c0, c1)
 
 
 def fq12_from_fq2(x: Fq2) -> Fq12:

@@ -87,8 +87,6 @@ def _sswu(u: Fq2) -> Tuple[Fq2, Fq2]:
 
 # -- 3-isogeny E2' -> E2 (RFC 9380 Appendix E.3) ----------------------------
 
-_XI = 0  # placeholder to keep constant block together
-
 _K1 = (
     Fq2(
         0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,

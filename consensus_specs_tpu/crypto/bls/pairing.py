@@ -9,9 +9,8 @@ need (reference behavior: eth2spec/utils/bls.py:47-74 via py_ecc).
 """
 from __future__ import annotations
 
-from typing import Iterable, List, Optional, Tuple
+from typing import Iterable, Optional, Tuple
 
-from .curve import Point
 from .fields import (
     FQ12_ONE,
     FQ12_W2_INV,

@@ -1,0 +1,8 @@
+"""Batched/vectorized compute kernels.
+
+Host (numpy) and device (JAX/Pallas) implementations of the hot
+operations the sequential spec calls into: swap-or-not shuffling,
+layer-batched SHA-256 merkleization, batched BLS verification, and
+vectorized epoch processing.  Everything here is semantics-preserving:
+each kernel has a scalar spec twin and a differential test.
+"""

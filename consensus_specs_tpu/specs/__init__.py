@@ -1,0 +1,12 @@
+"""Executable consensus specs, fork-layered.
+
+``get_spec(fork, preset)`` returns a module-like spec object exposing the
+full executable spec API for that fork×preset (state_transition,
+process_*, get_*, containers, config) — the equivalent of the
+reference's compiled ``eth2spec/<fork>/<preset>.py`` modules
+(reference: setup.py:998-1002), built from the Python fork sources in
+``src/`` instead of markdown extraction.
+"""
+from .builder import get_spec, available_forks
+
+__all__ = ["get_spec", "available_forks"]

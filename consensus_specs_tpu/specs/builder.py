@@ -1,0 +1,339 @@
+"""Spec builder: exec fork sources layered over one another.
+
+Architecture (mirrors the reference's compiled-module semantics,
+setup.py:741-764, without the markdown round-trip):
+
+  * each fork has a Python *source template* in ``specs/src/<fork>.py``
+    written against free globals (SSZ types, ``bls``, ``hash``, preset
+    constants, ``config``);
+  * ``get_spec(fork, preset)`` builds a fresh module whose globals are
+    pre-seeded with the environment, then execs the source of every fork
+    up to and including the target in order — later definitions override
+    earlier ones, and because all functions share ONE globals dict, a
+    phase0 function calling ``process_epoch`` dispatches to the newest
+    fork's override, exactly like the reference's single flat module;
+  * the previous fork's finished module is injected under its name
+    (``phase0``, ``altair``, ...) so ``upgrade_to_<fork>`` functions can
+    reference predecessor types (reference: setup.py:456-461);
+  * after exec, a sundry layer installs LRU caches on hot accessors
+    (reference: setup.py:358-428) and semantics-preserving optimizations
+    (vectorized whole-committee shuffling; reference's analogue:
+    implement_optimizations, setup.py:65-68).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+from types import ModuleType
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+    TypeVar,
+)
+
+import numpy as np
+
+from consensus_specs_tpu.config import get_config, get_preset
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.ops.shuffle import compute_shuffle_permutation
+from consensus_specs_tpu.ssz import hashing
+from consensus_specs_tpu.ssz import types as ssz_types
+from consensus_specs_tpu.ssz.impl import copy, hash_tree_root, serialize, uint_to_bytes
+from consensus_specs_tpu.ssz.types import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    View,
+    boolean,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+)
+
+SRC_DIR = Path(__file__).parent / "src"
+
+# Fork order; a spec for fork F execs sources [phase0 .. F] in sequence.
+FORK_ORDER = ("phase0", "altair", "bellatrix", "capella")
+
+# Config vars are typed when materialized (reference types them in the
+# Configuration NamedTuple, setup.py:632-639).
+_CONFIG_TYPES = {
+    "TERMINAL_TOTAL_DIFFICULTY": uint256,
+    "TERMINAL_BLOCK_HASH": ByteVector[32],
+    "GENESIS_FORK_VERSION": ByteVector[4],
+    "ALTAIR_FORK_VERSION": ByteVector[4],
+    "BELLATRIX_FORK_VERSION": ByteVector[4],
+    "CAPELLA_FORK_VERSION": ByteVector[4],
+    "SHARDING_FORK_VERSION": ByteVector[4],
+    "DEPOSIT_CONTRACT_ADDRESS": ByteVector[20],
+    "PRESET_BASE": str,
+    "CONFIG_NAME": str,
+}
+
+
+def available_forks() -> Tuple[str, ...]:
+    return tuple(f for f in FORK_ORDER if (SRC_DIR / f"{f}.py").exists())
+
+
+def _typed_config(raw: Dict[str, Any]):
+    from consensus_specs_tpu.config.configs import Config
+
+    typed = {}
+    for k, v in raw.items():
+        t = _CONFIG_TYPES.get(k, uint64)
+        typed[k] = v if t is str else t(v)
+    return Config(typed)
+
+
+def _spec_hash_fn():
+    """Memoized sha256 — the reference also caches `hash` (it is called
+    with identical seeds thousands of times per shuffle)."""
+    sha = hashing.sha256
+    Bytes32 = ByteVector[32]
+    cache: Dict[bytes, bytes] = {}
+
+    def hash_fn(data: bytes) -> bytes:
+        data = bytes(data)
+        out = cache.get(data)
+        if out is None:
+            if len(cache) > 200_000:
+                cache.clear()
+            out = Bytes32(sha(data))
+            cache[data] = out
+        return out
+
+    return hash_fn
+
+
+def _base_env(preset: Dict[str, int], config) -> Dict[str, Any]:
+    env: Dict[str, Any] = {
+        # typing / dataclasses for spec annotations
+        "Any": Any,
+        "Callable": Callable,
+        "Dict": Dict,
+        "Set": Set,
+        "Sequence": Sequence,
+        "Tuple": Tuple,
+        "Optional": Optional,
+        "NamedTuple": NamedTuple,
+        "TypeVar": TypeVar,
+        "dataclass": dataclass,
+        "field": field,
+        # SSZ type system
+        "View": View,
+        "boolean": boolean,
+        "Container": Container,
+        "List": List,
+        "Vector": Vector,
+        "Union": Union,
+        "Bitlist": Bitlist,
+        "Bitvector": Bitvector,
+        "ByteList": ByteList,
+        "ByteVector": ByteVector,
+        "uint8": uint8,
+        "uint16": uint16,
+        "uint32": uint32,
+        "uint64": uint64,
+        "uint128": uint128,
+        "uint256": uint256,
+        "Bytes1": ByteVector[1],
+        "Bytes4": ByteVector[4],
+        "Bytes20": ByteVector[20],
+        "Bytes32": ByteVector[32],
+        "Bytes48": ByteVector[48],
+        "Bytes96": ByteVector[96],
+        # seams
+        "bls": bls,
+        "hash": _spec_hash_fn(),
+        "hash_tree_root": hash_tree_root,
+        "serialize": serialize,
+        "copy": copy,
+        "uint_to_bytes": uint_to_bytes,
+        "config": config,
+    }
+    # preset vars become module constants, typed uint64 (setup.py emits
+    # them as typed constants the same way)
+    for k, v in preset.items():
+        env[k] = uint64(v)
+    return env
+
+
+class LRUDict:
+    """Small insertion-ordered LRU (stand-in for the reference's lru-dict
+    C extension, setup.py:333)."""
+
+    __slots__ = ("size", "d")
+
+    def __init__(self, size: int):
+        self.size = size
+        self.d: Dict[Any, Any] = {}
+
+    def get(self, key, default=None):
+        return self.d.get(key, default)
+
+    def __contains__(self, key):
+        return key in self.d
+
+    def __getitem__(self, key):
+        return self.d[key]
+
+    def __setitem__(self, key, value):
+        if len(self.d) >= self.size:
+            self.d.pop(next(iter(self.d)))
+        self.d[key] = value
+
+
+def cache_this(key_fn, value_fn, lru_size):
+    """Memoize ``value_fn`` under ``key_fn`` (reference: setup.py:369-379)."""
+    cache = LRUDict(lru_size)
+
+    def wrapper(*args, **kw):
+        key = key_fn(*args, **kw)
+        if key not in cache:
+            cache[key] = value_fn(*args, **kw)
+        return cache[key]
+
+    wrapper.__wrapped__ = value_fn
+    return wrapper
+
+
+def _install_sundry(g: Dict[str, Any]) -> None:
+    """LRU caches over hot accessors, keyed on (sub)tree roots so they
+    survive state copies (reference: setup.py:380-428)."""
+    SLOTS_PER_EPOCH = int(g["SLOTS_PER_EPOCH"])
+    MAX_COMMITTEES_PER_SLOT = int(g["MAX_COMMITTEES_PER_SLOT"])
+
+    g["cache_this"] = cache_this
+
+    g["compute_shuffled_index"] = cache_this(
+        lambda index, index_count, seed: (index, index_count, seed),
+        g["compute_shuffled_index"], lru_size=SLOTS_PER_EPOCH * 3)
+
+    g["get_total_active_balance"] = cache_this(
+        lambda state: (state.validators.hash_tree_root(), g["compute_epoch_at_slot"](state.slot)),
+        g["get_total_active_balance"], lru_size=10)
+
+    g["get_base_reward"] = cache_this(
+        lambda state, index: (state.validators.hash_tree_root(), state.slot, index),
+        g["get_base_reward"], lru_size=2048)
+
+    g["get_committee_count_per_slot"] = cache_this(
+        lambda state, epoch: (state.validators.hash_tree_root(), epoch),
+        g["get_committee_count_per_slot"], lru_size=SLOTS_PER_EPOCH * 3)
+
+    g["get_active_validator_indices"] = cache_this(
+        lambda state, epoch: (state.validators.hash_tree_root(), epoch),
+        g["get_active_validator_indices"], lru_size=3)
+
+    g["get_beacon_committee"] = cache_this(
+        lambda state, slot, index: (
+            state.validators.hash_tree_root(), state.randao_mixes.hash_tree_root(), slot, index),
+        g["get_beacon_committee"], lru_size=SLOTS_PER_EPOCH * MAX_COMMITTEES_PER_SLOT * 3)
+
+    g["get_matching_target_attestations"] = cache_this(
+        lambda state, epoch: (state.hash_tree_root(), epoch),
+        g["get_matching_target_attestations"], lru_size=10)
+
+    g["get_matching_head_attestations"] = cache_this(
+        lambda state, epoch: (state.hash_tree_root(), epoch),
+        g["get_matching_head_attestations"], lru_size=10)
+
+    g["get_attesting_indices"] = cache_this(
+        lambda state, data, bits: (
+            state.randao_mixes.hash_tree_root(),
+            state.validators.hash_tree_root(), data.hash_tree_root(), bits.hash_tree_root(),
+        ),
+        g["get_attesting_indices"], lru_size=SLOTS_PER_EPOCH * MAX_COMMITTEES_PER_SLOT * 3)
+
+
+def _install_optimizations(g: Dict[str, Any]) -> None:
+    """Semantics-preserving substitutions (the reference sanctions these
+    via implement_optimizations, setup.py:65-68).
+
+    ``compute_committee`` is replaced with a whole-permutation variant:
+    one vectorized pass produces every committee of the epoch instead of
+    2×rounds SHA-256 per member (differential test: tests/test_shuffle.py).
+    """
+    round_count = int(g["SHUFFLE_ROUND_COUNT"])
+    uint64_t = g["uint64"]
+
+    def compute_committee(indices, seed, index, count):
+        n = len(indices)
+        start = (n * index) // count
+        end = (n * uint64_t(index + 1)) // count
+        perm = compute_shuffle_permutation(bytes(seed), n, round_count)
+        return [indices[perm[i]] for i in range(start, end)]
+
+    compute_committee.__doc__ = g["compute_committee"].__doc__
+    compute_committee.__wrapped__ = g["compute_committee"]
+    g["compute_committee"] = compute_committee
+
+
+_lock = threading.Lock()
+_spec_cache: Dict[Tuple[str, str], ModuleType] = {}
+
+
+def build_spec(fork: str, preset_name: str, config=None, name: str = None) -> ModuleType:
+    """Build a fresh spec module (uncached). ``config`` may be a Config
+    override (used by the test framework's config-override machinery)."""
+    assert fork in FORK_ORDER, f"unknown fork {fork}"
+    preset = get_preset(preset_name)
+    cfg = config if config is not None else _typed_config(get_config(preset_name).to_dict())
+
+    mod_name = name or f"consensus_specs_tpu.specs.{fork}_{preset_name}"
+    mod = ModuleType(mod_name)
+    g = mod.__dict__
+    g.update(_base_env(preset, cfg))
+    g["fork"] = fork
+    g["preset_name"] = preset_name
+    # dataclasses (and pickling) resolve classes through sys.modules
+    sys.modules[mod_name] = mod
+
+    prev: Optional[ModuleType] = None
+    for f in FORK_ORDER:
+        if prev is not None:
+            # predecessor module available under its fork name for
+            # upgrade_to_* functions
+            g[prev.fork] = prev
+        src = (SRC_DIR / f"{f}.py").read_text()
+        # dont_inherit: this module's `from __future__ import annotations`
+        # must NOT leak into spec sources (containers need live types)
+        code = compile(src, str(SRC_DIR / f"{f}.py"), "exec", dont_inherit=True)
+        exec(code, g)
+        if f == fork:
+            break
+        # snapshot the intermediate fork as its own finished spec so
+        # upgrade functions see the *complete* predecessor
+        prev = get_spec(f, preset_name) if config is None else build_spec(f, preset_name, cfg)
+
+    _install_sundry(g)
+    _install_optimizations(g)
+    return mod
+
+
+def get_spec(fork: str, preset_name: str = "minimal") -> ModuleType:
+    """Cached spec module for fork×preset (reference: the 8-module
+    registry in test/context.py:73-86)."""
+    key = (fork, preset_name)
+    with _lock:
+        spec = _spec_cache.get(key)
+        if spec is None:
+            spec = build_spec(fork, preset_name)
+            _spec_cache[key] = spec
+    return spec

@@ -159,54 +159,87 @@ class uint(int, SSZType):
         return super().__new__(cls, value)
 
     # -- checked arithmetic (overflow/underflow -> ValueError) --
+    # Non-int operands return NotImplemented so Python falls back to the
+    # other operand's handler (e.g. list repetition `[x] * uint64(n)`).
+
     def __add__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) + int(o))
 
     __radd__ = __add__
 
     def __sub__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) - int(o))
 
     def __rsub__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(o) - int(self))
 
     def __mul__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) * int(o))
 
     __rmul__ = __mul__
 
     def __floordiv__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) // int(o))
 
     def __rfloordiv__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(o) // int(self))
 
     def __mod__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) % int(o))
 
     def __rmod__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(o) % int(self))
 
     def __pow__(self, o, mod=None):
+        if not isinstance(o, int):
+            return NotImplemented
+        if o < 0:
+            raise ValueError("negative exponent on checked uint")
         return type(self)(pow(int(self), int(o), mod))
 
     def __lshift__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) << int(o))
 
     def __rshift__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) >> int(o))
 
     def __and__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) & int(o))
 
     __rand__ = __and__
 
     def __or__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) | int(o))
 
     __ror__ = __or__
 
     def __xor__(self, o):
+        if not isinstance(o, int):
+            return NotImplemented
         return type(self)(int(self) ^ int(o))
 
     __rxor__ = __xor__
@@ -562,7 +595,14 @@ class _BitsBase(View):
         return self._bits[i]
 
     def __setitem__(self, i, v):
-        self._bits[i] = bool(v)
+        if isinstance(i, slice):
+            # length-preserving slice write (spec: justification bit rotation)
+            new = [bool(b) for b in v]
+            if len(self._bits[i]) != len(new):
+                raise ValueError("bit slice assignment must preserve length")
+            self._bits[i] = new
+        else:
+            self._bits[i] = bool(v)
         self._backing = None
         self._invalidate()
 
@@ -877,6 +917,19 @@ class Container(View):
         object.__setattr__(v, "_pkey", pkey)
         return v
 
+    def set_backing(self, node: Node) -> None:
+        """Swap this view's tree wholesale (state snapshot restore)."""
+        object.__setattr__(self, "_backing", node)
+        # detach handed-out child views: writes through them must not
+        # re-dirty fields that no longer exist in this view's cache
+        for child in self._cache.values():
+            if isinstance(child, View):
+                object.__setattr__(child, "_parent", None)
+                object.__setattr__(child, "_pkey", None)
+        self._cache.clear()
+        self._dirty.clear()
+        self._invalidate()
+
     @classmethod
     def _compute_layout_key(cls) -> tuple:
         return (
@@ -1044,6 +1097,15 @@ class _HomogeneousBase(View):
 
     def __contains__(self, item):
         return any(self[i] == item for i in range(self._length))
+
+    def count(self, item) -> int:
+        return sum(1 for i in range(self._length) if self[i] == item)
+
+    def index(self, item) -> int:
+        for i in range(self._length):
+            if self[i] == item:
+                return i
+        raise ValueError(f"{item!r} not in sequence")
 
     def _materialize_values(self):
         """Packed path: decode all chunks into a flat int list."""

@@ -1,0 +1,9 @@
+"""Test framework: decorator DSL, yield protocol, and helper library.
+
+Rebuild of the reference pyspec test framework (reference:
+tests/core/pyspec/eth2spec/test/) on top of this package's spec builder.
+The DSL surface is kept identical — @with_all_phases, @spec_state_test,
+@with_presets, @always_bls, ... — so test bodies read the same as the
+reference's and the same functions double as test-vector generators via
+``generator_mode=True`` (reference: test/utils/utils.py vector_test).
+"""

@@ -1,0 +1,3 @@
+class SkippedTest(Exception):
+    """Raised in generator mode instead of pytest.skip (reference:
+    eth2spec/test/exceptions.py)."""

@@ -1,0 +1,77 @@
+"""Yield protocol: dual-mode test functions.
+
+A spec test function yields named parts.  Under pytest the generator is
+drained (assertions still run); in generator mode each yield is
+type-annotated into ``(name, kind, value)`` with kind one of
+'meta' | 'ssz' | 'data' and SSZ views serialized — the contract the
+vector writers consume (reference: test/utils/utils.py:6-73).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from consensus_specs_tpu.ssz.impl import serialize
+from consensus_specs_tpu.ssz.types import View, boolean, uint
+
+
+def _is_ssz_value(v) -> bool:
+    return isinstance(v, (View, bytes)) or isinstance(v, (uint, boolean))
+
+
+def vector_test(description: str = None):
+    def runner(fn):
+        def entry(*args, **kw):
+            def generator_mode():
+                if description is not None:
+                    yield "description", "meta", description
+
+                for data in fn(*args, **kw):
+                    if len(data) != 2:
+                        # already fully annotated, e.g. ("bls_setting", "meta", 1)
+                        yield data
+                        continue
+                    (key, value) = data
+                    if value is None:
+                        continue
+                    if isinstance(value, View):
+                        yield key, "ssz", serialize(value)
+                    elif isinstance(value, bytes):
+                        yield key, "ssz", bytes(value)
+                    elif isinstance(value, list) and all(
+                        isinstance(el, (View, bytes)) for el in value
+                    ):
+                        for i, el in enumerate(value):
+                            yield f"{key}_{i}", "ssz", serialize(el) if isinstance(el, View) else bytes(el)
+                        yield f"{key}_count", "meta", len(value)
+                    else:
+                        yield key, "data", value
+
+            if kw.pop("generator_mode", False) is True:
+                return generator_mode()
+            # pytest mode: drain the generator so the body fully executes
+            for _ in fn(*args, **kw):
+                continue
+            return None
+
+        return entry
+
+    return runner
+
+
+def with_meta_tags(tags: Dict[str, Any]):
+    """Append meta tag parts when (and only when) the wrapped function
+    yielded anything (reference: test/utils/utils.py:76-95)."""
+
+    def runner(fn):
+        def entry(*args, **kw):
+            yielded_any = False
+            for part in fn(*args, **kw):
+                yield part
+                yielded_any = True
+            if yielded_any:
+                for k, v in tags.items():
+                    yield k, "meta", v
+
+        return entry
+
+    return runner

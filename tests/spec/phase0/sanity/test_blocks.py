@@ -1,0 +1,327 @@
+"""Block-processing sanity tests (reference: test/phase0/sanity/test_blocks.py,
+representative subset)."""
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import get_valid_attestation
+from consensus_specs_tpu.testing.helpers.block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+    sign_block,
+)
+from consensus_specs_tpu.testing.helpers.keys import privkeys, pubkeys
+from consensus_specs_tpu.testing.helpers.state import (
+    get_balance,
+    next_epoch,
+    next_slot,
+    state_transition_and_sign_block,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_prev_slot_block_transition(spec, state):
+    # Go to clean slot
+    spec.process_slots(state, state.slot + 1)
+    # Make a block for it
+    block = build_empty_block(spec, state, slot=state.slot)
+    proposer_index = spec.get_beacon_proposer_index(state)
+    # Transition to next slot, above block will not be invalid on top of new state.
+    spec.process_slots(state, state.slot + 1)
+
+    yield "pre", state
+    # State is beyond block slot, but the block can still be realistic when invalid.
+    # Try the transition, and update the state root to where it is halted. Then sign with the supposed proposer.
+    expect_assertion_error(lambda: spec.process_block(state, block))
+    block.state_root = state.hash_tree_root()
+    signed_block = sign_block(spec, state, block, proposer_index=proposer_index)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_same_slot_block_transition(spec, state):
+    # Same slot on top of pre-state, but move out of slot 0 first.
+    spec.process_slots(state, state.slot + 1)
+
+    block = build_empty_block(spec, state, slot=state.slot)
+
+    yield "pre", state
+
+    assert state.slot == block.slot
+
+    spec.process_block(state, block)
+    block.state_root = state.hash_tree_root()
+
+    signed_block = sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_block_transition(spec, state):
+    pre_slot = state.slot
+    pre_eth1_votes = len(state.eth1_data_votes)
+    pre_mix = spec.get_randao_mix(state, spec.get_current_epoch(state))
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert len(state.eth1_data_votes) == pre_eth1_votes + 1
+    assert spec.get_block_root_at_slot(state, pre_slot) == block.parent_root
+    assert spec.get_randao_mix(state, spec.get_current_epoch(state)) != pre_mix
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_state_root(spec, state):
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.state_root = b"\xaa" * 32
+    signed_block = sign_block(spec, state, block)
+
+    expect_assertion_error(
+        lambda: spec.state_transition(state, signed_block, validate_result=True))
+
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_zero_block_sig(spec, state):
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    invalid_signed_block = spec.SignedBeaconBlock(message=block)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, invalid_signed_block))
+
+    yield "blocks", [invalid_signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_block_sig(spec, state):
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot))
+    signing_root = spec.compute_signing_root(block, domain)
+    invalid_signed_block = spec.SignedBeaconBlock(
+        message=block,
+        signature=spec.bls.Sign(123456, signing_root),
+    )
+    expect_assertion_error(
+        lambda: spec.state_transition(state, invalid_signed_block))
+
+    yield "blocks", [invalid_signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_proposer_index_sig_from_expected_proposer(spec, state):
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    expect_proposer_index = block.proposer_index
+
+    # Set invalid proposer index but correct signature wrt expected proposer
+    active_indices = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    active_indices = [i for i in active_indices if i != block.proposer_index]
+    block.proposer_index = active_indices[0]  # invalid proposer index
+
+    invalid_signed_block = sign_block(spec, state, block, expect_proposer_index)
+
+    expect_assertion_error(
+        lambda: spec.state_transition(state, invalid_signed_block))
+
+    yield "blocks", [invalid_signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_skipped_slots(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+
+    block = build_empty_block(spec, state, state.slot + 4)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.slot == block.slot
+    assert spec.get_randao_mix(state, spec.get_current_epoch(state)) != spec.Bytes32()
+    for slot in range(pre_slot, state.slot):
+        assert spec.get_block_root_at_slot(state, slot) == block.parent_root
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch_transition(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+
+    block = build_empty_block(spec, state, state.slot + spec.SLOTS_PER_EPOCH)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.slot == block.slot
+    for slot in range(pre_slot, state.slot):
+        assert spec.get_block_root_at_slot(state, slot) == block.parent_root
+
+
+@with_all_phases
+@spec_state_test
+def test_high_proposer_index(spec, state):
+    # disable a good amount of validators to make the active count lower, for a faster test
+    current_epoch = spec.get_current_epoch(state)
+    for i in range(len(state.validators) // 3):
+        state.validators[i].exit_epoch = current_epoch
+
+    # skip forward, get brand new proposers
+    state.slot = spec.SLOTS_PER_EPOCH * 2
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+
+    active_count = len(spec.get_active_validator_indices(state, current_epoch))
+    while True:
+        proposer_index = spec.get_beacon_proposer_index(state)
+        if proposer_index >= active_count:
+            # found a proposer that has a higher index than the active validator count
+            yield "pre", state
+            # test if the proposer can be recognized correctly, even while it has a high index
+            signed_block = state_transition_and_sign_block(
+                spec, state, build_empty_block_for_next_slot(spec, state))
+            yield "blocks", [signed_block]
+            yield "post", state
+            break
+        next_slot(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation(spec, state):
+    next_epoch(spec, state)
+
+    yield "pre", state
+
+    attestation_block = build_empty_block(
+        spec, state, state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+
+    index = 0
+    attestation = get_valid_attestation(spec, state, index=index, signed=True)
+
+    # Add to state via block transition
+    pre_current_attestations_len = (
+        len(state.current_epoch_attestations) if spec.fork == "phase0" else None
+    )
+    attestation_block.body.attestations.append(attestation)
+    signed_attestation_block = state_transition_and_sign_block(spec, state, attestation_block)
+
+    if spec.fork == "phase0":
+        assert len(state.current_epoch_attestations) == pre_current_attestations_len + 1
+        # Epoch transition should move to previous_epoch_attestations
+        pre_current_attestations_root = spec.hash_tree_root(state.current_epoch_attestations)
+    else:
+        pre_current_epoch_participation_root = spec.hash_tree_root(state.current_epoch_participation)
+
+    epoch_block = build_empty_block(spec, state, state.slot + spec.SLOTS_PER_EPOCH)
+    signed_epoch_block = state_transition_and_sign_block(spec, state, epoch_block)
+
+    yield "blocks", [signed_attestation_block, signed_epoch_block]
+    yield "post", state
+
+    if spec.fork == "phase0":
+        assert len(state.current_epoch_attestations) == 0
+        assert spec.hash_tree_root(state.previous_epoch_attestations) == pre_current_attestations_root
+    else:
+        for index in range(len(state.validators)):
+            assert state.current_epoch_participation[index] == spec.ParticipationFlags(0b0000_0000)
+        assert spec.hash_tree_root(state.previous_epoch_participation) == pre_current_epoch_participation_root
+
+
+@with_all_phases
+@spec_state_test
+def test_balance_driven_status_transitions(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[-1]
+
+    assert state.validators[validator_index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+    # set validator balance to below ejection threshold
+    state.validators[validator_index].effective_balance = spec.config.EJECTION_BALANCE
+
+    yield "pre", state
+
+    # trigger epoch transition
+    block = build_empty_block(spec, state, state.slot + spec.SLOTS_PER_EPOCH)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.validators[validator_index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_votes_consensus(spec, state):
+    voting_period_slots = spec.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.SLOTS_PER_EPOCH
+
+    offset_block = build_empty_block(spec, state, slot=voting_period_slots - 1)
+    state_transition_and_sign_block(spec, state, offset_block)
+    yield "pre", state
+
+    a = b"\xaa" * 32
+    b = b"\xbb" * 32
+    c = b"\xcc" * 32
+
+    blocks = []
+
+    for i in range(0, voting_period_slots):
+        block = build_empty_block_for_next_slot(spec, state)
+        # wait for over 50% for A, then start voting B
+        block.body.eth1_data.block_hash = b if i * 2 > voting_period_slots else a
+        signed_block = state_transition_and_sign_block(spec, state, block)
+        blocks.append(signed_block)
+
+    assert len(state.eth1_data_votes) == voting_period_slots
+    assert state.eth1_data.block_hash == a
+
+    # transition to next eth1 voting period
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.eth1_data.block_hash = c
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    blocks.append(signed_block)
+
+    yield "blocks", blocks
+    yield "post", state
+
+    assert state.eth1_data.block_hash == a
+    assert state.slot % voting_period_slots == 0
+    assert len(state.eth1_data_votes) == 1
+    assert state.eth1_data_votes[0].block_hash == c

@@ -1,0 +1,81 @@
+"""Slot-advance sanity tests (reference: test/phase0/sanity/test_slots.py)."""
+from consensus_specs_tpu.testing.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.testing.helpers.state import get_state_root, next_epoch, next_slot
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_1(spec, state):
+    pre_slot = state.slot
+    pre_root = state.hash_tree_root()
+    yield "pre", state
+
+    slots = 1
+    yield "slots", "meta", int(slots)
+    spec.process_slots(state, state.slot + slots)
+
+    yield "post", state
+    assert state.slot == pre_slot + 1
+    assert get_state_root(spec, state, pre_slot) == pre_root
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_2(spec, state):
+    yield "pre", state
+    slots = 2
+    yield "slots", "meta", int(slots)
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+    slots = spec.SLOTS_PER_EPOCH
+    yield "slots", "meta", int(slots)
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+    assert state.slot == pre_slot + spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_double_empty_epoch(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+    slots = spec.SLOTS_PER_EPOCH * 2
+    yield "slots", "meta", int(slots)
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+    assert state.slot == pre_slot + slots
+
+
+@with_all_phases
+@spec_state_test
+def test_over_epoch_boundary(spec, state):
+    if spec.SLOTS_PER_EPOCH > 1:
+        spec.process_slots(state, state.slot + spec.SLOTS_PER_EPOCH // 2)
+    pre_slot = state.slot
+    yield "pre", state
+    slots = spec.SLOTS_PER_EPOCH
+    yield "slots", "meta", int(slots)
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+    assert state.slot == pre_slot + slots
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_accumulator(spec, state):
+    pre_historical_roots = state.historical_roots.copy()
+
+    yield "pre", state
+    slots = spec.SLOTS_PER_HISTORICAL_ROOT
+    yield "slots", "meta", int(slots)
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+
+    assert len(state.historical_roots) == len(pre_historical_roots) + 1
