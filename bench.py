@@ -1,0 +1,121 @@
+"""Benchmark: vectorized epoch rewards pass at mainnet scale (400k validators).
+
+Flagship kernel = phase0 ``get_attestation_deltas`` + balance update
+(the per-epoch hot loop, SURVEY §3.2 / BASELINE config ★).  The
+reference's executable spec computes this with sequential Python loops;
+the baseline twin below reproduces exactly that per-validator arithmetic
+(python ints, one loop) and is timed on the same machine, then scaled
+linearly to 400k validators (the sequential pass is O(n); the
+reference's real code path is strictly slower — O(n × attestations)
+committee recomputation on top).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline = sequential-python time / this-framework time (higher is better).
+"""
+import json
+import time
+
+import numpy as np
+
+N_VALIDATORS = 400_000
+BASELINE_SAMPLE = 16_384
+
+
+def _python_baseline(inp, balances, n):
+    """Sequential per-validator twin of get_attestation_deltas + update."""
+    eff = [int(x) for x in inp.effective_balance[:n]]
+    eligible = [bool(x) for x in inp.eligible[:n]]
+    src = [bool(x) for x in inp.source_part[:n]]
+    tgt = [bool(x) for x in inp.target_part[:n]]
+    head = [bool(x) for x in inp.head_part[:n]]
+    delay = [int(x) for x in inp.incl_delay[:n]]
+    proposer = [int(x) % n for x in inp.incl_proposer[:n]]
+    bals = [int(x) for x in balances[:n]]
+
+    ebi = inp.effective_balance_increment
+    total = inp.total_balance
+    sqrt_total = inp.sqrt_total
+    leak = inp.finality_delay > inp.min_epochs_to_inactivity_penalty
+
+    t0 = time.perf_counter()
+    att_bal = [
+        max(ebi, sum(e for e, p in zip(eff, part) if p))
+        for part in (src, tgt, head)
+    ]
+    rewards = [0] * n
+    penalties = [0] * n
+    for i in range(n):
+        base = eff[i] * inp.base_reward_factor // sqrt_total // inp.base_rewards_per_epoch
+        prop_r = base // inp.proposer_reward_quotient
+        for k, part in enumerate((src, tgt, head)):
+            if eligible[i]:
+                if part[i]:
+                    if leak:
+                        rewards[i] += base
+                    else:
+                        rewards[i] += base * (att_bal[k] // ebi) // (total // ebi)
+                else:
+                    penalties[i] += base
+        if src[i]:
+            rewards[i] += (base - prop_r) // delay[i]
+            rewards[proposer[i]] += prop_r
+        if leak and eligible[i]:
+            penalties[i] += inp.base_rewards_per_epoch * base - prop_r
+            if not tgt[i]:
+                penalties[i] += eff[i] * inp.finality_delay // inp.inactivity_penalty_quotient
+    for i in range(n):
+        b = bals[i] + rewards[i]
+        bals[i] = 0 if penalties[i] > b else b - penalties[i]
+    return time.perf_counter() - t0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("graft", "__graft_entry__.py")
+    graft = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(graft)
+
+    from consensus_specs_tpu.ops.epoch_jax import epoch_step
+
+    inp, balances = graft._example_inputs(N_VALIDATORS)
+    args = (
+        jnp.asarray(balances),
+        jnp.asarray(inp.effective_balance),
+        jnp.asarray(inp.eligible),
+        jnp.asarray(inp.source_part),
+        jnp.asarray(inp.target_part),
+        jnp.asarray(inp.head_part),
+        jnp.asarray(inp.incl_delay),
+        jnp.asarray(inp.incl_proposer),
+        jnp.asarray(graft._scalars(inp)),
+    )
+
+    step = jax.jit(epoch_step)
+    out = step(*args)
+    out.block_until_ready()  # compile + warm
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(*args)
+    out.block_until_ready()
+    device_time = (time.perf_counter() - t0) / iters
+
+    base_time = _python_baseline(inp, balances, BASELINE_SAMPLE)
+    base_scaled = base_time * (N_VALIDATORS / BASELINE_SAMPLE)
+
+    print(json.dumps({
+        "metric": "phase0_epoch_rewards_pass_400k_validators",
+        "value": round(device_time * 1000, 3),
+        "unit": "ms",
+        "vs_baseline": round(base_scaled / device_time, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

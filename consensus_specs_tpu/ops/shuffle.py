@@ -57,5 +57,6 @@ def compute_shuffle_permutation(seed: bytes, index_count: int, round_count: int)
         m = np.where(bits.astype(bool), flip, m)
     if len(_cache) >= _CACHE_MAX:
         _cache.pop(next(iter(_cache)))
+    m.setflags(write=False)  # shared across callers; mutation would corrupt committees
     _cache[key] = m
     return m

@@ -1,0 +1,13 @@
+"""Distributed execution: device meshes, sharded kernels, collectives.
+
+The reference has no intra-process parallelism (SURVEY §2.7) — its
+networking layer is wire-format documentation only.  Here the
+data-parallel axes the protocol actually exposes (validator registry,
+pubkey sets, merkle chunk lanes) are sharded over a
+``jax.sharding.Mesh`` with XLA collectives (psum / all_gather) riding
+ICI; multi-host scale-out uses the same code over a DCN-spanning mesh
+via ``jax.distributed``.
+"""
+from .mesh import build_mesh, default_mesh
+
+__all__ = ["build_mesh", "default_mesh"]

@@ -1,0 +1,30 @@
+"""Mesh construction helpers.
+
+One logical axis ``"v"`` (validator / lane axis) is enough for the
+protocol's compute: every hot kernel is data-parallel over validators or
+chunk lanes with only scalar reductions crossing shards.  A second axis
+can be layered for multi-host (DCN) topologies, keeping reductions
+within a host's ICI domain first.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def build_mesh(n_devices: Optional[int] = None, axis: str = "v",
+               devices: Optional[Sequence] = None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        assert len(devices) >= n_devices, (
+            f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def default_mesh() -> Mesh:
+    return build_mesh()

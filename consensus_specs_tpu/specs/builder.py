@@ -175,8 +175,8 @@ def _base_env(preset: Dict[str, int], config) -> Dict[str, Any]:
 
 
 class LRUDict:
-    """Small insertion-ordered LRU (stand-in for the reference's lru-dict
-    C extension, setup.py:333)."""
+    """Small LRU dict (stand-in for the reference's lru-dict C extension,
+    setup.py:333).  Accesses refresh recency via move-to-end."""
 
     __slots__ = ("size", "d")
 
@@ -185,17 +185,21 @@ class LRUDict:
         self.d: Dict[Any, Any] = {}
 
     def get(self, key, default=None):
-        return self.d.get(key, default)
+        if key in self.d:
+            return self[key]
+        return default
 
     def __contains__(self, key):
         return key in self.d
 
     def __getitem__(self, key):
-        return self.d[key]
+        value = self.d.pop(key)
+        self.d[key] = value  # re-insert at the recent end
+        return value
 
     def __setitem__(self, key, value):
-        if len(self.d) >= self.size:
-            self.d.pop(next(iter(self.d)))
+        if key not in self.d and len(self.d) >= self.size:
+            self.d.pop(next(iter(self.d)))  # evict least-recent
         self.d[key] = value
 
 

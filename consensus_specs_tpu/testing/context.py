@@ -142,7 +142,13 @@ def with_custom_state(balances_fn: Callable[[Any], Sequence[int]],
                       threshold_fn: Callable[[Any], int]):
     def deco(fn):
         def entry(*args, spec, phases, **kw):
-            key = (spec.fork, spec.preset_name, id(spec.config), balances_fn, threshold_fn)
+            # key on config *content* so override-specs don't collide or
+            # miss (object ids are recyclable)
+            cfg_key = tuple(sorted(
+                (k, bytes(v) if isinstance(v, bytes) else v)
+                for k, v in spec.config.to_dict().items()
+            ))
+            key = (spec.fork, spec.preset_name, cfg_key, balances_fn, threshold_fn)
             if key not in _custom_state_cache:
                 state = create_genesis_state(
                     spec=spec,

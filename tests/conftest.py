@@ -11,12 +11,20 @@ CLI flags mirror the reference:
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient env points at a TPU (e.g. the axon
+# tunnel) — unit tests must run on the virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Some TPU platform plugins override JAX_PLATFORMS via jax config at
+# import; pin the config itself so tests always see the CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_addoption(parser):

@@ -1,0 +1,70 @@
+"""Differential test: JAX attestation-deltas kernel vs the sequential spec."""
+import numpy as np
+
+from consensus_specs_tpu.ops.epoch_jax import attestation_deltas_for_state
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.helpers.attestations import (
+    prepare_state_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+def _assert_deltas_match(spec, state):
+    spec_rewards, spec_penalties = spec.get_attestation_deltas(state)
+    k_rewards, k_penalties = attestation_deltas_for_state(spec, state)
+    assert [int(x) for x in spec_rewards] == k_rewards.tolist()
+    assert [int(x) for x in spec_penalties] == k_penalties.tolist()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_deltas_kernel_full_participation(spec, state):
+    prepare_state_with_attestations(spec, state)
+    _assert_deltas_match(spec, state)
+    yield from ()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_deltas_kernel_partial_participation(spec, state):
+    prepare_state_with_attestations(
+        spec, state,
+        participation_fn=lambda slot, index, comm: set(list(comm)[: len(comm) // 2]),
+    )
+    _assert_deltas_match(spec, state)
+    yield from ()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_deltas_kernel_empty_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    _assert_deltas_match(spec, state)
+    yield from ()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_deltas_kernel_inactivity_leak(spec, state):
+    # skip enough epochs with no finality to enter the leak
+    for _ in range(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 3):
+        next_epoch(spec, state)
+    prepare_state_with_attestations(
+        spec, state,
+        participation_fn=lambda slot, index, comm: set(list(comm)[: len(comm) // 3]),
+    )
+    assert spec.is_in_inactivity_leak(state)
+    _assert_deltas_match(spec, state)
+    yield from ()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_deltas_kernel_with_slashed_validators(spec, state):
+    prepare_state_with_attestations(spec, state)
+    # slash a few attesters directly
+    for index in (0, 3, 7):
+        state.validators[index].slashed = True
+    _assert_deltas_match(spec, state)
+    yield from ()

@@ -1,0 +1,81 @@
+"""Sharded epoch step vs single-device kernel (8-device virtual CPU mesh)."""
+import hashlib
+
+import numpy as np
+
+from consensus_specs_tpu.ops.epoch_jax import DeltaInputs, attestation_deltas
+from consensus_specs_tpu.parallel import build_mesh
+from consensus_specs_tpu.parallel.epoch_sharded import (
+    make_sharded_epoch_step,
+    shard_delta_inputs,
+)
+
+
+def _random_inputs(n, seed=7):
+    rng = np.random.default_rng(seed)
+    eff = (rng.integers(16, 33, n) * 10**9).astype(np.int64)
+    eligible = rng.random(n) < 0.95
+    src = (rng.random(n) < 0.8) & eligible
+    tgt = src & (rng.random(n) < 0.9)
+    head = tgt & (rng.random(n) < 0.9)
+    delay = np.where(src, rng.integers(1, 9, n), 1).astype(np.int64)
+    proposer = rng.integers(0, n, n).astype(np.int64)
+    total = int(np.sum(np.where(eligible, eff, 0)))
+    return DeltaInputs(
+        effective_balance=eff,
+        eligible=eligible,
+        source_part=src,
+        target_part=tgt,
+        head_part=head,
+        incl_delay=delay,
+        incl_proposer=proposer,
+        total_balance=total,
+        sqrt_total=int(np.sqrt(total)),
+        finality_delay=2,
+        base_reward_factor=64,
+        base_rewards_per_epoch=4,
+        proposer_reward_quotient=8,
+        inactivity_penalty_quotient=2**26,
+        min_epochs_to_inactivity_penalty=4,
+        effective_balance_increment=10**9,
+    )
+
+
+def test_sharded_step_matches_single_device():
+    n = 1024  # multiple of 8*8 so no padding ambiguity
+    inp = _random_inputs(n)
+    balances = (np.random.default_rng(3).integers(16, 33, n) * 10**9).astype(np.int64)
+
+    rewards, penalties = attestation_deltas(inp)
+    expected = balances + rewards
+    expected = np.where(penalties > expected, 0, expected - penalties)
+
+    mesh = build_mesh(8)
+    step = make_sharded_epoch_step(mesh)
+    args, n_orig = shard_delta_inputs(mesh, inp, balances)
+    new_balances, digests = step(*args)
+
+    assert np.array_equal(np.asarray(new_balances)[:n_orig], expected)
+
+    # digests: each 64-byte block = 8 consecutive uint64 balances (LE)
+    nb = np.asarray(new_balances)
+    raw = nb.astype("<u8").tobytes()
+    expected_digest0 = hashlib.sha256(raw[:64]).digest()
+    got = np.asarray(digests)[:8].astype(">u4").tobytes()
+    assert got == expected_digest0
+
+
+def test_sharded_step_leak_mode():
+    n = 512
+    inp = _random_inputs(n, seed=11)._replace(finality_delay=9)
+    balances = np.full(n, 32 * 10**9, dtype=np.int64)
+
+    rewards, penalties = attestation_deltas(inp)
+    expected = balances + rewards
+    expected = np.where(penalties > expected, 0, expected - penalties)
+
+    mesh = build_mesh(8)
+    step = make_sharded_epoch_step(mesh)
+    args, n_orig = shard_delta_inputs(mesh, inp, balances)
+    new_balances, _ = step(*args)
+    assert np.array_equal(np.asarray(new_balances)[:n_orig], expected)
