@@ -33,6 +33,7 @@ from typing import (
     Dict,
     NamedTuple,
     Optional,
+    Protocol,
     Sequence,
     Set,
     Tuple,
@@ -46,6 +47,7 @@ from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.ops.shuffle import compute_shuffle_permutation
 from consensus_specs_tpu.ssz import hashing
 from consensus_specs_tpu.ssz import types as ssz_types
+from consensus_specs_tpu.ssz.gindex import get_generalized_index
 from consensus_specs_tpu.ssz.impl import copy, hash_tree_root, serialize, uint_to_bytes
 from consensus_specs_tpu.ssz.types import (
     Bitlist,
@@ -132,6 +134,7 @@ def _base_env(preset: Dict[str, int], config) -> Dict[str, Any]:
         "Tuple": Tuple,
         "Optional": Optional,
         "NamedTuple": NamedTuple,
+        "Protocol": Protocol,
         "TypeVar": TypeVar,
         "dataclass": dataclass,
         "field": field,
@@ -166,6 +169,10 @@ def _base_env(preset: Dict[str, int], config) -> Dict[str, Any]:
         "copy": copy,
         "uint_to_bytes": uint_to_bytes,
         "config": config,
+        # merkle-proof machinery (altair light client, merkle-proofs.md)
+        "GeneralizedIndex": int,
+        "get_generalized_index": get_generalized_index,
+        "floorlog2": lambda x: uint64(int(x).bit_length() - 1),
     }
     # preset vars become module constants, typed uint64 (setup.py emits
     # them as typed constants the same way)
@@ -289,7 +296,8 @@ def _install_optimizations(g: Dict[str, Any]) -> None:
     g["compute_committee"] = compute_committee
 
 
-_lock = threading.Lock()
+# RLock: building fork F recursively resolves its predecessor via get_spec
+_lock = threading.RLock()
 _spec_cache: Dict[Tuple[str, str], ModuleType] = {}
 
 

@@ -128,8 +128,10 @@ class View(SSZType):
 
     def __eq__(self, other):
         if isinstance(other, View):
+            # layout (not type identity): fork-layered spec modules each
+            # define their own classes, but identical layouts compare equal
             return (
-                type(self) is type(other)
+                type(self)._layout_key() == type(other)._layout_key()
                 and self.hash_tree_root() == other.hash_tree_root()
             )
         return NotImplemented
@@ -611,7 +613,7 @@ class _BitsBase(View):
 
     def __eq__(self, other):
         if isinstance(other, _BitsBase):
-            return type(self) is type(other) and self._bits == other._bits
+            return type(self)._layout_key() == type(other)._layout_key() and self._bits == other._bits
         if isinstance(other, (list, tuple)):
             return self._bits == [bool(b) for b in other]
         return NotImplemented
@@ -942,8 +944,30 @@ class Container(View):
 
     @classmethod
     def coerce_for_store(cls, value, parent=None, pkey=None):
-        if isinstance(value, Container) and value._layout_key() == cls._layout_key():
-            return cls.view_from_backing(value.get_backing(), parent, pkey)
+        if isinstance(value, Container):
+            if value._layout_key() == cls._layout_key():
+                return cls.view_from_backing(value.get_backing(), parent, pkey)
+            # fork-extension reinterpretation (e.g. a bellatrix
+            # ExecutionPayloadHeader stored into capella's, fork.md
+            # upgrades): when the source's (name, layout) field list is a
+            # strict prefix of the target's, rebuild the backing from the
+            # source's field subtrees plus proper *default nodes* for the
+            # appended fields — structurally correct for composite
+            # additions, root-identical to zero-padding for basic ones.
+            src = type(value)
+            n_src = len(src._field_types)
+            if n_src <= len(cls._field_types) and all(
+                na == nb and ta._layout_key() == tb._layout_key()
+                for (na, ta), (nb, tb) in zip(
+                    zip(src._field_names, src._field_types),
+                    zip(cls._field_names, cls._field_types),
+                )
+            ):
+                backing = value.get_backing()
+                nodes = [get_subtree(backing, src._depth, i) for i in range(n_src)]
+                nodes += [t.default_node() for t in cls._field_types[n_src:]]
+                rebuilt = subtree_fill_to_contents(nodes, cls._depth)
+                return cls.view_from_backing(rebuilt, parent, pkey)
         raise TypeError(f"cannot store {type(value).__name__} as {cls.__name__}")
 
     def encode_bytes(self) -> bytes:
@@ -1182,7 +1206,7 @@ class _HomogeneousBase(View):
     def __eq__(self, other):
         if isinstance(other, View):
             return (
-                type(self) is type(other)
+                type(self)._layout_key() == type(other)._layout_key()
                 and self.hash_tree_root() == other.hash_tree_root()
             )
         if isinstance(other, (list, tuple)):
@@ -1550,7 +1574,7 @@ class Union(View):
     def __eq__(self, other):
         if isinstance(other, Union):
             return (
-                type(self) is type(other)
+                type(self)._layout_key() == type(other)._layout_key()
                 and self._selector == other._selector
                 and self._value == other._value
             )

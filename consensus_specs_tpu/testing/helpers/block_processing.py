@@ -1,0 +1,60 @@
+"""Partial block-processing runner (reference: test/helpers/block_processing.py)."""
+from __future__ import annotations
+
+
+def for_ops(state, operations, fn) -> None:
+    for operation in operations:
+        fn(state, operation)
+
+
+def get_process_calls(spec):
+    return {
+        # PHASE0
+        "process_block_header":
+            lambda state, block: spec.process_block_header(state, block),
+        "process_randao":
+            lambda state, block: spec.process_randao(state, block.body),
+        "process_eth1_data":
+            lambda state, block: spec.process_eth1_data(state, block.body),
+        "process_proposer_slashing":
+            lambda state, block: for_ops(state, block.body.proposer_slashings, spec.process_proposer_slashing),
+        "process_attester_slashing":
+            lambda state, block: for_ops(state, block.body.attester_slashings, spec.process_attester_slashing),
+        "process_shard_header":
+            lambda state, block: for_ops(state, block.body.shard_headers, spec.process_shard_header),
+        "process_attestation":
+            lambda state, block: for_ops(state, block.body.attestations, spec.process_attestation),
+        "process_deposit":
+            lambda state, block: for_ops(state, block.body.deposits, spec.process_deposit),
+        "process_voluntary_exit":
+            lambda state, block: for_ops(state, block.body.voluntary_exits, spec.process_voluntary_exit),
+        # Altair
+        "process_sync_aggregate":
+            lambda state, block: spec.process_sync_aggregate(state, block.body.sync_aggregate),
+        # Bellatrix
+        "process_execution_payload":
+            lambda state, block: spec.process_execution_payload(
+                state, block.body.execution_payload, spec.EXECUTION_ENGINE),
+        # Capella
+        "process_withdrawals":
+            lambda state, block: spec.process_withdrawals(state, block.body.execution_payload),
+        "process_bls_to_execution_change":
+            lambda state, block: for_ops(
+                state, block.body.bls_to_execution_changes, spec.process_bls_to_execution_change),
+    }
+
+
+def run_block_processing_to(spec, state, block, process_name: str):
+    """
+    Processes up to, but not including, the sub-transition ``process_name``.
+    Returns a Callable[[state, block], None] for that remaining transition.
+    """
+    if state.slot < block.slot:
+        spec.process_slots(state, block.slot)
+
+    for name, call in get_process_calls(spec).items():
+        if name == process_name:
+            return call
+        # only run when present; later forks add more block processing
+        if hasattr(spec, name):
+            call(state, block)

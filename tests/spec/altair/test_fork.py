@@ -1,0 +1,58 @@
+"""Altair fork-upgrade tests (reference: test/altair/fork/test_altair_fork_basic.py)."""
+from consensus_specs_tpu.testing.context import (
+    low_balances,
+    misc_balances,
+    spec_test,
+    with_custom_state,
+    with_phases,
+    with_state,
+)
+from consensus_specs_tpu.testing.helpers.altair.fork import (
+    ALTAIR_FORK_TEST_META_TAGS,
+    run_fork_test,
+)
+from consensus_specs_tpu.testing.helpers.constants import ALTAIR, PHASE0
+from consensus_specs_tpu.testing.helpers.state import next_epoch, next_epoch_via_block
+from consensus_specs_tpu.testing.utils import with_meta_tags
+
+
+@with_phases(phases=[PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_state
+@with_meta_tags(ALTAIR_FORK_TEST_META_TAGS)
+def test_fork_base_state(spec, phases, state):
+    yield from run_fork_test(phases[ALTAIR], state)
+
+
+@with_phases(phases=[PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_state
+@with_meta_tags(ALTAIR_FORK_TEST_META_TAGS)
+def test_fork_next_epoch(spec, phases, state):
+    next_epoch(spec, state)
+    yield from run_fork_test(phases[ALTAIR], state)
+
+
+@with_phases(phases=[PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_state
+@with_meta_tags(ALTAIR_FORK_TEST_META_TAGS)
+def test_fork_next_epoch_with_block(spec, phases, state):
+    next_epoch_via_block(spec, state)
+    yield from run_fork_test(phases[ALTAIR], state)
+
+
+@with_phases(phases=[PHASE0], other_phases=[ALTAIR])
+@with_custom_state(balances_fn=low_balances, threshold_fn=lambda spec: spec.config.EJECTION_BALANCE)
+@spec_test
+@with_meta_tags(ALTAIR_FORK_TEST_META_TAGS)
+def test_fork_random_low_balances(spec, phases, state):
+    yield from run_fork_test(phases[ALTAIR], state)
+
+
+@with_phases(phases=[PHASE0], other_phases=[ALTAIR])
+@with_custom_state(balances_fn=misc_balances, threshold_fn=lambda spec: spec.config.EJECTION_BALANCE)
+@spec_test
+@with_meta_tags(ALTAIR_FORK_TEST_META_TAGS)
+def test_fork_random_misc_balances(spec, phases, state):
+    yield from run_fork_test(phases[ALTAIR], state)

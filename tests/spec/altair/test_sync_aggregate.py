@@ -1,0 +1,143 @@
+"""Sync aggregate processing tests (reference:
+test/altair/block_processing/sync_aggregate/test_process_sync_aggregate.py,
+representative subset)."""
+import random
+
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    spec_state_test,
+    with_altair_and_later,
+)
+from consensus_specs_tpu.testing.helpers.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.testing.helpers.state import transition_to
+from consensus_specs_tpu.testing.helpers.sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+    run_successful_sync_committee_test,
+    run_sync_committee_processing,
+)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_bad_domain(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec,
+            state,
+            block.slot - 1,
+            committee_indices,  # full committee signs
+            block_root=block.parent_root,
+            domain_type=spec.DOMAIN_BEACON_ATTESTER,  # Incorrect domain
+        ),
+    )
+    yield from run_sync_committee_processing(spec, state, block, expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_missing_participant(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    rng = random.Random(2020)
+    random_participant = rng.choice(committee_indices)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    # Exclude one participant whose signature was included.
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[index != random_participant for index in committee_indices],
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec,
+            state,
+            block.slot - 1,
+            committee_indices,  # full committee signs
+            block_root=block.parent_root,
+        ),
+    )
+    yield from run_sync_committee_processing(spec, state, block, expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_extra_participant(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    rng = random.Random(3030)
+    random_participant = rng.choice(committee_indices)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    # Exclude one signature even though the block claims the entire committee participated.
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec,
+            state,
+            block.slot - 1,
+            [index for index in committee_indices if index != random_participant],
+            block_root=block.parent_root,
+        ),
+    )
+    yield from run_sync_committee_processing(spec, state, block, expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_sync_committee_rewards_empty_participants(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    committee_bits = [False] * len(committee_indices)
+
+    yield from run_successful_sync_committee_test(spec, state, committee_indices, committee_bits)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_sync_committee_rewards_not_full_participants(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    rng = random.Random(1010)
+    committee_bits = [rng.choice([True, False]) for _ in committee_indices]
+
+    yield from run_successful_sync_committee_test(spec, state, committee_indices, committee_bits)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_sync_committee_rewards_nonduplicate_committee(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    committee_bits = [True] * len(committee_indices)
+
+    yield from run_successful_sync_committee_test(spec, state, committee_indices, committee_bits)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_proposer_in_committee_without_participation(spec, state):
+    state.slot = state.slot + 1  # skip one slot to roll proposers
+
+    # find a slot where the proposer is in the sync committee
+    committee_indices = compute_committee_indices(spec, state)
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        block = build_empty_block_for_next_slot(spec, state)
+        proposer_index = block.proposer_index
+        if proposer_index in committee_indices:
+            committee_bits = [index != proposer_index for index in committee_indices]
+            participants = [index for index in committee_indices if index != proposer_index]
+            block.body.sync_aggregate = spec.SyncAggregate(
+                sync_committee_bits=committee_bits,
+                sync_committee_signature=compute_aggregate_sync_committee_signature(
+                    spec, state, block.slot - 1, participants, block_root=block.parent_root,
+                ),
+            )
+            yield from run_sync_committee_processing(spec, state, block)
+            return
+        else:
+            transition_to(spec, state, state.slot + 1)
+    raise AssertionError("no proposer in committee found within an epoch")

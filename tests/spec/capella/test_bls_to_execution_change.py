@@ -1,0 +1,71 @@
+"""BLSToExecutionChange operation tests (reference: test/capella/block_processing)."""
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_capella_and_later,
+)
+from consensus_specs_tpu.testing.helpers.keys import pubkeys, pubkey_to_privkey
+
+
+def _signed_address_change(spec, state, validator_index):
+    withdrawal_pubkey = pubkeys[-1 - int(validator_index)]
+    privkey = pubkey_to_privkey[withdrawal_pubkey]
+    address_change = spec.BLSToExecutionChange(
+        validator_index=validator_index,
+        from_bls_pubkey=withdrawal_pubkey,
+        to_execution_address=b"\x42" * 20,
+    )
+    domain = spec.get_domain(state, spec.DOMAIN_BLS_TO_EXECUTION_CHANGE)
+    signing_root = spec.compute_signing_root(address_change, domain)
+    return spec.SignedBLSToExecutionChange(
+        message=address_change,
+        signature=bls.Sign(privkey, signing_root),
+    )
+
+
+@with_capella_and_later
+@spec_state_test
+@always_bls
+def test_valid_bls_to_execution_change(spec, state):
+    signed_change = _signed_address_change(spec, state, 0)
+    yield "pre", state
+    yield "address_change", signed_change
+    spec.process_bls_to_execution_change(state, signed_change)
+    yield "post", state
+
+    creds = state.validators[0].withdrawal_credentials
+    assert creds[:1] == spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    assert creds[12:] == b"\x42" * 20
+
+
+@with_capella_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_rejected(spec, state):
+    signed_change = _signed_address_change(spec, state, 0)
+    signed_change.signature = spec.BLSSignature(b"\x01" + bytes(signed_change.signature[1:]))
+    yield "pre", state
+    expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed_change))
+    yield "post", None
+
+
+@with_capella_and_later
+@spec_state_test
+def test_wrong_pubkey_rejected(spec, state):
+    signed_change = _signed_address_change(spec, state, 0)
+    signed_change.message.from_bls_pubkey = pubkeys[5]  # wrong withdrawal key
+    yield "pre", state
+    expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed_change))
+    yield "post", None
+
+
+@with_capella_and_later
+@spec_state_test
+def test_out_of_range_validator_index(spec, state):
+    signed_change = _signed_address_change(spec, state, 0)
+    signed_change.message.validator_index = len(state.validators)
+    yield "pre", state
+    expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed_change))
+    yield "post", None

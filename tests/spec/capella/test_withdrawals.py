@@ -1,0 +1,77 @@
+"""Capella withdrawals tests (reference: test/capella/, early-draft
+full-withdrawals queue semantics)."""
+from consensus_specs_tpu.testing.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_capella_and_later,
+)
+from consensus_specs_tpu.testing.helpers.execution_payload import (
+    build_empty_execution_payload,
+    build_state_with_complete_transition,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch, next_slot
+
+
+def _make_validator_withdrawable(spec, state, index):
+    validator = state.validators[index]
+    validator.withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + bytes(validator.withdrawal_credentials[1:])
+    )
+    validator.withdrawable_epoch = spec.get_current_epoch(state)
+    assert spec.is_fully_withdrawable_validator(
+        state.validators[index], spec.get_current_epoch(state))
+
+
+@with_capella_and_later
+@spec_state_test
+def test_full_withdrawal_enqueued_at_epoch_boundary(spec, state):
+    index = 0
+    _make_validator_withdrawable(spec, state, index)
+    pre_balance = state.balances[index]
+    pre_queue_len = len(state.withdrawals_queue)
+
+    yield "pre", state
+    next_epoch(spec, state)
+    yield "post", state
+
+    assert state.balances[index] == 0
+    assert len(state.withdrawals_queue) == pre_queue_len + 1
+    wd = state.withdrawals_queue[len(state.withdrawals_queue) - 1]
+    assert wd.amount == pre_balance
+    assert state.validators[index].fully_withdrawn_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_capella_and_later
+@spec_state_test
+def test_process_withdrawals_dequeues_queue(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    index = 0
+    _make_validator_withdrawable(spec, state, index)
+    next_epoch(spec, state)  # enqueue the withdrawal
+    assert len(state.withdrawals_queue) == 1
+
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+
+    yield "pre", state
+    spec.process_withdrawals(state, payload)
+    yield "post", state
+
+    assert len(state.withdrawals_queue) == 0
+
+
+@with_capella_and_later
+@spec_state_test
+def test_process_withdrawals_wrong_payload_fails(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    index = 0
+    _make_validator_withdrawable(spec, state, index)
+    next_epoch(spec, state)
+    assert len(state.withdrawals_queue) == 1
+
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[0].amount += 1  # mismatch vs queue
+
+    yield "pre", state
+    expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+    yield "post", None

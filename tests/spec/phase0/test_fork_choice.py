@@ -1,0 +1,111 @@
+"""Fork-choice tests (reference: test/phase0/fork_choice/test_on_block.py,
+test_get_head.py — representative subset)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+    with_presets,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+    next_epoch_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testing.helpers.constants import MINIMAL
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    add_attestation,
+    add_block,
+    apply_next_epoch_with_attestations,
+    get_anchor_root,
+    get_genesis_forkchoice_store,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    tick_and_add_block,
+    tick_and_run_on_attestation,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis_head(spec, state):
+    test_steps = []
+    store = get_genesis_forkchoice_store(spec, state)
+    anchor_root = get_anchor_root(spec, state)
+    assert spec.get_head(store) == anchor_root
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_checks(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # On receiving a block of `GENESIS_SLOT + 1` slot
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    assert spec.get_head(store) == signed_block.message.hash_tree_root()
+
+    # block from the future is not added
+    future_block = build_empty_block_for_next_slot(spec, state)
+    future_signed = state_transition_and_sign_block(spec, state.copy(), future_block)
+    # do NOT tick forward: current slot < block slot
+    yield from add_block(spec, store, future_signed, test_steps, valid=False)
+
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_updates_latest_messages(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    test_steps = []
+
+    # advance a slot with a block, then attest to it
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    yield from tick_and_run_on_attestation(spec, store, attestation, test_steps)
+
+    attesting = spec.get_attesting_indices(state, attestation.data, attestation.aggregation_bits)
+    for i in attesting:
+        assert i in store.latest_messages
+        assert store.latest_messages[i].root == attestation.data.beacon_block_root
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@with_presets([MINIMAL], reason="epoch-long walks; too slow at mainnet size")
+@spec_state_test
+def test_on_block_finalization_updates(spec, state):
+    """Full epochs with attestations drive justification+finality into the store."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    current_time = state.genesis_time + spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+
+    next_epoch(spec, state)
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps)
+
+    for _ in range(4):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, True, test_steps=test_steps)
+
+    assert store.finalized_checkpoint.epoch > 0
+    assert store.justified_checkpoint.epoch > store.finalized_checkpoint.epoch
+
+    yield "steps", "data", test_steps
