@@ -1,0 +1,83 @@
+"""Differential test: whole-permutation swap-or-not shuffle vs the scalar spec.
+
+``compute_shuffle_permutation`` (ops/shuffle.py) is installed into every
+built spec as the committee-computation optimization (specs/builder.py),
+so it must equal the spec's scalar ``compute_shuffled_index``
+(reference: specs/phase0/beacon-chain.md:760-781) at every index — in
+particular near the 256-index source-hash block boundaries.
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.ops.shuffle import compute_shuffle_permutation
+from consensus_specs_tpu.specs.builder import get_spec
+
+SIZES = [1, 2, 3, 7, 8, 100, 255, 256, 257, 511, 512, 513, 1000]
+SEEDS = [b"\x00" * 32, bytes(range(32)), b"\xff" * 32]
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("phase0", "minimal")
+
+
+def _scalar_permutation(spec, seed, n):
+    return [int(spec.compute_shuffled_index(spec.uint64(i), spec.uint64(n), seed))
+            for i in range(n)]
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_permutation_matches_scalar_minimal_rounds(spec, n):
+    rounds = int(spec.SHUFFLE_ROUND_COUNT)
+    seed = SEEDS[1]
+    perm = compute_shuffle_permutation(seed, n, rounds)
+    assert perm.tolist() == _scalar_permutation(spec, seed, n)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_permutation_matches_scalar_all_seeds(spec, seed):
+    rounds = int(spec.SHUFFLE_ROUND_COUNT)
+    for n in (255, 256, 257):
+        perm = compute_shuffle_permutation(seed, n, rounds)
+        assert perm.tolist() == _scalar_permutation(spec, seed, n)
+
+
+def test_permutation_mainnet_round_count(spec):
+    """90 rounds (mainnet SHUFFLE_ROUND_COUNT) against a scalar twin that
+    re-derives the per-index form directly from the spec formula."""
+    import hashlib
+
+    def scalar_shuffled_index(index, index_count, seed, rounds):
+        # reference: specs/phase0/beacon-chain.md:760-781
+        assert index < index_count
+        for current_round in range(rounds):
+            pivot = int.from_bytes(
+                hashlib.sha256(seed + bytes([current_round])).digest()[:8],
+                "little") % index_count
+            flip = (pivot + index_count - index) % index_count
+            position = max(index, flip)
+            source = hashlib.sha256(
+                seed + bytes([current_round])
+                + (position // 256).to_bytes(4, "little")).digest()
+            byte = source[(position % 256) // 8]
+            bit = (byte >> (position % 8)) % 2
+            index = flip if bit else index
+        return index
+
+    rounds = 90
+    seed = SEEDS[2]
+    for n in (257, 512):
+        perm = compute_shuffle_permutation(seed, n, rounds)
+        expected = [scalar_shuffled_index(i, n, seed, rounds) for i in range(n)]
+        assert perm.tolist() == expected
+
+
+def test_permutation_is_bijection():
+    perm = compute_shuffle_permutation(SEEDS[0], 1000, 90)
+    assert sorted(perm.tolist()) == list(range(1000))
+
+
+def test_cache_returns_readonly():
+    perm = compute_shuffle_permutation(SEEDS[0], 64, 10)
+    with pytest.raises(ValueError):
+        perm[0] = 99
