@@ -1,0 +1,269 @@
+"""Fq (BLS12-381 base field) arithmetic on 26-bit limb lanes in JAX.
+
+Representation: an Fq element is a ``[..., 15]`` **int64** array of
+little-endian 26-bit limbs, value = sum(limb[i] << 26*i), held in
+Montgomery form (a*R mod p, R = 2^390).
+
+Lazy-reduction design (the TPU-native shape — lanes with headroom, not
+carry chains):
+
+  * ``add``/``sub``/``neg``/scalar doublings are ONE elementwise op each:
+    limbs are signed and may grow/ go negative; nothing propagates.
+  * Only ``mul`` reduces.  It accepts operands with limbs |a_i| <= 2^29
+    (i.e. sums/differences of up to ~8 reduced values) and values
+    |a| <= 36p, and returns a *reduced* element: canonical digits in
+    [0, 2^26), value in (0, 3p).  Equality therefore requires
+    ``canonical()`` first.
+
+Overflow audit for ``mul`` (int64):
+  schoolbook product limbs: <= 15 * 2^29 * 2^29 = 2^61.9;
+  REDC adds m_i * p limbs (<= 15 * 2^52 = 2^55.9) and carries (< 2^37):
+  total < 2^62.5 < 2^63.  REDC exactness needs |a*b| < R*p: worst
+  (36p)^2 = 1296 p^2 << 2^390 p.  After REDC the value lies in (-p, 2p);
+  the tail adds p and carry-propagates, giving (0, 3p) with canonical
+  digits.
+
+Differential tests vs python ints: tests/test_bls_jax.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+# 16 limbs (R = 2^416) rather than the minimal 15: the extra limb buys
+# enough headroom that lazily-accumulated values (up to ~1000p) still
+# satisfy the REDC exactness bound |a|*|b| < R*p with a wide margin.
+N_LIMBS = 16
+LIMB_BITS = 26
+MASK = (1 << LIMB_BITS) - 1
+R_BITS = N_LIMBS * LIMB_BITS  # 416
+
+R_INT = (1 << R_BITS) % P_INT
+R2_INT = (R_INT * R_INT) % P_INT
+N0INV_INT = (-pow(P_INT, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host: python int in [0, 2^390) -> [15] int64 limb array (plain
+    value, NOT Montgomery).  p itself is a valid input."""
+    assert 0 <= x < (1 << R_BITS)
+    out = np.zeros(N_LIMBS, dtype=np.int64)
+    for i in range(N_LIMBS):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    return out
+
+
+def limbs_to_int(a) -> int:
+    """Host: limb array (any signed representation) -> python int value."""
+    a = np.asarray(a, dtype=np.int64)
+    return sum(int(a[i]) << (LIMB_BITS * i) for i in range(N_LIMBS))
+
+
+P_LIMBS = int_to_limbs(P_INT)
+R2_LIMBS = int_to_limbs(R2_INT)
+ONE_LIMBS = int_to_limbs(1)
+MONT_ONE_LIMBS = int_to_limbs(R_INT)
+
+_P_LIMBS_J = jnp.asarray(P_LIMBS)
+_R2_LIMBS_J = jnp.asarray(R2_LIMBS)
+_ONE_LIMBS_J = jnp.asarray(ONE_LIMBS)
+_N0INV = jnp.int64(N0INV_INT)
+_MASK = jnp.int64(MASK)
+_B = LIMB_BITS
+
+# p shifted to offset i inside a 30-limb window, one constant per REDC step
+_P_SHIFTED = np.zeros((N_LIMBS, 2 * N_LIMBS), dtype=np.int64)
+for _i in range(N_LIMBS):
+    _P_SHIFTED[_i, _i:_i + N_LIMBS] = P_LIMBS
+_P_SHIFTED_J = jnp.asarray(_P_SHIFTED)
+
+# one-hot unit vectors for carry injection
+_E = np.eye(2 * N_LIMBS, dtype=np.int64)
+_E_J = jnp.asarray(_E)
+
+# gather indices for anti-diagonal (convolution) summation:
+# padded outer row i rolled right by i, so column k holds a_i * b_{k-i}
+_CONV_IDX = np.zeros((N_LIMBS, 2 * N_LIMBS), dtype=np.int32)
+for _i in range(N_LIMBS):
+    _CONV_IDX[_i] = (np.arange(2 * N_LIMBS) - _i) % (2 * N_LIMBS)
+_CONV_IDX_J = jnp.asarray(_CONV_IDX)
+
+
+# ---------------------------------------------------------------------------
+# lazy elementwise ops
+# ---------------------------------------------------------------------------
+
+
+def add(a, b):
+    return a + b
+
+
+def sub(a, b):
+    return a - b
+
+
+def neg(a):
+    return -a
+
+
+def double(a):
+    return a + a
+
+
+def renorm(a):
+    """Digit renormalization for lazily-accumulated elements: signed
+    carry propagation with NO offset — the represented value is unchanged
+    (and may be negative).  Limbs 0..14 become canonical in [0, 2^26);
+    limb 15 absorbs the remaining signed magnitude (tiny: |value| < 2^20*p
+    implies |top| < 2^32).  Keeps schoolbook digit bounds without
+    inflating values — ``mul`` accepts signed operands natively."""
+    digits = []
+    c = jnp.zeros_like(a[..., 0])
+    for i in range(N_LIMBS - 1):
+        v = a[..., i] + c
+        digits.append(v & _MASK)
+        c = v >> _B
+    digits.append(a[..., N_LIMBS - 1] + c)
+    return jnp.stack(digits, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# multiplication (the only reducing op)
+# ---------------------------------------------------------------------------
+
+
+def mul(a, b):
+    """Montgomery multiply-reduce: a*b*R^-1 mod p, reduced output
+    (canonical digits, value in (0, 3p)).  See module docstring bounds."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+
+    # schoolbook product via padded outer rows + anti-diagonal gather-sum
+    outer = a[..., :, None] * b[..., None, :]                  # [..., 15, 15]
+    padded = jnp.concatenate(
+        [outer, jnp.zeros(shape[:-1] + (N_LIMBS, N_LIMBS), jnp.int64)],
+        axis=-1)                                               # [..., 15, 30]
+    idx = jnp.broadcast_to(_CONV_IDX_J, shape[:-1] + (N_LIMBS, 2 * N_LIMBS))
+    rolled = jnp.take_along_axis(padded, idx.astype(jnp.int64), axis=-1)
+    T = jnp.sum(rolled, axis=-2)                               # [..., 30]
+
+    # REDC: clear limbs 0..14; static-shift constant adds, no scatters
+    for i in range(N_LIMBS):
+        m = ((T[..., i] & _MASK) * _N0INV) & _MASK
+        T = T + m[..., None] * _P_SHIFTED_J[i]
+        carry = T[..., i] >> _B                                # exact: T[i] ≡ 0
+        T = T + carry[..., None] * _E_J[i + 1]
+
+    r = T[..., N_LIMBS:]
+    # make surely positive, then carry-propagate to canonical digits
+    r = r + _P_LIMBS_J
+    digits = []
+    c = jnp.zeros_like(r[..., 0])
+    for i in range(N_LIMBS):
+        v = r[..., i] + c
+        digits.append(v & _MASK)
+        c = v >> _B
+    return jnp.stack(digits, axis=-1)
+
+
+def square(a):
+    return mul(a, a)
+
+
+def to_mont(a):
+    return mul(a, _R2_LIMBS_J)
+
+
+def from_mont(a):
+    """Montgomery -> plain residue, canonical in [0, p)."""
+    return cond_sub_p(cond_sub_p(mul(a, _ONE_LIMBS_J)))
+
+
+# ---------------------------------------------------------------------------
+# canonicalization + comparisons
+# ---------------------------------------------------------------------------
+
+
+def _geq_p(a):
+    """a >= p for canonical-digit a (lexicographic from the top limb)."""
+    gt = jnp.zeros(a.shape[:-1], dtype=bool)
+    eq_ = jnp.ones(a.shape[:-1], dtype=bool)
+    for i in range(N_LIMBS - 1, -1, -1):
+        pi = _P_LIMBS_J[i]
+        gt = gt | (eq_ & (a[..., i] > pi))
+        eq_ = eq_ & (a[..., i] == pi)
+    return gt | eq_
+
+
+def cond_sub_p(a):
+    """Subtract p once where a >= p (canonical digits in, canonical out)."""
+    d = a - _P_LIMBS_J
+    # re-propagate (digits may go negative limb-wise but value >= 0)
+    digits = []
+    c = jnp.zeros_like(a[..., 0])
+    for i in range(N_LIMBS):
+        v = d[..., i] + c
+        digits.append(v & _MASK)
+        c = v >> _B
+    d = jnp.stack(digits, axis=-1)
+    return jnp.where(_geq_p(a)[..., None], d, a)
+
+
+def canonical(a):
+    """Fully-reduced Montgomery representative in [0, p)."""
+    r = mul(a, jnp.asarray(MONT_ONE_LIMBS))  # value in (0, 2p + eps)
+    return cond_sub_p(cond_sub_p(r))
+
+
+def eq_canonical(a, b):
+    """Equality of canonical() outputs."""
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero_canonical(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# fixed-exponent powers
+# ---------------------------------------------------------------------------
+
+
+def _exp_bits(e: int) -> np.ndarray:
+    return np.array([int(c) for c in bin(e)[2:]], dtype=np.int32)
+
+
+_P_MINUS_2_BITS = jnp.asarray(_exp_bits(P_INT - 2))
+
+
+def inv(a):
+    """a^(p-2) via square-and-multiply scan over the fixed exponent."""
+    def body(acc, bit):
+        acc = mul(acc, acc)
+        acc = jnp.where(bit > 0, mul(acc, a), acc)
+        return acc, None
+
+    init = jnp.broadcast_to(jnp.asarray(MONT_ONE_LIMBS), a.shape)
+    out, _ = jax.lax.scan(body, init, _P_MINUS_2_BITS)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host conversions
+# ---------------------------------------------------------------------------
+
+
+def host_to_mont(x: int) -> np.ndarray:
+    return int_to_limbs((x * R_INT) % P_INT)
+
+
+def host_from_mont(a) -> int:
+    return (limbs_to_int(a) * pow(R_INT, -1, P_INT)) % P_INT
