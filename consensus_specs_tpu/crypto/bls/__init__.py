@@ -10,6 +10,8 @@ Behavioral twin of the reference's eth2spec/utils/bls.py:
 
 Backends:
   * "python": the from-scratch pure-Python oracle in this package
+  * "native": from-scratch C++ (crypto/bls/native/), the fast host path —
+    the role the reference fills with its Rust milagro binding
   * "jax":    batched TPU pipeline (ops/bls_jax) — registered lazily
 """
 from __future__ import annotations
@@ -41,6 +43,10 @@ def use_backend(name: str) -> None:
         from consensus_specs_tpu.ops import bls_jax
 
         register_backend("jax", bls_jax.backend())
+    if name == "native" and "native" not in _backends:
+        from . import native
+
+        register_backend("native", native)
     bls = _backends[name]
     _backend_name = name
 
@@ -49,8 +55,22 @@ def use_python() -> None:
     use_backend("python")
 
 
+def use_native() -> None:
+    use_backend("native")
+
+
 def use_jax() -> None:
     use_backend("jax")
+
+
+def use_fastest() -> None:
+    """Prefer the native C++ backend, falling back to the Python oracle
+    (mirrors the reference's bls_active default of the fastest available
+    backend for CI; eth2spec/utils/bls.py:8-30)."""
+    try:
+        use_backend("native")
+    except ImportError:
+        use_backend("python")
 
 
 def backend_name() -> str:

@@ -1,0 +1,1149 @@
+// Native BLS12-381 backend: the "fast host path" of the BLS selector
+// (role analogous to the reference's milagro/Rust backend selectable in
+// eth2spec/utils/bls.py:8-30; implementation is from scratch).
+//
+// Design: 6x64-bit Montgomery Fp, Karatsuba Fp2/Fp6/Fp12 towers mirroring
+// the formulas of the pure-Python oracle (crypto/bls/fields.py), affine
+// Miller loop on the twist with sparse line evaluation, final exponentiation
+// via Frobenius easy part + plain hard-part exponent.  All constants come
+// from the generated bls_constants.h, each validated against the Python
+// oracle at generation time.  Differential tests in
+// tests/crypto/test_native_bls.py pin every exported function to the oracle.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 bls12_381.cpp -o _bls.so
+
+#include <stdint.h>
+#include <stddef.h>
+#include <string.h>
+
+#include <vector>
+
+#include "bls_constants.h"
+
+typedef unsigned __int128 u128;
+
+// ===========================================================================
+// Fp: integers mod p in Montgomery form (R = 2^384)
+// ===========================================================================
+
+struct fp {
+    uint64_t l[6];
+};
+
+static inline bool fp_is_zero_raw(const fp &a) {
+    return (a.l[0] | a.l[1] | a.l[2] | a.l[3] | a.l[4] | a.l[5]) == 0;
+}
+
+static inline int limbs_cmp(const uint64_t a[6], const uint64_t b[6]) {
+    for (int i = 5; i >= 0; i--) {
+        if (a[i] < b[i]) return -1;
+        if (a[i] > b[i]) return 1;
+    }
+    return 0;
+}
+
+static inline void limbs_sub(uint64_t r[6], const uint64_t a[6], const uint64_t b[6]) {
+    u128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 cur = (u128)a[i] - b[i] - (uint64_t)borrow;
+        r[i] = (uint64_t)cur;
+        borrow = (cur >> 64) & 1;  // 1 when borrowed
+    }
+}
+
+static inline void fp_add(fp &r, const fp &a, const fp &b) {
+    u128 carry = 0;
+    uint64_t t[6];
+    for (int i = 0; i < 6; i++) {
+        u128 cur = (u128)a.l[i] + b.l[i] + (uint64_t)carry;
+        t[i] = (uint64_t)cur;
+        carry = cur >> 64;
+    }
+    // 2p < 2^384, so no carry out; reduce once if >= p
+    if (limbs_cmp(t, P_LIMBS) >= 0) limbs_sub(r.l, t, P_LIMBS);
+    else memcpy(r.l, t, sizeof(t));
+}
+
+static inline void fp_sub(fp &r, const fp &a, const fp &b) {
+    u128 borrow = 0;
+    uint64_t t[6];
+    for (int i = 0; i < 6; i++) {
+        u128 cur = (u128)a.l[i] - b.l[i] - (uint64_t)borrow;
+        t[i] = (uint64_t)cur;
+        borrow = (cur >> 64) & 1;
+    }
+    if (borrow) {
+        u128 carry = 0;
+        for (int i = 0; i < 6; i++) {
+            u128 cur = (u128)t[i] + P_LIMBS[i] + (uint64_t)carry;
+            t[i] = (uint64_t)cur;
+            carry = cur >> 64;
+        }
+    }
+    memcpy(r.l, t, sizeof(t));
+}
+
+static inline void fp_neg(fp &r, const fp &a) {
+    if (fp_is_zero_raw(a)) {
+        memset(r.l, 0, sizeof(r.l));
+        return;
+    }
+    limbs_sub(r.l, P_LIMBS, a.l);
+}
+
+// CIOS Montgomery multiplication: r = a*b*R^-1 mod p
+static void fp_mul(fp &r, const fp &a, const fp &b) {
+    uint64_t t[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 6; i++) {
+        u128 carry = 0;
+        uint64_t bi = b.l[i];
+        for (int j = 0; j < 6; j++) {
+            u128 cur = (u128)a.l[j] * bi + t[j] + (uint64_t)carry;
+            t[j] = (uint64_t)cur;
+            carry = cur >> 64;
+        }
+        u128 cur = (u128)t[6] + (uint64_t)carry;
+        t[6] = (uint64_t)cur;
+        t[7] = (uint64_t)(cur >> 64);
+
+        uint64_t m = t[0] * P_INV_NEG;
+        u128 cur0 = (u128)m * P_LIMBS[0] + t[0];
+        carry = cur0 >> 64;
+        for (int j = 1; j < 6; j++) {
+            u128 c2 = (u128)m * P_LIMBS[j] + t[j] + (uint64_t)carry;
+            t[j - 1] = (uint64_t)c2;
+            carry = c2 >> 64;
+        }
+        u128 c3 = (u128)t[6] + (uint64_t)carry;
+        t[5] = (uint64_t)c3;
+        t[6] = t[7] + (uint64_t)(c3 >> 64);
+        t[7] = 0;
+    }
+    if (limbs_cmp(t, P_LIMBS) >= 0) limbs_sub(r.l, t, P_LIMBS);
+    else memcpy(r.l, t, sizeof(fp));
+}
+
+static inline void fp_sqr(fp &r, const fp &a) { fp_mul(r, a, a); }
+
+static const fp FP_ZERO = {{0, 0, 0, 0, 0, 0}};
+
+static fp FP_ONE;   // R mod p (Montgomery 1), set in init
+static fp FP_R2;    // 2^768 mod p
+static fp FP_RMODP_MONT;  // mont(R mod p): for 2^384 shifts in reductions
+
+static inline void fp_to_mont(fp &r, const fp &raw) { fp_mul(r, raw, FP_R2); }
+
+static inline void fp_from_mont(fp &r, const fp &a) {
+    fp one_raw = {{1, 0, 0, 0, 0, 0}};
+    fp_mul(r, a, one_raw);
+}
+
+static inline bool fp_eq(const fp &a, const fp &b) {
+    return memcmp(a.l, b.l, sizeof(a.l)) == 0;
+}
+
+// Binary extended GCD inversion.  Treats the Montgomery representative aR
+// as a plain integer: egcd gives (aR)^-1 = a^-1 R^-1; two Montgomery
+// multiplications by R^2 then lift to a^-1 R (Montgomery form of a^-1).
+static void fp_inv(fp &r, const fp &a) {
+    if (fp_is_zero_raw(a)) {  // 0 has no inverse; define inv(0)=0 (never hit on valid input)
+        r = FP_ZERO;
+        return;
+    }
+    // HAC 14.61 structure: invariants x1*aR = u (mod p), x2*aR = v (mod p);
+    // gcd(aR, p) = 1 so u == v > 1 can never occur and the loop terminates
+    // with u == 1 (answer x1) or v == 1 (answer x2).
+    uint64_t u[6], v[6], x1[6], x2[6];
+    memcpy(u, a.l, sizeof(u));
+    memcpy(v, P_LIMBS, sizeof(v));
+    memset(x1, 0, sizeof(x1));
+    memset(x2, 0, sizeof(x2));
+    x1[0] = 1;
+
+    auto is_zero = [](const uint64_t x[6]) {
+        return (x[0] | x[1] | x[2] | x[3] | x[4] | x[5]) == 0;
+    };
+    auto shr1 = [](uint64_t x[6], uint64_t top) {
+        for (int i = 0; i < 5; i++) x[i] = (x[i] >> 1) | (x[i + 1] << 63);
+        x[5] = (x[5] >> 1) | (top << 63);
+    };
+    auto half_mod = [&](uint64_t x[6]) {
+        if (x[0] & 1) {
+            // x = (x + p) / 2, with the carry bit out of 384 feeding the shift
+            u128 carry = 0;
+            for (int i = 0; i < 6; i++) {
+                u128 cur = (u128)x[i] + P_LIMBS[i] + (uint64_t)carry;
+                x[i] = (uint64_t)cur;
+                carry = cur >> 64;
+            }
+            shr1(x, (uint64_t)carry);
+        } else {
+            shr1(x, 0);
+        }
+    };
+    auto sub_mod = [&](uint64_t x[6], const uint64_t y[6]) {
+        // x = (x - y) mod p
+        u128 borrow = 0;
+        for (int i = 0; i < 6; i++) {
+            u128 cur = (u128)x[i] - y[i] - (uint64_t)borrow;
+            x[i] = (uint64_t)cur;
+            borrow = (cur >> 64) & 1;
+        }
+        if (borrow) {
+            u128 carry = 0;
+            for (int i = 0; i < 6; i++) {
+                u128 cur = (u128)x[i] + P_LIMBS[i] + (uint64_t)carry;
+                x[i] = (uint64_t)cur;
+                carry = cur >> 64;
+            }
+        }
+    };
+
+    auto is_one = [](const uint64_t x[6]) {
+        return x[0] == 1 && (x[1] | x[2] | x[3] | x[4] | x[5]) == 0;
+    };
+
+    while (!is_one(u) && !is_one(v)) {
+        while (!(u[0] & 1)) {
+            shr1(u, 0);
+            half_mod(x1);
+        }
+        while (!(v[0] & 1)) {
+            shr1(v, 0);
+            half_mod(x2);
+        }
+        if (limbs_cmp(u, v) >= 0) {
+            limbs_sub(u, u, v);
+            sub_mod(x1, x2);
+        } else {
+            limbs_sub(v, v, u);
+            sub_mod(x2, x1);
+        }
+    }
+    // answer = (aR)^-1 mod p = a^-1 R^-1
+    fp e;
+    memcpy(e.l, is_one(u) ? x1 : x2, sizeof(e.l));
+    fp_mul(e, e, FP_R2);  // a^-1 (canonical)
+    fp_mul(r, e, FP_R2);  // a^-1 R (Montgomery)
+}
+
+// generic square-and-multiply by a big-endian byte exponent
+template <typename T>
+static T pow_be(const T &base, const uint8_t *exp, size_t n, const T &one) {
+    T result = one;
+    for (size_t i = 0; i < n; i++) {
+        uint8_t byte = exp[i];
+        for (int b = 7; b >= 0; b--) {
+            result = result.square();
+            if ((byte >> b) & 1) result = result * base;
+        }
+    }
+    return result;
+}
+
+struct Fp {
+    fp v;
+    Fp() : v(FP_ZERO) {}
+    explicit Fp(const fp &x) : v(x) {}
+    Fp operator+(const Fp &o) const { Fp r; fp_add(r.v, v, o.v); return r; }
+    Fp operator-(const Fp &o) const { Fp r; fp_sub(r.v, v, o.v); return r; }
+    Fp operator*(const Fp &o) const { Fp r; fp_mul(r.v, v, o.v); return r; }
+    Fp operator-() const { Fp r; fp_neg(r.v, v); return r; }
+    Fp square() const { Fp r; fp_sqr(r.v, v); return r; }
+    Fp inv() const { Fp r; fp_inv(r.v, v); return r; }
+    bool is_zero() const { return fp_is_zero_raw(v); }
+    bool operator==(const Fp &o) const { return fp_eq(v, o.v); }
+    bool operator!=(const Fp &o) const { return !fp_eq(v, o.v); }
+    static Fp one() { return Fp(FP_ONE); }
+    static Fp zero() { return Fp(FP_ZERO); }
+};
+
+static Fp fp_from_limbs(const uint64_t raw[6]) {
+    fp x;
+    memcpy(x.l, raw, sizeof(x.l));
+    Fp r;
+    fp_to_mont(r.v, x);
+    return r;
+}
+
+// canonical (non-Montgomery) little-endian limbs
+static void fp_canonical(uint64_t out[6], const Fp &a) {
+    fp c;
+    fp_from_mont(c, a.v);
+    memcpy(out, c.l, sizeof(c.l));
+}
+
+static bool fp_sgn_lex(const Fp &y) {  // y > (p-1)/2
+    uint64_t c[6];
+    fp_canonical(c, y);
+    return limbs_cmp(c, HALF_P) > 0;
+}
+
+static int fp_parity(const Fp &a) {
+    uint64_t c[6];
+    fp_canonical(c, a);
+    return (int)(c[0] & 1);
+}
+
+// ===========================================================================
+// Fp2 = Fp[u]/(u^2+1)
+// ===========================================================================
+
+struct Fp2 {
+    Fp c0, c1;
+    Fp2() {}
+    Fp2(const Fp &a, const Fp &b) : c0(a), c1(b) {}
+    Fp2 operator+(const Fp2 &o) const { return Fp2(c0 + o.c0, c1 + o.c1); }
+    Fp2 operator-(const Fp2 &o) const { return Fp2(c0 - o.c0, c1 - o.c1); }
+    Fp2 operator-() const { return Fp2(-c0, -c1); }
+    Fp2 operator*(const Fp2 &o) const {
+        // karatsuba, mirrors fields.py Fq2.__mul__
+        Fp t0 = c0 * o.c0;
+        Fp t1 = c1 * o.c1;
+        Fp cross = (c0 + c1) * (o.c0 + o.c1);
+        return Fp2(t0 - t1, cross - t0 - t1);
+    }
+    Fp2 square() const {
+        // (a0+a1)(a0-a1) + 2 a0 a1 u
+        Fp t0 = (c0 + c1) * (c0 - c1);
+        Fp t1 = c0 * c1;
+        return Fp2(t0, t1 + t1);
+    }
+    Fp2 mul_by_xi() const {  // * (1 + u)
+        return Fp2(c0 - c1, c0 + c1);
+    }
+    Fp2 conjugate() const { return Fp2(c0, -c1); }
+    Fp2 inv() const {
+        Fp norm = c0.square() + c1.square();
+        Fp ninv = norm.inv();
+        return Fp2(c0 * ninv, -(c1 * ninv));
+    }
+    Fp2 scale(const Fp &s) const { return Fp2(c0 * s, c1 * s); }
+    bool is_zero() const { return c0.is_zero() && c1.is_zero(); }
+    bool operator==(const Fp2 &o) const { return c0 == o.c0 && c1 == o.c1; }
+    bool operator!=(const Fp2 &o) const { return !(*this == o); }
+    static Fp2 one() { return Fp2(Fp::one(), Fp::zero()); }
+    static Fp2 zero() { return Fp2(Fp::zero(), Fp::zero()); }
+};
+
+static Fp2 fp2_from_limbs(const uint64_t c0[6], const uint64_t c1[6]) {
+    return Fp2(fp_from_limbs(c0), fp_from_limbs(c1));
+}
+
+static int fp2_sgn0(const Fp2 &a) {  // RFC 9380 sgn0, m=2
+    int sign_0 = fp_parity(a.c0);
+    int zero_0 = a.c0.is_zero() ? 1 : 0;
+    int sign_1 = fp_parity(a.c1);
+    return sign_0 | (zero_0 & sign_1);
+}
+
+static Fp2 FQ2_SQRT_ADJ[4];
+
+static bool fp2_sqrt(Fp2 &out, const Fp2 &a) {
+    Fp2 c = pow_be(a, EXP_FQ2_SQRT, EXP_FQ2_SQRT_LEN, Fp2::one());
+    for (int i = 0; i < 4; i++) {
+        Fp2 cand = c * FQ2_SQRT_ADJ[i];
+        if (cand.square() == a) {
+            out = cand;
+            return true;
+        }
+    }
+    return false;
+}
+
+static bool fp_sqrt(Fp &out, const Fp &a) {
+    Fp c = pow_be(a, EXP_FP_SQRT, EXP_FP_SQRT_LEN, Fp::one());
+    if (c.square() == a) {
+        out = c;
+        return true;
+    }
+    return false;
+}
+
+// ===========================================================================
+// Fp6 = Fp2[v]/(v^3 - xi), Fp12 = Fp6[w]/(w^2 - v)     (xi = 1 + u)
+// ===========================================================================
+
+struct Fp6 {
+    Fp2 c0, c1, c2;
+    Fp6() {}
+    Fp6(const Fp2 &a, const Fp2 &b, const Fp2 &c) : c0(a), c1(b), c2(c) {}
+    Fp6 operator+(const Fp6 &o) const { return Fp6(c0 + o.c0, c1 + o.c1, c2 + o.c2); }
+    Fp6 operator-(const Fp6 &o) const { return Fp6(c0 - o.c0, c1 - o.c1, c2 - o.c2); }
+    Fp6 operator-() const { return Fp6(-c0, -c1, -c2); }
+    Fp6 operator*(const Fp6 &o) const {
+        // mirrors fields.py Fq6.__mul__
+        Fp2 t0 = c0 * o.c0;
+        Fp2 t1 = c1 * o.c1;
+        Fp2 t2 = c2 * o.c2;
+        Fp2 r0 = ((c1 + c2) * (o.c1 + o.c2) - t1 - t2).mul_by_xi() + t0;
+        Fp2 r1 = (c0 + c1) * (o.c0 + o.c1) - t0 - t1 + t2.mul_by_xi();
+        Fp2 r2 = (c0 + c2) * (o.c0 + o.c2) - t0 - t2 + t1;
+        return Fp6(r0, r1, r2);
+    }
+    Fp6 square() const { return (*this) * (*this); }
+    Fp6 mul_by_v() const { return Fp6(c2.mul_by_xi(), c0, c1); }
+    Fp6 inv() const {
+        Fp2 t0 = c0.square() - (c1 * c2).mul_by_xi();
+        Fp2 t1 = c2.square().mul_by_xi() - c0 * c1;
+        Fp2 t2 = c1.square() - c0 * c2;
+        Fp2 factor = (c0 * t0 + (c2 * t1).mul_by_xi() + (c1 * t2).mul_by_xi()).inv();
+        return Fp6(t0 * factor, t1 * factor, t2 * factor);
+    }
+    bool is_zero() const { return c0.is_zero() && c1.is_zero() && c2.is_zero(); }
+    bool operator==(const Fp6 &o) const { return c0 == o.c0 && c1 == o.c1 && c2 == o.c2; }
+    static Fp6 one() { return Fp6(Fp2::one(), Fp2::zero(), Fp2::zero()); }
+    static Fp6 zero() { return Fp6(Fp2::zero(), Fp2::zero(), Fp2::zero()); }
+};
+
+struct Fp12 {
+    Fp6 c0, c1;
+    Fp12() {}
+    Fp12(const Fp6 &a, const Fp6 &b) : c0(a), c1(b) {}
+    Fp12 operator*(const Fp12 &o) const {
+        Fp6 t0 = c0 * o.c0;
+        Fp6 t1 = c1 * o.c1;
+        Fp6 r0 = t0 + t1.mul_by_v();
+        Fp6 r1 = (c0 + c1) * (o.c0 + o.c1) - t0 - t1;
+        return Fp12(r0, r1);
+    }
+    Fp12 square() const {
+        // mirrors fields.py Fq12.square
+        Fp6 t0 = c0 * c1;
+        Fp6 r0 = (c0 + c1) * (c0 + c1.mul_by_v()) - t0 - t0.mul_by_v();
+        return Fp12(r0, t0 + t0);
+    }
+    Fp12 conjugate() const { return Fp12(c0, -c1); }
+    Fp12 inv() const {
+        Fp6 factor = (c0.square() - c1.square().mul_by_v()).inv();
+        return Fp12(c0 * factor, -(c1 * factor));
+    }
+    bool operator==(const Fp12 &o) const { return c0 == o.c0 && c1 == o.c1; }
+    static Fp12 one() { return Fp12(Fp6::one(), Fp6::zero()); }
+};
+
+// Frobenius p^2: coefficient at w^k scales by FROB2_G[k] (an Fp element).
+// Basis order: c0.(c0,c1,c2) sit at w^0,w^2,w^4; c1.(c0,c1,c2) at w^1,w^3,w^5.
+static Fp FROB2_COEF[6];
+
+static Fp12 frobenius_p2(const Fp12 &f) {
+    return Fp12(
+        Fp6(f.c0.c0.scale(FROB2_COEF[0]),
+            f.c0.c1.scale(FROB2_COEF[2]),
+            f.c0.c2.scale(FROB2_COEF[4])),
+        Fp6(f.c1.c0.scale(FROB2_COEF[1]),
+            f.c1.c1.scale(FROB2_COEF[3]),
+            f.c1.c2.scale(FROB2_COEF[5])));
+}
+
+// ===========================================================================
+// Curve points (Jacobian), generic over the coordinate field
+// ===========================================================================
+
+template <class F>
+struct Pt {
+    F x, y, z;
+    bool is_inf() const { return z.is_zero(); }
+    static Pt infinity() { return Pt{F::one(), F::one(), F::zero()}; }
+
+    Pt dbl() const {
+        if (is_inf()) return *this;
+        // dbl-2009-l, mirrors curve.py Point.double
+        F A = x.square();
+        F B = y.square();
+        F C = B.square();
+        F D = (x + B).square() - A - C;
+        D = D + D;
+        F E = A + A + A;
+        F Fv = E.square();
+        F X3 = Fv - D - D;
+        F eightC = C + C;
+        eightC = eightC + eightC;
+        eightC = eightC + eightC;
+        F Y3 = E * (D - X3) - eightC;
+        F Z3 = y * z;
+        Z3 = Z3 + Z3;
+        return Pt{X3, Y3, Z3};
+    }
+
+    Pt add(const Pt &o) const {
+        if (is_inf()) return o;
+        if (o.is_inf()) return *this;
+        // add-2007-bl, mirrors curve.py Point.__add__
+        F Z1Z1 = z.square();
+        F Z2Z2 = o.z.square();
+        F U1 = x * Z2Z2;
+        F U2 = o.x * Z1Z1;
+        F S1 = y * o.z * Z2Z2;
+        F S2 = o.y * z * Z1Z1;
+        if (U1 == U2) {
+            if (S1 == S2) return dbl();
+            return infinity();
+        }
+        F H = U2 - U1;
+        F I = (H + H).square();
+        F J = H * I;
+        F rr = S2 - S1;
+        rr = rr + rr;
+        F V = U1 * I;
+        F X3 = rr.square() - J - V - V;
+        F S1J = S1 * J;
+        F Y3 = rr * (V - X3) - S1J - S1J;
+        F Z3 = ((z + o.z).square() - Z1Z1 - Z2Z2) * H;
+        return Pt{X3, Y3, Z3};
+    }
+
+    Pt neg() const { return Pt{x, -y, z}; }
+
+    Pt mul_be(const uint8_t *k, size_t n) const {
+        Pt result = infinity();
+        for (size_t i = 0; i < n; i++) {
+            uint8_t byte = k[i];
+            for (int b = 7; b >= 0; b--) {
+                result = result.dbl();
+                if ((byte >> b) & 1) result = result.add(*this);
+            }
+        }
+        return result;
+    }
+
+    // affine (x, y); only valid when not infinity
+    void to_affine(F &ax, F &ay) const {
+        F zinv = z.inv();
+        F zinv2 = zinv.square();
+        ax = x * zinv2;
+        ay = y * zinv2 * zinv;
+    }
+};
+
+typedef Pt<Fp> G1;
+typedef Pt<Fp2> G2;
+
+static G1 G1_GEN;
+static G2 G2_GEN;
+static Fp B1;     // 4
+static Fp2 B2;    // 4(1+u)
+
+static bool g1_on_curve(const Fp &x, const Fp &y) {
+    return y.square() == x.square() * x + B1;
+}
+
+static bool g2_on_curve(const Fp2 &x, const Fp2 &y) {
+    return y.square() == x.square() * x + B2;
+}
+
+template <class P>
+static bool in_subgroup(const P &pt) {
+    return pt.mul_be(CURVE_ORDER_R, CURVE_ORDER_R_LEN).is_inf();
+}
+
+// ===========================================================================
+// Serialization (ZCash compressed format, mirrors curve.py)
+// ===========================================================================
+
+static void fp_to_bytes48(uint8_t out[48], const Fp &a) {
+    uint64_t c[6];
+    fp_canonical(c, a);
+    for (int i = 0; i < 6; i++) {
+        uint64_t limb = c[5 - i];
+        for (int b = 0; b < 8; b++) out[i * 8 + b] = (uint8_t)(limb >> (56 - 8 * b));
+    }
+}
+
+// returns false if value >= p
+static bool fp_from_bytes48(Fp &out, const uint8_t in[48]) {
+    fp raw;
+    for (int i = 0; i < 6; i++) {
+        uint64_t limb = 0;
+        for (int b = 0; b < 8; b++) limb = (limb << 8) | in[i * 8 + b];
+        raw.l[5 - i] = limb;
+    }
+    if (limbs_cmp(raw.l, P_LIMBS) >= 0) return false;
+    fp_to_mont(out.v, raw);
+    return true;
+}
+
+static void g1_serialize(uint8_t out[48], const G1 &pt) {
+    if (pt.is_inf()) {
+        memset(out, 0, 48);
+        out[0] = 0xC0;
+        return;
+    }
+    Fp x, y;
+    pt.to_affine(x, y);
+    fp_to_bytes48(out, x);
+    out[0] |= 0x80 | (fp_sgn_lex(y) ? 0x20 : 0);
+}
+
+static void g2_serialize(uint8_t out[96], const G2 &pt) {
+    if (pt.is_inf()) {
+        memset(out, 0, 96);
+        out[0] = 0xC0;
+        return;
+    }
+    Fp2 x, y;
+    pt.to_affine(x, y);
+    fp_to_bytes48(out, x.c1);
+    fp_to_bytes48(out + 48, x.c0);
+    bool s = y.c1.is_zero() ? fp_sgn_lex(y.c0) : fp_sgn_lex(y.c1);
+    out[0] |= 0x80 | (s ? 0x20 : 0);
+}
+
+// 0 = ok, nonzero = malformed.  Subgroup check NOT included.
+static int g1_deserialize(G1 &out, const uint8_t in[48]) {
+    int c_flag = (in[0] >> 7) & 1;
+    int i_flag = (in[0] >> 6) & 1;
+    int s_flag = (in[0] >> 5) & 1;
+    if (!c_flag) return 1;
+    if (i_flag) {
+        if (in[0] & 0x3F) return 2;
+        for (int i = 1; i < 48; i++)
+            if (in[i]) return 2;
+        out = G1::infinity();
+        return 0;
+    }
+    uint8_t buf[48];
+    memcpy(buf, in, 48);
+    buf[0] &= 0x1F;
+    Fp x;
+    if (!fp_from_bytes48(x, buf)) return 3;
+    Fp y2 = x.square() * x + B1;
+    Fp y;
+    if (!fp_sqrt(y, y2)) return 4;
+    if (fp_sgn_lex(y) != (bool)s_flag) y = -y;
+    out = G1{x, y, Fp::one()};
+    return 0;
+}
+
+static int g2_deserialize(G2 &out, const uint8_t in[96]) {
+    int c_flag = (in[0] >> 7) & 1;
+    int i_flag = (in[0] >> 6) & 1;
+    int s_flag = (in[0] >> 5) & 1;
+    if (!c_flag) return 1;
+    if (i_flag) {
+        if (in[0] & 0x3F) return 2;
+        for (int i = 1; i < 96; i++)
+            if (in[i]) return 2;
+        out = G2::infinity();
+        return 0;
+    }
+    uint8_t buf[48];
+    memcpy(buf, in, 48);
+    buf[0] &= 0x1F;
+    Fp x1, x0;
+    if (!fp_from_bytes48(x1, buf)) return 3;
+    if (!fp_from_bytes48(x0, in + 48)) return 3;
+    Fp2 x(x0, x1);
+    Fp2 y2 = x.square() * x + B2;
+    Fp2 y;
+    if (!fp2_sqrt(y, y2)) return 4;
+    bool cur = y.c1.is_zero() ? fp_sgn_lex(y.c0) : fp_sgn_lex(y.c1);
+    if (cur != (bool)s_flag) y = -y;
+    out = G2{x, y, Fp2::one()};
+    return 0;
+}
+
+// ===========================================================================
+// SHA-256 (from generated round constants) + expand_message_xmd
+// ===========================================================================
+
+struct Sha256 {
+    uint32_t h[8];
+    uint64_t len;
+    uint8_t buf[64];
+    size_t buflen;
+
+    Sha256() {
+        memcpy(h, SHA_H0, sizeof(h));
+        len = 0;
+        buflen = 0;
+    }
+    static inline uint32_t ror(uint32_t v, int r) { return (v >> r) | (v << (32 - r)); }
+
+    void block(const uint8_t *p) {
+        uint32_t w[64];
+        for (int i = 0; i < 16; i++)
+            w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+                   ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+        for (int i = 16; i < 64; i++) {
+            uint32_t s0 = ror(w[i - 15], 7) ^ ror(w[i - 15], 18) ^ (w[i - 15] >> 3);
+            uint32_t s1 = ror(w[i - 2], 17) ^ ror(w[i - 2], 19) ^ (w[i - 2] >> 10);
+            w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+        }
+        uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+        uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+        for (int i = 0; i < 64; i++) {
+            uint32_t s1 = ror(e, 6) ^ ror(e, 11) ^ ror(e, 25);
+            uint32_t ch = (e & f) ^ (~e & g);
+            uint32_t t1 = hh + s1 + ch + SHA_K[i] + w[i];
+            uint32_t s0 = ror(a, 2) ^ ror(a, 13) ^ ror(a, 22);
+            uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+            uint32_t t2 = s0 + maj;
+            hh = g; g = f; f = e; e = d + t1;
+            d = c; c = b; b = a; a = t1 + t2;
+        }
+        h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+        h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+    }
+
+    void update(const uint8_t *p, size_t n) {
+        len += n;
+        if (buflen) {
+            while (n && buflen < 64) {
+                buf[buflen++] = *p++;
+                n--;
+            }
+            if (buflen == 64) {
+                block(buf);
+                buflen = 0;
+            }
+        }
+        while (n >= 64) {
+            block(p);
+            p += 64;
+            n -= 64;
+        }
+        while (n) {
+            buf[buflen++] = *p++;
+            n--;
+        }
+    }
+
+    void final(uint8_t out[32]) {
+        uint64_t bits = len * 8;
+        uint8_t pad = 0x80;
+        update(&pad, 1);
+        uint8_t zero = 0;
+        while (buflen != 56) update(&zero, 1);
+        uint8_t lb[8];
+        for (int i = 0; i < 8; i++) lb[i] = (uint8_t)(bits >> (56 - 8 * i));
+        update(lb, 8);
+        for (int i = 0; i < 8; i++)
+            for (int b = 0; b < 4; b++) out[4 * i + b] = (uint8_t)(h[i] >> (24 - 8 * b));
+    }
+};
+
+// RFC 9380 §5.3.1 (SHA-256: b=32, s=64)
+static void expand_message_xmd(uint8_t *out, size_t len_in_bytes,
+                               const uint8_t *msg, size_t msg_len,
+                               const uint8_t *dst, size_t dst_len) {
+    if (dst_len > 255) dst_len = 255;  // callers reject earlier; never overflow
+    size_t ell = (len_in_bytes + 31) / 32;
+    uint8_t dst_prime[256];
+    memcpy(dst_prime, dst, dst_len);
+    dst_prime[dst_len] = (uint8_t)dst_len;
+    size_t dpl = dst_len + 1;
+
+    uint8_t b0[32];
+    {
+        Sha256 s;
+        uint8_t zpad[64] = {0};
+        s.update(zpad, 64);
+        s.update(msg, msg_len);
+        uint8_t lib[3] = {(uint8_t)(len_in_bytes >> 8), (uint8_t)len_in_bytes, 0};
+        s.update(lib, 3);
+        s.update(dst_prime, dpl);
+        s.final(b0);
+    }
+    uint8_t bi[32];
+    {
+        Sha256 s;
+        s.update(b0, 32);
+        uint8_t one = 1;
+        s.update(&one, 1);
+        s.update(dst_prime, dpl);
+        s.final(bi);
+    }
+    size_t off = 0;
+    for (size_t i = 1;; i++) {
+        size_t take = len_in_bytes - off < 32 ? len_in_bytes - off : 32;
+        memcpy(out + off, bi, take);
+        off += take;
+        if (i >= ell) break;
+        uint8_t tmp[32];
+        for (int j = 0; j < 32; j++) tmp[j] = b0[j] ^ bi[j];
+        Sha256 s;
+        s.update(tmp, 32);
+        uint8_t idx = (uint8_t)(i + 1);
+        s.update(&idx, 1);
+        s.update(dst_prime, dpl);
+        s.final(bi);
+    }
+}
+
+// reduce a 64-byte big-endian integer mod p (Montgomery form out)
+static Fp fp_from_bytes64_reduce(const uint8_t in[64]) {
+    // n = hi(16B) * 2^384 + lo(48B)
+    fp lo_raw, hi_raw;
+    memset(hi_raw.l, 0, sizeof(hi_raw.l));
+    // hi bytes in[0..15] are big-endian: in[15-k] is the k-th least
+    // significant byte, landing in limb k/8 at bit offset 8*(k%8)
+    for (int k = 0; k < 16; k++)
+        hi_raw.l[k / 8] |= (uint64_t)in[15 - k] << (8 * (k % 8));
+    for (int i = 0; i < 6; i++) {
+        uint64_t limb = 0;
+        for (int b = 0; b < 8; b++) limb = (limb << 8) | in[16 + i * 8 + b];
+        lo_raw.l[5 - i] = limb;
+    }
+    Fp lo, hi;
+    fp_to_mont(lo.v, lo_raw);  // valid for raw < 2^384 even if >= p
+    fp_to_mont(hi.v, hi_raw);
+    Fp shift(FP_RMODP_MONT);  // mont(2^384 mod p)
+    return hi * shift + lo;
+}
+
+// ===========================================================================
+// hash_to_curve G2 (RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO)
+// ===========================================================================
+
+static Fp2 SSWU_A_C, SSWU_B_C, SSWU_Z_C;
+static std::vector<Fp2> ISO_K1_C, ISO_K2_C, ISO_K3_C, ISO_K4_C;
+
+static void hash_to_field_fq2(Fp2 out[2], const uint8_t *msg, size_t msg_len,
+                              const uint8_t *dst, size_t dst_len) {
+    uint8_t uniform[256];  // count=2, m=2, L=64
+    expand_message_xmd(uniform, 256, msg, msg_len, dst, dst_len);
+    for (int i = 0; i < 2; i++) {
+        Fp e0 = fp_from_bytes64_reduce(uniform + 128 * i);
+        Fp e1 = fp_from_bytes64_reduce(uniform + 128 * i + 64);
+        out[i] = Fp2(e0, e1);
+    }
+}
+
+// simplified SWU onto E2' (mirrors hash_to_curve.py _sswu)
+static void sswu(Fp2 &x, Fp2 &y, const Fp2 &u) {
+    Fp2 z_u2 = SSWU_Z_C * u.square();
+    Fp2 tv = z_u2.square() + z_u2;
+    Fp2 x1;
+    if (tv.is_zero()) {
+        x1 = SSWU_B_C * (SSWU_Z_C * SSWU_A_C).inv();
+    } else {
+        x1 = (-SSWU_B_C) * SSWU_A_C.inv() * (Fp2::one() + tv.inv());
+    }
+    Fp2 gx1 = x1.square() * x1 + SSWU_A_C * x1 + SSWU_B_C;
+    Fp2 y1;
+    if (fp2_sqrt(y1, gx1)) {
+        x = x1;
+        y = y1;
+    } else {
+        Fp2 x2 = z_u2 * x1;
+        Fp2 gx2 = x2.square() * x2 + SSWU_A_C * x2 + SSWU_B_C;
+        Fp2 y2;
+        fp2_sqrt(y2, gx2);  // must succeed
+        x = x2;
+        y = y2;
+    }
+    if (fp2_sgn0(u) != fp2_sgn0(y)) y = -y;
+}
+
+static Fp2 horner(const std::vector<Fp2> &k, const Fp2 &x) {
+    Fp2 acc = k.back();
+    for (int i = (int)k.size() - 2; i >= 0; i--) acc = acc * x + k[i];
+    return acc;
+}
+
+static void iso_map(Fp2 &xo, Fp2 &yo, const Fp2 &x, const Fp2 &y) {
+    Fp2 xn = horner(ISO_K1_C, x);
+    Fp2 xd = horner(ISO_K2_C, x);
+    Fp2 yn = horner(ISO_K3_C, x);
+    Fp2 yd = horner(ISO_K4_C, x);
+    xo = xn * xd.inv();
+    yo = y * yn * yd.inv();
+}
+
+static G2 hash_to_g2(const uint8_t *msg, size_t msg_len,
+                     const uint8_t *dst, size_t dst_len) {
+    Fp2 u[2];
+    hash_to_field_fq2(u, msg, msg_len, dst, dst_len);
+    G2 q[2];
+    for (int i = 0; i < 2; i++) {
+        Fp2 xp, yp, xe, ye;
+        sswu(xp, yp, u[i]);
+        iso_map(xe, ye, xp, yp);
+        q[i] = G2{xe, ye, Fp2::one()};
+    }
+    G2 r = q[0].add(q[1]);
+    return r.mul_be(H_EFF_G2, H_EFF_G2_LEN);
+}
+
+static const uint8_t DST_POP[] = "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_";
+static const size_t DST_POP_LEN = sizeof(DST_POP) - 1;
+
+// ===========================================================================
+// Pairing
+// ===========================================================================
+
+// Line through points on the twist, evaluated at the untwisted G1 point and
+// folded into a sparse Fp12: with untwist (x,y) -> (x/w^2, y/w^3) and the
+// whole line scaled by xi (an Fp2 constant the final exponentiation kills):
+//   l = (-xi*yP)  +  (yT - lambda*xT) * v*w  +  (lambda*xP) * v^2*w
+// where lambda is the slope in Fp2.  Basis: Fp12 c0=(w^0,w^2,w^4), c1=(w^1,w^3,w^5).
+static Fp12 sparse_line(const Fp2 &A, const Fp2 &B, const Fp2 &C) {
+    return Fp12(Fp6(A, Fp2::zero(), Fp2::zero()), Fp6(Fp2::zero(), B, C));
+}
+
+// f_{|x|,Q}(P) conjugated (BLS parameter is negative), mirrors pairing.py
+// miller_loop but runs on the twist with affine steps.
+static Fp12 miller_loop(const G1 &p, const G2 &q) {
+    if (p.is_inf() || q.is_inf()) return Fp12::one();
+    Fp xP, yP;
+    p.to_affine(xP, yP);
+    Fp2 xQ, yQ;
+    q.to_affine(xQ, yQ);
+
+    Fp negyP = -yP;
+    Fp2 A(negyP, negyP);  // -xi*yP = -(yP + yP*u)
+
+    Fp2 xT = xQ, yT = yQ;
+    Fp12 f = Fp12::one();
+
+    for (int i = 62; i >= 0; i--) {
+        // doubling step: lambda = 3 xT^2 / (2 yT)
+        Fp2 xT2 = xT.square();
+        Fp2 lam = (xT2 + xT2 + xT2) * (yT + yT).inv();
+        Fp2 B = yT - lam * xT;
+        Fp2 C = lam.scale(xP);
+        f = f.square() * sparse_line(A, B, C);
+        Fp2 x3 = lam.square() - xT - xT;
+        yT = lam * (xT - x3) - yT;
+        xT = x3;
+
+        if ((ATE_LOOP >> i) & 1) {
+            // addition step: lambda = (yQ - yT) / (xQ - xT)
+            Fp2 lam2 = (yQ - yT) * (xQ - xT).inv();
+            Fp2 B2c = yQ - lam2 * xQ;
+            Fp2 C2 = lam2.scale(xP);
+            f = f * sparse_line(A, B2c, C2);
+            Fp2 x3a = lam2.square() - xT - xQ;
+            yT = lam2 * (xT - x3a) - yT;
+            xT = x3a;
+        }
+    }
+    return f.conjugate();
+}
+
+static Fp12 final_exponentiation(const Fp12 &f) {
+    Fp12 t = f.conjugate() * f.inv();    // f^(p^6 - 1)
+    t = frobenius_p2(t) * t;             // ^(p^2 + 1)
+    return pow_be(t, EXP_HARD, EXP_HARD_LEN, Fp12::one());
+}
+
+// ===========================================================================
+// init
+// ===========================================================================
+
+static void bls_init_impl();
+
+// thread-safe one-time init (C++ guarantees a single racing-free run of the
+// function-local static initializer; ctypes calls drop the GIL)
+static void bls_init() {
+    static const bool done = []() {
+        bls_init_impl();
+        return true;
+    }();
+    (void)done;
+}
+
+static void bls_init_impl() {
+    memcpy(FP_R2.l, R2_MONT, sizeof(FP_R2.l));
+    memcpy(FP_ONE.l, R_MONT, sizeof(FP_ONE.l));
+    {
+        fp r_raw;
+        memcpy(r_raw.l, R_MONT, sizeof(r_raw.l));
+        fp_to_mont(FP_RMODP_MONT, r_raw);
+    }
+    G1_GEN = G1{fp_from_limbs(G1_GEN_X), fp_from_limbs(G1_GEN_Y), Fp::one()};
+    G2_GEN = G2{fp2_from_limbs(G2_GEN_X_C0, G2_GEN_X_C1),
+                fp2_from_limbs(G2_GEN_Y_C0, G2_GEN_Y_C1), Fp2::one()};
+    B1 = fp_from_limbs(B_G1);
+    B2 = fp2_from_limbs(B_G2_C0, B_G2_C1);
+    SSWU_A_C = fp2_from_limbs(SSWU_A_C0, SSWU_A_C1);
+    SSWU_B_C = fp2_from_limbs(SSWU_B_C0, SSWU_B_C1);
+    SSWU_Z_C = fp2_from_limbs(SSWU_Z_C0, SSWU_Z_C1);
+    FQ2_SQRT_ADJ[0] = fp2_from_limbs(FQ2_SQRT_ADJ0_C0, FQ2_SQRT_ADJ0_C1);
+    FQ2_SQRT_ADJ[1] = fp2_from_limbs(FQ2_SQRT_ADJ1_C0, FQ2_SQRT_ADJ1_C1);
+    FQ2_SQRT_ADJ[2] = fp2_from_limbs(FQ2_SQRT_ADJ2_C0, FQ2_SQRT_ADJ2_C1);
+    FQ2_SQRT_ADJ[3] = fp2_from_limbs(FQ2_SQRT_ADJ3_C0, FQ2_SQRT_ADJ3_C1);
+    ISO_K1_C = {fp2_from_limbs(ISO_K1_0_C0, ISO_K1_0_C1), fp2_from_limbs(ISO_K1_1_C0, ISO_K1_1_C1),
+                fp2_from_limbs(ISO_K1_2_C0, ISO_K1_2_C1), fp2_from_limbs(ISO_K1_3_C0, ISO_K1_3_C1)};
+    ISO_K2_C = {fp2_from_limbs(ISO_K2_0_C0, ISO_K2_0_C1), fp2_from_limbs(ISO_K2_1_C0, ISO_K2_1_C1),
+                fp2_from_limbs(ISO_K2_2_C0, ISO_K2_2_C1)};
+    ISO_K3_C = {fp2_from_limbs(ISO_K3_0_C0, ISO_K3_0_C1), fp2_from_limbs(ISO_K3_1_C0, ISO_K3_1_C1),
+                fp2_from_limbs(ISO_K3_2_C0, ISO_K3_2_C1), fp2_from_limbs(ISO_K3_3_C0, ISO_K3_3_C1)};
+    ISO_K4_C = {fp2_from_limbs(ISO_K4_0_C0, ISO_K4_0_C1), fp2_from_limbs(ISO_K4_1_C0, ISO_K4_1_C1),
+                fp2_from_limbs(ISO_K4_2_C0, ISO_K4_2_C1), fp2_from_limbs(ISO_K4_3_C0, ISO_K4_3_C1)};
+    FROB2_COEF[0] = fp_from_limbs(FROB2_G0);
+    FROB2_COEF[1] = fp_from_limbs(FROB2_G1);
+    FROB2_COEF[2] = fp_from_limbs(FROB2_G2);
+    FROB2_COEF[3] = fp_from_limbs(FROB2_G3);
+    FROB2_COEF[4] = fp_from_limbs(FROB2_G4);
+    FROB2_COEF[5] = fp_from_limbs(FROB2_G5);
+}
+
+// ===========================================================================
+// helpers for the ciphersuite
+// ===========================================================================
+
+// deserialize + subgroup-check; rc: 0 ok, nonzero bad
+static int load_pubkey(G1 &out, const uint8_t pk[48]) {
+    int rc = g1_deserialize(out, pk);
+    if (rc) return rc;
+    if (!out.is_inf() && !in_subgroup(out)) return 5;
+    return 0;
+}
+
+static int load_signature(G2 &out, const uint8_t sig[96]) {
+    int rc = g2_deserialize(out, sig);
+    if (rc) return rc;
+    if (!out.is_inf() && !in_subgroup(out)) return 5;
+    return 0;
+}
+
+// ===========================================================================
+// exported C ABI (all return 1=true/ok, 0=false/error unless noted)
+// ===========================================================================
+
+extern "C" {
+
+int bls_sk_to_pk(const uint8_t sk[32], uint8_t out[48]) {
+    bls_init();
+    G1 pk = G1_GEN.mul_be(sk, 32);
+    g1_serialize(out, pk);
+    return 1;
+}
+
+int bls_sign(const uint8_t sk[32], const uint8_t *msg, size_t msg_len, uint8_t out[96]) {
+    bls_init();
+    G2 h = hash_to_g2(msg, msg_len, DST_POP, DST_POP_LEN);
+    G2 sig = h.mul_be(sk, 32);
+    g2_serialize(out, sig);
+    return 1;
+}
+
+int bls_key_validate(const uint8_t pk[48]) {
+    bls_init();
+    G1 pt;
+    if (load_pubkey(pt, pk)) return 0;
+    return pt.is_inf() ? 0 : 1;
+}
+
+int bls_verify(const uint8_t pk[48], const uint8_t *msg, size_t msg_len,
+               const uint8_t sig[96]) {
+    bls_init();
+    G1 pkpt;
+    G2 sigpt;
+    if (load_pubkey(pkpt, pk)) return 0;
+    if (pkpt.is_inf()) return 0;
+    if (load_signature(sigpt, sig)) return 0;
+    G2 h = hash_to_g2(msg, msg_len, DST_POP, DST_POP_LEN);
+    Fp12 f = miller_loop(pkpt, h) * miller_loop(G1_GEN.neg(), sigpt);
+    return final_exponentiation(f) == Fp12::one() ? 1 : 0;
+}
+
+int bls_aggregate(const uint8_t *sigs, size_t n, uint8_t out[96]) {
+    bls_init();
+    if (n == 0) return 0;
+    G2 acc = G2::infinity();
+    for (size_t i = 0; i < n; i++) {
+        G2 s;
+        if (load_signature(s, sigs + 96 * i)) return 0;
+        acc = acc.add(s);
+    }
+    g2_serialize(out, acc);
+    return 1;
+}
+
+int bls_aggregate_pks(const uint8_t *pks, size_t n, uint8_t out[48]) {
+    bls_init();
+    if (n == 0) return 0;
+    G1 acc = G1::infinity();
+    for (size_t i = 0; i < n; i++) {
+        G1 p;
+        if (load_pubkey(p, pks + 48 * i)) return 0;
+        if (p.is_inf()) return 0;
+        acc = acc.add(p);
+    }
+    g1_serialize(out, acc);
+    return 1;
+}
+
+int bls_fast_aggregate_verify(const uint8_t *pks, size_t n, const uint8_t *msg,
+                              size_t msg_len, const uint8_t sig[96]) {
+    bls_init();
+    if (n == 0) return 0;
+    G2 sigpt;
+    if (load_signature(sigpt, sig)) return 0;
+    G1 agg = G1::infinity();
+    for (size_t i = 0; i < n; i++) {
+        G1 p;
+        if (load_pubkey(p, pks + 48 * i)) return 0;
+        if (p.is_inf()) return 0;
+        agg = agg.add(p);
+    }
+    G2 h = hash_to_g2(msg, msg_len, DST_POP, DST_POP_LEN);
+    Fp12 f = miller_loop(agg, h) * miller_loop(G1_GEN.neg(), sigpt);
+    return final_exponentiation(f) == Fp12::one() ? 1 : 0;
+}
+
+// msgs: concatenated message bytes; msg_lens[i] the length of message i
+int bls_aggregate_verify(const uint8_t *pks, size_t n, const uint8_t *msgs,
+                         const size_t *msg_lens, const uint8_t sig[96]) {
+    bls_init();
+    if (n == 0) return 0;
+    G2 sigpt;
+    if (load_signature(sigpt, sig)) return 0;
+    Fp12 f = Fp12::one();
+    size_t off = 0;
+    for (size_t i = 0; i < n; i++) {
+        G1 p;
+        if (load_pubkey(p, pks + 48 * i)) return 0;
+        if (p.is_inf()) return 0;
+        G2 h = hash_to_g2(msgs + off, msg_lens[i], DST_POP, DST_POP_LEN);
+        off += msg_lens[i];
+        f = f * miller_loop(p, h);
+    }
+    f = f * miller_loop(G1_GEN.neg(), sigpt);
+    return final_exponentiation(f) == Fp12::one() ? 1 : 0;
+}
+
+// test/diagnostic exports ---------------------------------------------------
+
+int bls_hash_to_g2(const uint8_t *msg, size_t msg_len, const uint8_t *dst,
+                   size_t dst_len, uint8_t out[96]) {
+    bls_init();
+    if (dst_len > 255) return 0;  // RFC 9380: DST must be <= 255 bytes
+    G2 h = hash_to_g2(msg, msg_len, dst, dst_len);
+    g2_serialize(out, h);
+    return 1;
+}
+
+int bls_initialize() {
+    bls_init();
+    return 1;
+}
+
+// e(P, Q) -> 12 canonical 48-byte big-endian Fp values, order:
+// (c0|c1) x (c0,c1,c2 of Fp6) x (c0,c1 of Fp2)
+int bls_pairing(const uint8_t p48[48], const uint8_t q96[96], uint8_t out[576]) {
+    bls_init();
+    G1 p;
+    G2 q;
+    if (load_pubkey(p, p48)) return 0;
+    if (load_signature(q, q96)) return 0;
+    Fp12 f = final_exponentiation(miller_loop(p, q));
+    const Fp2 *coeffs[6] = {&f.c0.c0, &f.c0.c1, &f.c0.c2, &f.c1.c0, &f.c1.c1, &f.c1.c2};
+    for (int i = 0; i < 6; i++) {
+        fp_to_bytes48(out + 96 * i, coeffs[i]->c0);
+        fp_to_bytes48(out + 96 * i + 48, coeffs[i]->c1);
+    }
+    return 1;
+}
+
+int bls_sha256(const uint8_t *msg, size_t n, uint8_t out[32]) {
+    Sha256 s;
+    s.update(msg, n);
+    s.final(out);
+    return 1;
+}
+
+}  // extern "C"

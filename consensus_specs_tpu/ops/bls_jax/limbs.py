@@ -1,8 +1,8 @@
 """Fq (BLS12-381 base field) arithmetic on 26-bit limb lanes in JAX.
 
-Representation: an Fq element is a ``[..., 15]`` **int64** array of
-little-endian 26-bit limbs, value = sum(limb[i] << 26*i), held in
-Montgomery form (a*R mod p, R = 2^390).
+Representation: an Fq element is a ``[..., N_LIMBS]`` (= 16) **int64**
+array of little-endian 26-bit limbs, value = sum(limb[i] << 26*i), held
+in Montgomery form (a*R mod p, R = 2^416).
 
 Lazy-reduction design (the TPU-native shape — lanes with headroom, not
 carry chains):
@@ -16,10 +16,10 @@ carry chains):
     ``canonical()`` first.
 
 Overflow audit for ``mul`` (int64):
-  schoolbook product limbs: <= 15 * 2^29 * 2^29 = 2^61.9;
-  REDC adds m_i * p limbs (<= 15 * 2^52 = 2^55.9) and carries (< 2^37):
-  total < 2^62.5 < 2^63.  REDC exactness needs |a*b| < R*p: worst
-  (36p)^2 = 1296 p^2 << 2^390 p.  After REDC the value lies in (-p, 2p);
+  schoolbook product limbs: <= 16 * 2^29 * 2^29 = 2^62;
+  REDC adds m_i * p limbs (<= 16 * 2^52 = 2^56) and carries (< 2^37):
+  total < 2^62.6 < 2^63.  REDC exactness needs |a*b| < R*p: worst
+  (36p)^2 = 1296 p^2 << 2^416 p.  After REDC the value lies in (-p, 2p);
   the tail adds p and carry-propagates, giving (0, 3p) with canonical
   digits.
 
@@ -50,8 +50,8 @@ N0INV_INT = (-pow(P_INT, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
 
 
 def int_to_limbs(x: int) -> np.ndarray:
-    """Host: python int in [0, 2^390) -> [15] int64 limb array (plain
-    value, NOT Montgomery).  p itself is a valid input."""
+    """Host: python int in [0, 2^416) -> [N_LIMBS] int64 limb array
+    (plain value, NOT Montgomery).  p itself is a valid input."""
     assert 0 <= x < (1 << R_BITS)
     out = np.zeros(N_LIMBS, dtype=np.int64)
     for i in range(N_LIMBS):
@@ -78,7 +78,7 @@ _N0INV = jnp.int64(N0INV_INT)
 _MASK = jnp.int64(MASK)
 _B = LIMB_BITS
 
-# p shifted to offset i inside a 30-limb window, one constant per REDC step
+# p shifted to offset i inside a 2*N_LIMBS-limb window, one constant per REDC step
 _P_SHIFTED = np.zeros((N_LIMBS, 2 * N_LIMBS), dtype=np.int64)
 for _i in range(N_LIMBS):
     _P_SHIFTED[_i, _i:_i + N_LIMBS] = P_LIMBS
@@ -120,8 +120,8 @@ def double(a):
 def renorm(a):
     """Digit renormalization for lazily-accumulated elements: signed
     carry propagation with NO offset — the represented value is unchanged
-    (and may be negative).  Limbs 0..14 become canonical in [0, 2^26);
-    limb 15 absorbs the remaining signed magnitude (tiny: |value| < 2^20*p
+    (and may be negative).  Limbs 0..N-2 become canonical in [0, 2^26);
+    the top limb absorbs the remaining signed magnitude (tiny: |value| < 2^20*p
     implies |top| < 2^32).  Keeps schoolbook digit bounds without
     inflating values — ``mul`` accepts signed operands natively."""
     digits = []
@@ -147,15 +147,15 @@ def mul(a, b):
     b = jnp.broadcast_to(b, shape)
 
     # schoolbook product via padded outer rows + anti-diagonal gather-sum
-    outer = a[..., :, None] * b[..., None, :]                  # [..., 15, 15]
+    outer = a[..., :, None] * b[..., None, :]                  # [..., N, N]
     padded = jnp.concatenate(
         [outer, jnp.zeros(shape[:-1] + (N_LIMBS, N_LIMBS), jnp.int64)],
-        axis=-1)                                               # [..., 15, 30]
+        axis=-1)                                               # [..., N, 2N]
     idx = jnp.broadcast_to(_CONV_IDX_J, shape[:-1] + (N_LIMBS, 2 * N_LIMBS))
     rolled = jnp.take_along_axis(padded, idx.astype(jnp.int64), axis=-1)
-    T = jnp.sum(rolled, axis=-2)                               # [..., 30]
+    T = jnp.sum(rolled, axis=-2)                               # [..., 2N]
 
-    # REDC: clear limbs 0..14; static-shift constant adds, no scatters
+    # REDC: clear limbs 0..N-1; static-shift constant adds, no scatters
     for i in range(N_LIMBS):
         m = ((T[..., i] & _MASK) * _N0INV) & _MASK
         T = T + m[..., None] * _P_SHIFTED_J[i]

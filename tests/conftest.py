@@ -43,7 +43,12 @@ def pytest_addoption(parser):
 
 
 def pytest_configure(config):
+    from consensus_specs_tpu.crypto import bls
     from consensus_specs_tpu.testing import context
+
+    # fast host BLS (native C++) when the toolchain can build it, like the
+    # reference's CI running under the milagro backend
+    bls.use_fastest()
 
     context.DEFAULT_TEST_PRESET = config.getoption("--preset")
     forks = config.getoption("--fork")

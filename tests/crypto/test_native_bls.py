@@ -1,0 +1,166 @@
+"""Differential tests: native C++ BLS backend vs the pure-Python oracle.
+
+The oracle (crypto/bls/*.py) is itself pinned by RFC 9380 vectors and PoP
+semantics tests from round 1; these tests pin the native backend to it
+bit-for-bit, including edge cases the Verify-family contract requires
+(reference behavior: eth2spec/utils/bls.py:47-74 — malformed input is
+invalid, never fatal).
+"""
+import hashlib
+
+import pytest
+
+try:
+    from consensus_specs_tpu.crypto.bls import native
+except ImportError as exc:  # toolchain missing — report, don't hide
+    pytest.skip(f"native BLS unavailable: {exc}", allow_module_level=True)
+
+from consensus_specs_tpu.crypto.bls import ciphersuite as py
+from consensus_specs_tpu.crypto.bls.curve import (
+    g1_generator,
+    g2_generator,
+    g1_to_bytes,
+    g2_to_bytes,
+)
+from consensus_specs_tpu.crypto.bls.hash_to_curve import DST_G2_POP, hash_to_g2
+from consensus_specs_tpu.crypto.bls.pairing import pairing
+
+SKS = [1, 2, 3, 0x1234, 0xDEADBEEF, 2**200 + 17]
+MSGS = [b"", b"a", b"hello consensus", b"\x00" * 32, bytes(range(100))]
+
+
+def fq12_to_bytes(f) -> bytes:
+    coeffs = [f.c0.c0, f.c0.c1, f.c0.c2, f.c1.c0, f.c1.c1, f.c1.c2]
+    out = b""
+    for c in coeffs:
+        out += c.c0.to_bytes(48, "big") + c.c1.to_bytes(48, "big")
+    return out
+
+
+def test_sha256_matches_hashlib():
+    for probe in [b"", b"abc", b"x" * 1000, bytes(range(256)) * 3]:
+        assert native.sha256(probe) == hashlib.sha256(probe).digest()
+
+
+def test_sk_to_pk_matches_oracle():
+    for sk in SKS:
+        assert native.SkToPk(sk) == py.SkToPk(sk)
+
+
+def test_sk_range_rejected():
+    from consensus_specs_tpu.crypto.bls.fields import R
+
+    for bad in [0, R, R + 5]:
+        with pytest.raises(ValueError):
+            native.SkToPk(bad)
+
+
+def test_hash_to_g2_matches_oracle():
+    for msg in MSGS:
+        expected = g2_to_bytes(hash_to_g2(msg, DST_G2_POP))
+        assert native.hash_to_g2_compressed(msg, DST_G2_POP) == expected
+
+
+def test_hash_to_g2_rfc9380_vector():
+    # RFC 9380 §J.10.1 (BLS12381G2_XMD:SHA-256_SSWU_RO_), msg="abc"
+    dst = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+    expected = g2_to_bytes(hash_to_g2(b"abc", dst))
+    assert native.hash_to_g2_compressed(b"abc", dst) == expected
+
+
+def test_sign_matches_oracle():
+    for sk in SKS[:3]:
+        for msg in MSGS[:3]:
+            assert native.Sign(sk, msg) == py.Sign(sk, msg)
+
+
+def test_pairing_matches_oracle():
+    p = g1_generator()
+    q = g2_generator()
+    expected = fq12_to_bytes(pairing(p, q))
+    got = native.pairing_bytes(g1_to_bytes(p), g2_to_bytes(q))
+    assert got == expected
+
+
+def test_pairing_bilinear_native():
+    # e(2P, Q) == e(P, 2Q) without any oracle in the loop
+    p, q = g1_generator(), g2_generator()
+    lhs = native.pairing_bytes(g1_to_bytes(p.mul(2)), g2_to_bytes(q))
+    rhs = native.pairing_bytes(g1_to_bytes(p), g2_to_bytes(q.mul(2)))
+    assert lhs == rhs
+
+
+def test_verify_roundtrip():
+    sk = 777
+    pk = native.SkToPk(sk)
+    msg = b"attestation data root"
+    sig = native.Sign(sk, msg)
+    assert native.Verify(pk, msg, sig)
+    assert not native.Verify(pk, b"tampered", sig)
+    assert not native.Verify(pk, msg, native.Sign(778, msg))
+
+
+def test_key_validate():
+    assert native.KeyValidate(native.SkToPk(42))
+    assert not native.KeyValidate(b"\xc0" + b"\x00" * 47)  # infinity
+    assert not native.KeyValidate(b"\x00" * 48)  # no compression flag
+    assert not native.KeyValidate(b"\xff" * 48)  # x >= p
+    assert not native.KeyValidate(b"\x99" * 48)  # junk
+
+
+def test_verify_malformed_inputs_false_not_fatal():
+    sk = 9
+    pk = native.SkToPk(sk)
+    sig = native.Sign(sk, b"m")
+    assert not native.Verify(b"\x00" * 48, b"m", sig)
+    assert not native.Verify(pk, b"m", b"\x00" * 96)
+    assert not native.Verify(pk, b"m", b"\xff" * 96)
+    assert not native.Verify(b"", b"m", sig)
+    # infinity pubkey is rejected even with an infinity signature
+    assert not native.Verify(b"\xc0" + b"\x00" * 47, b"m", native.G2_POINT_AT_INFINITY)
+
+
+def test_aggregate_matches_oracle():
+    msg = b"same message"
+    sigs = [native.Sign(sk, msg) for sk in SKS[:4]]
+    assert native.Aggregate(sigs) == py.Aggregate(sigs)
+    with pytest.raises(ValueError):
+        native.Aggregate([])
+
+
+def test_aggregate_pks_matches_oracle():
+    pks = [native.SkToPk(sk) for sk in SKS[:4]]
+    assert native.AggregatePKs(pks) == py.AggregatePKs(pks)
+    with pytest.raises(ValueError):
+        native.AggregatePKs([])
+
+
+def test_fast_aggregate_verify():
+    msg = b"sync committee root"
+    sks = SKS[:4]
+    pks = [native.SkToPk(sk) for sk in sks]
+    agg = native.Aggregate([native.Sign(sk, msg) for sk in sks])
+    assert native.FastAggregateVerify(pks, msg, agg)
+    assert not native.FastAggregateVerify(pks, b"other", agg)
+    assert not native.FastAggregateVerify(pks[:3], msg, agg)
+    assert not native.FastAggregateVerify([], msg, agg)
+    # infinity signature with empty-sum pubkeys is still rejected on n=0
+    assert not native.FastAggregateVerify([], msg, native.G2_POINT_AT_INFINITY)
+
+
+def test_aggregate_verify_distinct_messages():
+    sks = SKS[:3]
+    msgs = [b"m1", b"m2-longer", b""]
+    pks = [native.SkToPk(sk) for sk in sks]
+    agg = native.Aggregate([native.Sign(sk, m) for sk, m in zip(sks, msgs)])
+    assert native.AggregateVerify(pks, msgs, agg)
+    assert not native.AggregateVerify(pks, [b"m1", b"m2-longer", b"x"], agg)
+    assert not native.AggregateVerify(pks, msgs[:2], agg)
+    assert not native.AggregateVerify([], [], agg)
+
+
+def test_cross_backend_verify():
+    """Signatures produced by either backend verify under the other."""
+    sk, msg = 31337, b"cross-check"
+    assert py.Verify(py.SkToPk(sk), msg, native.Sign(sk, msg))
+    assert native.Verify(native.SkToPk(sk), msg, py.Sign(sk, msg))

@@ -173,6 +173,11 @@ def test_bls_active_stubbing():
 
 
 def test_backend_selector():
-    assert bls.backend_name() == "python"
-    bls.use_python()
-    assert bls.backend_name() == "python"
+    prev = bls.backend_name()
+    try:
+        bls.use_python()
+        assert bls.backend_name() == "python"
+        bls.use_fastest()
+        assert bls.backend_name() in ("native", "python")
+    finally:
+        bls.use_backend(prev)
