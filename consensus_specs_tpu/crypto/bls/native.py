@@ -151,7 +151,11 @@ def Aggregate(signatures: Sequence[bytes]) -> bytes:
     flat = b"".join(sigs)
     out = (ctypes.c_uint8 * 96)()
     if not _lib.bls_aggregate(_buf(flat), len(sigs), out):
-        raise ValueError("invalid signature in aggregate")
+        # reproduce the oracle's exact exception type (DeserializationError
+        # vs ValueError) so backend choice never changes caller behavior
+        from . import ciphersuite as _py
+
+        return _py.Aggregate(sigs)
     return bytes(out)
 
 
@@ -164,7 +168,9 @@ def AggregatePKs(pubkeys: Sequence[bytes]) -> bytes:
     flat = b"".join(pks)
     out = (ctypes.c_uint8 * 48)()
     if not _lib.bls_aggregate_pks(_buf(flat), len(pks), out):
-        raise ValueError("invalid pubkey in aggregate")
+        from . import ciphersuite as _py
+
+        return _py.AggregatePKs(pks)
     return bytes(out)
 
 

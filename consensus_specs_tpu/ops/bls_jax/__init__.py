@@ -1,0 +1,226 @@
+"""Batched TPU BLS backend — the "#1 TPU target" of SURVEY §2.7.
+
+Splits BLS verification the TPU way:
+  * host (this module): byte deserialization + subgroup checks (cached),
+    pubkey aggregation, message hashing to G2 — tiny, irregular, branchy
+    work that XLA has no business compiling;
+  * device (pairing.py): the pairing-product check — thousands of
+    Montgomery limb multiplies per verification, batched over B
+    independent verifications as [K, B, ...] limb tensors so the MXU sees
+    large regular contractions instead of one sequential bigint chain.
+
+The batch APIs are the point: a block carries <= 128 attestations
+(phase0/beacon-chain.md:1807-1833 FastAggregateVerify per attestation) and
+a sync aggregate of 512 pubkeys (altair/beacon-chain.md:540-547);
+``batch_fast_aggregate_verify`` decides ALL of them in one device call.
+
+The ciphersuite-compatible scalar API (Verify/FastAggregateVerify/...)
+lets ``bls.use_jax()`` register this module as a drop-in backend; Sign /
+SkToPk / aggregation delegate to the fastest host backend (native C++,
+falling back to the pure-Python oracle) since signing is not a batch
+workload.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax as _jax
+
+# The pairing kernels are compile-heavy (~minutes per batch shape on CPU);
+# a persistent compilation cache makes that a once-per-machine cost.  Users
+# can override via JAX_COMPILATION_CACHE_DIR or their own config.
+if _jax.config.jax_compilation_cache_dir is None and \
+        "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    _cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))), ".cache", "jax")
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except OSError:
+        pass  # read-only tree: in-memory cache only
+
+from consensus_specs_tpu.crypto.bls import ciphersuite as _py
+from consensus_specs_tpu.crypto.bls.curve import (
+    DeserializationError,
+    Point,
+    g1_generator,
+    g1_infinity,
+    pubkey_to_point,
+    signature_to_point,
+)
+from consensus_specs_tpu.crypto.bls.hash_to_curve import DST_G2_POP, hash_to_g2
+
+from . import limbs, pairing, tower  # noqa: F401  (tower re-exported for tests)
+
+try:  # fast host path for hashing/signing/aggregation
+    from consensus_specs_tpu.crypto.bls import native as _host
+except ImportError:
+    _host = None
+
+G2_POINT_AT_INFINITY = _py.G2_POINT_AT_INFINITY
+
+# host-side scalar delegates --------------------------------------------------
+
+_delegate = _host if _host is not None else _py
+
+Sign = _delegate.Sign
+SkToPk = _delegate.SkToPk
+KeyValidate = _delegate.KeyValidate
+Aggregate = _delegate.Aggregate
+AggregatePKs = _delegate.AggregatePKs
+
+
+def _hash_to_g2_point(message: bytes) -> Point:
+    """H(msg) as an oracle curve point, via the native C++ hasher when
+    available (compressed-bytes round trip), else the Python pipeline."""
+    if _host is not None:
+        from consensus_specs_tpu.crypto.bls.curve import g2_from_bytes
+
+        return g2_from_bytes(_host.hash_to_g2_compressed(message, DST_G2_POP))
+    return hash_to_g2(bytes(message), DST_G2_POP)
+
+
+# marshalling -----------------------------------------------------------------
+
+
+def _g1_coords(pt: Point) -> Tuple[np.ndarray, np.ndarray]:
+    x, y = pt.to_affine()
+    return limbs.host_to_mont(x.n), limbs.host_to_mont(y.n)
+
+
+def _g2_coords(pt: Point) -> Tuple[np.ndarray, np.ndarray]:
+    x, y = pt.to_affine()
+    return (
+        np.stack([limbs.host_to_mont(x.c0), limbs.host_to_mont(x.c1)]),
+        np.stack([limbs.host_to_mont(y.c0), limbs.host_to_mont(y.c1)]),
+    )
+
+
+_NEG_G1_GEN = -g1_generator()
+
+
+def _check_pairs_batch(
+    pairs_per_item: Sequence[Sequence[Tuple[Point, Point]]],
+) -> np.ndarray:
+    """prod e(P_k, Q_k) == 1 for each item; every item must carry the same
+    number K of pairs (the verify family always yields K = 2)."""
+    B = len(pairs_per_item)
+    K = len(pairs_per_item[0])
+    assert all(len(ps) == K for ps in pairs_per_item)
+    px = np.zeros((K, B, limbs.N_LIMBS), dtype=np.int64)
+    py = np.zeros((K, B, limbs.N_LIMBS), dtype=np.int64)
+    qx = np.zeros((K, B, 2, limbs.N_LIMBS), dtype=np.int64)
+    qy = np.zeros((K, B, 2, limbs.N_LIMBS), dtype=np.int64)
+    infinity_mask = np.zeros((K, B), dtype=bool)
+    for b, ps in enumerate(pairs_per_item):
+        for k, (p, q) in enumerate(ps):
+            if p.is_infinity() or q.is_infinity():
+                infinity_mask[k, b] = True  # whole batch falls back below
+                continue
+            px[k, b], py[k, b] = _g1_coords(p)
+            qx[k, b], qy[k, b] = _g2_coords(q)
+    if infinity_mask.any():
+        # rare path (infinity points, e.g. infinity signatures): decide on
+        # the host oracle — batching machinery would only add shapes
+        from consensus_specs_tpu.crypto.bls.pairing import pairings_are_identity
+
+        return np.array(
+            [pairings_are_identity(ps) for ps in pairs_per_item], dtype=bool
+        )
+    return np.asarray(pairing.pairs_product_is_one(px, py, qx, qy))
+
+
+# batch APIs ------------------------------------------------------------------
+
+
+def batch_fast_aggregate_verify(
+    pubkeys_lists: Sequence[Sequence[bytes]],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> List[bool]:
+    """One device call deciding FastAggregateVerify for B items.
+
+    Malformed/out-of-subgroup inputs, infinity pubkeys, and empty pubkey
+    lists yield False for that item (never an exception), mirroring the
+    selector's Verify-family contract (crypto/bls/__init__.py)."""
+    B = len(pubkeys_lists)
+    assert len(messages) == len(signatures) == B
+    results = np.zeros(B, dtype=bool)
+    todo: List[Tuple[int, List[Tuple[Point, Point]]]] = []
+    for b in range(B):
+        try:
+            if len(pubkeys_lists[b]) == 0:
+                continue
+            sig = signature_to_point(bytes(signatures[b]))
+            agg = g1_infinity()
+            ok = True
+            for pk_bytes in pubkeys_lists[b]:
+                pk = pubkey_to_point(bytes(pk_bytes))
+                if pk.is_infinity():
+                    ok = False
+                    break
+                agg = agg + pk
+            if not ok:
+                continue
+            h = _hash_to_g2_point(bytes(messages[b]))
+            todo.append((b, [(agg, h), (_NEG_G1_GEN, sig)]))
+        except (DeserializationError, ValueError):
+            continue
+    if todo:
+        # pad to a power-of-two bucket (min 2) by repeating the first item:
+        # bounded set of compiled batch shapes, shared across callers
+        n = len(todo)
+        bucket = 2
+        while bucket < n:
+            bucket *= 2
+        padded = [pairs for _, pairs in todo]
+        padded.extend([todo[0][1]] * (bucket - n))
+        verdicts = _check_pairs_batch(padded)
+        for (b, _), v in zip(todo, verdicts[:n]):
+            results[b] = bool(v)
+    return list(results)
+
+
+def batch_verify(
+    pubkeys: Sequence[bytes],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> List[bool]:
+    """One device call deciding single-pubkey Verify for B items."""
+    return batch_fast_aggregate_verify(
+        [[pk] for pk in pubkeys], messages, signatures
+    )
+
+
+# ciphersuite-compatible scalar API ------------------------------------------
+
+
+def Verify(pubkey: bytes, message: bytes, signature: bytes) -> bool:
+    return batch_verify([pubkey], [message], [signature])[0]
+
+
+def FastAggregateVerify(
+    pubkeys: Sequence[bytes], message: bytes, signature: bytes
+) -> bool:
+    return batch_fast_aggregate_verify([list(pubkeys)], [message], [signature])[0]
+
+
+def AggregateVerify(
+    pubkeys: Sequence[bytes], messages: Sequence[bytes], signature: bytes
+) -> bool:
+    """Distinct-message aggregate verification.  K varies with len(pubkeys),
+    and each distinct K would trigger a fresh XLA compilation, so this
+    rare, unbatchable path stays on the fastest host backend."""
+    return _delegate.AggregateVerify(pubkeys, messages, signature)
+
+
+def backend():
+    """The module itself is the backend object the selector registers."""
+    import sys
+
+    return sys.modules[__name__]

@@ -23,7 +23,7 @@ Overflow audit for ``mul`` (int64):
   the tail adds p and carry-propagates, giving (0, 3p) with canonical
   digits.
 
-Differential tests vs python ints: tests/test_bls_jax.py.
+Differential tests vs python ints: tests/crypto/test_bls_jax.py.
 """
 from __future__ import annotations
 

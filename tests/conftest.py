@@ -47,8 +47,10 @@ def pytest_configure(config):
     from consensus_specs_tpu.testing import context
 
     # fast host BLS (native C++) when the toolchain can build it, like the
-    # reference's CI running under the milagro backend
-    bls.use_fastest()
+    # reference's CI running under the milagro backend; pointless when BLS
+    # is stubbed out
+    if not config.getoption("--disable-bls"):
+        bls.use_fastest()
 
     context.DEFAULT_TEST_PRESET = config.getoption("--preset")
     forks = config.getoption("--fork")

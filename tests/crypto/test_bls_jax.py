@@ -1,0 +1,231 @@
+"""Differential tests: JAX batched BLS pipeline vs the Python oracle.
+
+Layered exactly like the implementation: limb arithmetic vs python ints,
+tower ops vs crypto/bls/fields.py, Frobenius/HHT identities exactly, then
+the full batched pairing-product check vs oracle verifications.
+
+The pairing tests share ONE compiled batch shape (B=2, K=2) — compile is
+the dominant cost and is persistently cached under .cache/jax.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto.bls import ciphersuite as py
+from consensus_specs_tpu.crypto.bls.fields import (
+    FQ12_ONE,
+    Fq2,
+    Fq6,
+    Fq12,
+    P,
+    R,
+    X_PARAM,
+)
+from consensus_specs_tpu.ops import bls_jax
+from consensus_specs_tpu.ops.bls_jax import limbs, tower
+
+rng = random.Random(99)
+
+
+def rand_fq() -> int:
+    return rng.randrange(P)
+
+
+def rand_fq12() -> Fq12:
+    def f2():
+        return Fq2(rand_fq(), rand_fq())
+
+    return Fq12(Fq6(f2(), f2(), f2()), Fq6(f2(), f2(), f2()))
+
+
+# --- limb layer -------------------------------------------------------------
+
+
+def test_limb_roundtrip():
+    for _ in range(20):
+        x = rand_fq()
+        assert limbs.limbs_to_int(limbs.int_to_limbs(x)) == x
+
+
+def test_limb_mont_mul_differential():
+    import jax.numpy as jnp
+
+    xs = [rand_fq() for _ in range(8)]
+    ys = [rand_fq() for _ in range(8)]
+    a = jnp.asarray(np.stack([limbs.host_to_mont(x) for x in xs]))
+    b = jnp.asarray(np.stack([limbs.host_to_mont(y) for y in ys]))
+    out = limbs.mul(a, b)
+    for i in range(8):
+        assert limbs.host_from_mont(np.asarray(out[i])) == (xs[i] * ys[i]) % P
+
+
+def test_limb_lazy_add_sub_then_mul():
+    import jax.numpy as jnp
+
+    xs = [rand_fq() for _ in range(4)]
+    a = jnp.asarray(np.stack([limbs.host_to_mont(x) for x in xs]))
+    # (8a - 3a) * a == 5a^2
+    acc = a + a + a + a + a + a + a + a - (a + a + a)
+    out = limbs.mul(acc, a)
+    for i in range(4):
+        assert limbs.host_from_mont(np.asarray(out[i])) == (5 * xs[i] * xs[i]) % P
+
+
+def test_limb_inv():
+    import jax.numpy as jnp
+
+    xs = [rand_fq() for _ in range(4)]
+    a = jnp.asarray(np.stack([limbs.host_to_mont(x) for x in xs]))
+    out = limbs.inv(a)
+    for i in range(4):
+        assert limbs.host_from_mont(np.asarray(out[i])) == pow(xs[i], P - 2, P)
+
+
+def test_limb_canonical_and_cond_sub():
+    import jax.numpy as jnp
+
+    for x in [0, 1, P - 1, P // 2, rand_fq()]:
+        a = jnp.asarray(limbs.host_to_mont(x))[None, :]
+        c = limbs.canonical(a)
+        assert limbs.limbs_to_int(np.asarray(c[0])) == (x * limbs.R_INT) % P
+
+
+# --- tower layer ------------------------------------------------------------
+
+
+def _to12(x: Fq12) -> np.ndarray:
+    return tower.host_fq12_from_oracle(x)
+
+
+def _from12(a) -> Fq12:
+    return tower.host_fq12_to_oracle(np.asarray(a))
+
+
+def test_fq12_mul_square_differential():
+    import jax.numpy as jnp
+
+    for _ in range(3):
+        x, y = rand_fq12(), rand_fq12()
+        got = _from12(tower.fq12_mul(jnp.asarray(_to12(x)), jnp.asarray(_to12(y))))
+        assert got == x * y
+        got_sq = _from12(tower.fq12_square(jnp.asarray(_to12(x))))
+        assert got_sq == x.square()
+
+
+def test_fq12_inv_conj_differential():
+    import jax.numpy as jnp
+
+    x = rand_fq12()
+    assert _from12(tower.fq12_inv(jnp.asarray(_to12(x)))) == x.inv()
+    assert _from12(tower.fq12_conj(jnp.asarray(_to12(x)))) == x.conjugate()
+
+
+def test_fq12_frobenius_differential():
+    import jax.numpy as jnp
+
+    x = rand_fq12()
+    assert _from12(tower.fq12_frob1(jnp.asarray(_to12(x)))) == x.pow(P)
+    assert _from12(tower.fq12_frob2(jnp.asarray(_to12(x)))) == x.pow(P * P)
+
+
+def test_fq12_mul_line_matches_full_mul():
+    import jax.numpy as jnp
+
+    x = rand_fq12()
+    l0, l3, l5 = Fq2(rand_fq(), rand_fq()), Fq2(rand_fq(), rand_fq()), Fq2(
+        rand_fq(), rand_fq()
+    )
+    # sparse element with w-slots {0, 3, 5}
+    sparse = Fq12(
+        Fq6(l0, Fq2(0, 0), Fq2(0, 0)), Fq6(Fq2(0, 0), l3, l5)
+    )
+
+    def h2(v: Fq2):
+        return jnp.asarray(
+            np.stack([limbs.host_to_mont(v.c0), limbs.host_to_mont(v.c1)])
+        )
+
+    got = _from12(
+        tower.fq12_mul_line(jnp.asarray(_to12(x)), h2(l0), h2(l3), h2(l5))
+    )
+    assert got == x * sparse
+
+
+def test_hht_hard_part_identity():
+    """3 * (p^4 - p^2 + 1)/r  ==  (x-1)^2 (x+p) (x^2 + p^2 - 1) + 3, exactly."""
+    x = X_PARAM
+    lhs = 3 * ((P**4 - P**2 + 1) // R)
+    rhs = (x - 1) ** 2 * (x + P) * (x**2 + P**2 - 1) + 3
+    assert lhs == rhs
+
+
+# --- full pipeline (shares one compiled shape: K=2, B=2) --------------------
+
+
+@pytest.fixture(scope="module")
+def signed_fixture():
+    msg = b"jax batch attestation"
+    sks = [11, 22, 33]
+    pks = [py.SkToPk(sk) for sk in sks]
+    sigs = [py.Sign(sk, msg) for sk in sks]
+    agg = py.Aggregate(sigs)
+    return msg, sks, pks, sigs, agg
+
+
+def test_batch_fast_aggregate_verify_differential(signed_fixture):
+    msg, sks, pks, sigs, agg = signed_fixture
+    got = bls_jax.batch_fast_aggregate_verify(
+        [pks, pks], [msg, b"wrong message"], [agg, agg]
+    )
+    assert got == [True, False]
+    expected = [
+        py.FastAggregateVerify(pks, msg, agg),
+        py.FastAggregateVerify(pks, b"wrong message", agg),
+    ]
+    assert got == expected
+
+
+def test_batch_verify_mixed(signed_fixture):
+    msg, sks, pks, sigs, agg = signed_fixture
+    got = bls_jax.batch_verify(
+        [pks[0], pks[1]], [msg, msg], [sigs[0], sigs[0]]
+    )
+    assert got == [True, False]
+
+
+def test_batch_malformed_inputs_are_false(signed_fixture):
+    msg, sks, pks, sigs, agg = signed_fixture
+    got = bls_jax.batch_fast_aggregate_verify(
+        [[], [b"\xff" * 48]], [msg, msg], [agg, agg]
+    )
+    assert got == [False, False]
+
+
+def test_scalar_api_matches_backend_contract(signed_fixture):
+    msg, sks, pks, sigs, agg = signed_fixture
+    assert bls_jax.FastAggregateVerify(pks, msg, agg)
+    assert not bls_jax.Verify(pks[0], msg, sigs[1])
+    assert bls_jax.Verify(pks[0], msg, sigs[0])
+    # infinity signature takes the host fallback path
+    assert not bls_jax.Verify(pks[0], msg, bls_jax.G2_POINT_AT_INFINITY)
+    # distinct-message AggregateVerify delegates to the host backend
+    msgs = [b"m1", b"m2", b"m3"]
+    agg2 = py.Aggregate([py.Sign(sk, m) for sk, m in zip(sks, msgs)])
+    assert bls_jax.AggregateVerify(pks, msgs, agg2)
+    assert not bls_jax.AggregateVerify(pks, list(reversed(msgs)), agg2)
+
+
+def test_selector_use_jax_roundtrip(signed_fixture):
+    from consensus_specs_tpu.crypto import bls
+
+    msg, sks, pks, sigs, agg = signed_fixture
+    prev = bls.backend_name()
+    try:
+        bls.use_jax()
+        assert bls.backend_name() == "jax"
+        assert bls.FastAggregateVerify(pks, msg, agg)
+        assert not bls.Verify(pks[0], b"nope", sigs[0])
+        assert bls.Sign(sks[0], msg) == sigs[0]
+    finally:
+        bls.use_backend(prev)
