@@ -1,119 +1,267 @@
-"""Benchmark: vectorized epoch rewards pass at mainnet scale (400k validators).
+"""End-to-end benchmarks against BASELINE.md's config table.
 
-Flagship kernel = phase0 ``get_attestation_deltas`` + balance update
-(the per-epoch hot loop, SURVEY §3.2 / BASELINE config ★).  The
-reference's executable spec computes this with sequential Python loops;
-the baseline twin below reproduces exactly that per-validator arithmetic
-(python ints, one loop) and is timed on the same machine, then scaled
-linearly to 400k validators (the sequential pass is O(n); the
-reference's real code path is strictly slower — O(n × attestations)
-committee recomputation on top).
+Headline (the ONE printed JSON line): the north-star metric — a full
+mainnet-preset phase0 epoch transition at 400k validators, run through the
+REAL spec module (``spec.process_epoch`` on a real BeaconState with a full
+complement of pending attestations), not an isolated kernel.
+``vs_baseline`` compares against the sequential spec path (the substituted
+functions' ``__wrapped__`` originals — the reference pyspec's own
+algorithmic shape) measured at 16k validators and scaled linearly, which
+flatters the baseline: the reference's real cost grows superlinearly with
+committee recomputation.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-vs_baseline = sequential-python time / this-framework time (higher is better).
+Details for every measured BASELINE config land in BENCH_DETAILS.json.
+
+Env knobs: BENCH_VALIDATORS (default 400000), BENCH_QUICK=1 (32k, skips
+the BLS batch configs).
 """
 import json
+import os
 import time
 
 import numpy as np
 
-N_VALIDATORS = 400_000
-BASELINE_SAMPLE = 16_384
+N_VALIDATORS = int(os.environ.get("BENCH_VALIDATORS", "400000"))
+QUICK = os.environ.get("BENCH_QUICK", "") == "1"
+if QUICK:
+    N_VALIDATORS = min(N_VALIDATORS, 32_768)
+BASELINE_N = 16_384
+
+FAR_FUTURE = 2**64 - 1
 
 
-def _python_baseline(inp, balances, n):
-    """Sequential per-validator twin of get_attestation_deltas + update."""
-    eff = [int(x) for x in inp.effective_balance[:n]]
-    eligible = [bool(x) for x in inp.eligible[:n]]
-    src = [bool(x) for x in inp.source_part[:n]]
-    tgt = [bool(x) for x in inp.target_part[:n]]
-    head = [bool(x) for x in inp.head_part[:n]]
-    delay = [int(x) for x in inp.incl_delay[:n]]
-    proposer = [int(x) % n for x in inp.incl_proposer[:n]]
-    bals = [int(x) for x in balances[:n]]
+def build_state(spec, n):
+    """Synthetic mainnet-shape state at epoch 2: n active validators with a
+    full previous epoch of maximum-participation pending attestations."""
+    from consensus_specs_tpu.ssz import bulk
+    from consensus_specs_tpu.ssz.node import (
+        BranchNode,
+        subtree_fill_to_contents,
+        uint_to_leaf,
+    )
 
-    ebi = inp.effective_balance_increment
-    total = inp.total_balance
-    sqrt_total = inp.sqrt_total
-    leak = inp.finality_delay > inp.min_epochs_to_inactivity_penalty
+    state = spec.BeaconState()
+    state.slot = 2 * spec.SLOTS_PER_EPOCH
 
+    vnode = spec.Validator(
+        effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+        activation_epoch=0,
+        activation_eligibility_epoch=0,
+        exit_epoch=FAR_FUTURE,
+        withdrawable_epoch=FAR_FUTURE,
+    ).get_backing()
+    vlist_t = type(state.validators)
+    contents = subtree_fill_to_contents([vnode] * n, vlist_t.contents_depth())
+    state.validators = vlist_t.view_from_backing(
+        BranchNode(contents, uint_to_leaf(n))
+    )
+    bulk.set_packed_uint64_from_numpy(
+        state.balances, np.full(n, int(spec.MAX_EFFECTIVE_BALANCE), dtype=np.int64)
+    )
+
+    prev_epoch = spec.get_previous_epoch(state)
+    start_slot = spec.compute_start_slot_at_epoch(prev_epoch)
+    committees_per_slot = int(spec.get_committee_count_per_slot(state, prev_epoch))
+    for slot in range(int(start_slot), int(start_slot) + int(spec.SLOTS_PER_EPOCH)):
+        for index in range(committees_per_slot):
+            committee = spec.get_beacon_committee(state, slot, index)
+            data = spec.AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=spec.get_block_root_at_slot(state, slot),
+                source=state.previous_justified_checkpoint,
+                target=spec.Checkpoint(
+                    epoch=prev_epoch, root=spec.get_block_root(state, prev_epoch)
+                ),
+            )
+            att = spec.PendingAttestation(
+                aggregation_bits=[True] * len(committee),
+                data=data,
+                inclusion_delay=1,
+                proposer_index=slot % n,
+            )
+            state.previous_epoch_attestations.append(att)
+    return state
+
+
+def _timed(fn, *args):
     t0 = time.perf_counter()
-    att_bal = [
-        max(ebi, sum(e for e, p in zip(eff, part) if p))
-        for part in (src, tgt, head)
-    ]
-    rewards = [0] * n
-    penalties = [0] * n
-    for i in range(n):
-        base = eff[i] * inp.base_reward_factor // sqrt_total // inp.base_rewards_per_epoch
-        prop_r = base // inp.proposer_reward_quotient
-        for k, part in enumerate((src, tgt, head)):
-            if eligible[i]:
-                if part[i]:
-                    if leak:
-                        rewards[i] += base
-                    else:
-                        rewards[i] += base * (att_bal[k] // ebi) // (total // ebi)
-                else:
-                    penalties[i] += base
-        if src[i]:
-            rewards[i] += (base - prop_r) // delay[i]
-            rewards[proposer[i]] += prop_r
-        if leak and eligible[i]:
-            penalties[i] += inp.base_rewards_per_epoch * base - prop_r
-            if not tgt[i]:
-                penalties[i] += eff[i] * inp.finality_delay // inp.inactivity_penalty_quotient
-    for i in range(n):
-        b = bals[i] + rewards[i]
-        bals[i] = 0 if penalties[i] > b else b - penalties[i]
-    return time.perf_counter() - t0
+    out = fn(*args)
+    return time.perf_counter() - t0, out
+
+
+def bench_epoch(results):
+    """North star: full mainnet epoch transition at N_VALIDATORS."""
+    from consensus_specs_tpu.specs.builder import build_spec, get_spec
+
+    spec = get_spec("phase0", "mainnet")
+
+    t_build, state = _timed(build_state, spec, N_VALIDATORS)
+    # cold pass on a throwaway copy: pays XLA compile/cache-load + committee
+    # cache warmup, the way a live client's first epoch would
+    t_cold, _ = _timed(spec.process_epoch, state.copy())
+
+    t_epoch, _ = _timed(spec.process_epoch, state)
+    t_root, _ = _timed(state.hash_tree_root)
+
+    # sequential baseline: fresh spec module with the kernel substitutions
+    # bypassed, at BASELINE_N, scaled linearly (favorable to the baseline)
+    seq_spec = build_spec("phase0", "mainnet", name="bench_seq_phase0")
+    seq_spec.process_rewards_and_penalties = (
+        seq_spec.process_rewards_and_penalties.__wrapped__
+    )
+    seq_spec.get_attestation_deltas = seq_spec.get_attestation_deltas.__wrapped__
+    seq_state = build_state(seq_spec, BASELINE_N)
+    t_seq, _ = _timed(seq_spec.process_epoch, seq_state)
+    t_seq_scaled = t_seq * (N_VALIDATORS / BASELINE_N)
+
+    results["north_star_epoch"] = {
+        "metric": f"phase0_mainnet_epoch_transition_{N_VALIDATORS}_validators",
+        "value": round(t_epoch, 3),
+        "unit": "s",
+        "cold_first_epoch_s": round(t_cold, 3),
+        "state_build_s": round(t_build, 3),
+        "post_root_s": round(t_root, 3),
+        "sequential_spec_scaled_s": round(t_seq_scaled, 3),
+        "vs_baseline": round(t_seq_scaled / t_epoch, 1),
+        "target": "< 60 s",
+    }
+    return state, spec
+
+
+def bench_hash_tree_root(results, spec, state):
+    """BASELINE config 4: full-state hash_tree_root after mutating every
+    balance (forces a re-merkleization of the balances subtree)."""
+    from consensus_specs_tpu.ssz import bulk, hashing
+
+    timings = {}
+    for backend in ("hashlib", "jax"):
+        try:
+            hashing.set_backend(backend)
+        except Exception:
+            continue
+        bal = bulk.packed_uint64_to_numpy(state.balances)
+        bulk.set_packed_uint64_from_numpy(state.balances, bal + 1)
+        t, _ = _timed(state.hash_tree_root)
+        timings[backend] = round(t, 3)
+    hashing.set_backend("hashlib")
+    results["hash_tree_root_state"] = {
+        "metric": f"beacon_state_hash_tree_root_{N_VALIDATORS}_validators_balances_dirty",
+        "unit": "s",
+        **timings,
+    }
+
+
+def bench_block_transition(results):
+    """BASELINE config 1: minimal-preset single signed block through
+    state_transition with BLS verification ON, native backend."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.block import (
+        build_empty_block_for_next_slot,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+    from consensus_specs_tpu.testing.helpers.state import (
+        state_transition_and_sign_block,
+    )
+
+    spec = get_spec("phase0", "minimal")
+    bls.use_fastest()
+    bls.bls_active = True
+    state = create_genesis_state(
+        spec=spec,
+        validator_balances=default_balances(spec),
+        activation_threshold=default_activation_threshold(spec),
+    )
+    # warm caches, then measure a signed empty-block transition
+    block = build_empty_block_for_next_slot(spec, state)
+    t, _ = _timed(state_transition_and_sign_block, spec, state, block, False)
+    results["block_transition_minimal_bls_on"] = {
+        "metric": "phase0_minimal_signed_block_state_transition_bls_on",
+        "value": round(t * 1000, 1),
+        "unit": "ms",
+        "backend": bls.backend_name(),
+    }
+
+
+def bench_bls_batches(results):
+    """BASELINE configs 2+3: sync-aggregate-scale FastAggregateVerify (512
+    pubkeys) and a block's worth of attestation verifications (64 batches
+    of ~128 pubkeys), via the batched device pipeline vs the native host."""
+    from consensus_specs_tpu.crypto.bls import native
+    from consensus_specs_tpu.ops import bls_jax
+
+    msg = b"\x42" * 32
+    sks = list(range(1, 513))
+    pks = [native.SkToPk(sk) for sk in sks]
+    agg512 = native.Aggregate([native.Sign(sk, msg) for sk in sks])
+
+    # config 2: 512-pubkey sync aggregate, batch of 32 slots' worth
+    B = 32
+    t_host, _ = _timed(
+        lambda: [native.FastAggregateVerify(pks, msg, agg512) for _ in range(B)]
+    )
+    bls_jax.batch_fast_aggregate_verify([pks] * B, [msg] * B, [agg512] * B)  # compile
+    t_dev, out = _timed(
+        bls_jax.batch_fast_aggregate_verify, [pks] * B, [msg] * B, [agg512] * B
+    )
+    assert all(out)
+    results["sync_aggregate_512"] = {
+        "metric": "fast_aggregate_verify_512_pubkeys",
+        "value": round(B / t_dev, 1),
+        "unit": "verifies/s",
+        "host_native": round(B / t_host, 1),
+        "batch": B,
+    }
+
+    # config 3: 64 attestations x 128 pubkeys
+    pks128 = pks[:128]
+    agg128 = native.Aggregate([native.Sign(sk, msg) for sk in sks[:128]])
+    B = 64
+    t_host, _ = _timed(
+        lambda: [native.FastAggregateVerify(pks128, msg, agg128) for _ in range(B)]
+    )
+    bls_jax.batch_fast_aggregate_verify([pks128] * B, [msg] * B, [agg128] * B)
+    t_dev, out = _timed(
+        bls_jax.batch_fast_aggregate_verify, [pks128] * B, [msg] * B, [agg128] * B
+    )
+    assert all(out)
+    results["attestation_batch"] = {
+        "metric": "attestation_fast_aggregate_verify_128_pubkeys",
+        "value": round(B / t_dev, 1),
+        "unit": "verifies/s",
+        "host_native": round(B / t_host, 1),
+        "batch": B,
+    }
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
+    results = {}
+    state, spec = bench_epoch(results)
+    bench_hash_tree_root(results, spec, state)
+    try:
+        bench_block_transition(results)
+    except Exception as exc:  # keep the headline alive even if a row fails
+        results["block_transition_minimal_bls_on"] = {"error": repr(exc)[:300]}
+    if not QUICK:
+        try:
+            bench_bls_batches(results)
+        except Exception as exc:
+            results["bls_batches"] = {"error": repr(exc)[:300]}
 
-    import importlib.util
+    with open("BENCH_DETAILS.json", "w") as f:
+        json.dump(results, f, indent=2)
 
-    spec = importlib.util.spec_from_file_location("graft", "__graft_entry__.py")
-    graft = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(graft)
-
-    from consensus_specs_tpu.ops.epoch_jax import epoch_step
-
-    inp, balances = graft._example_inputs(N_VALIDATORS)
-    args = (
-        jnp.asarray(balances),
-        jnp.asarray(inp.effective_balance),
-        jnp.asarray(inp.eligible),
-        jnp.asarray(inp.source_part),
-        jnp.asarray(inp.target_part),
-        jnp.asarray(inp.head_part),
-        jnp.asarray(inp.incl_delay),
-        jnp.asarray(inp.incl_proposer),
-        jnp.asarray(graft._scalars(inp)),
-    )
-
-    step = jax.jit(epoch_step)
-    out = step(*args)
-    out.block_until_ready()  # compile + warm
-
-    iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = step(*args)
-    out.block_until_ready()
-    device_time = (time.perf_counter() - t0) / iters
-
-    base_time = _python_baseline(inp, balances, BASELINE_SAMPLE)
-    base_scaled = base_time * (N_VALIDATORS / BASELINE_SAMPLE)
-
+    ns = results["north_star_epoch"]
     print(json.dumps({
-        "metric": "phase0_epoch_rewards_pass_400k_validators",
-        "value": round(device_time * 1000, 3),
-        "unit": "ms",
-        "vs_baseline": round(base_scaled / device_time, 1),
+        "metric": ns["metric"],
+        "value": ns["value"],
+        "unit": ns["unit"],
+        "vs_baseline": ns["vs_baseline"],
     }))
 
 

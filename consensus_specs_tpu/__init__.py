@@ -16,3 +16,8 @@ Layer map (mirrors SURVEY.md):
 """
 
 __version__ = "0.1.0"
+
+
+# NOTE: no eager imports here — pure-SSZ consumers must not pay the jax
+# import cost.  Kernel modules call _jaxcache.configure() after importing
+# jax themselves.

@@ -85,6 +85,7 @@ def _load() -> ctypes.CDLL:
     sig("bls_aggregate", u8p, sz, u8p)
     sig("bls_aggregate_pks", u8p, sz, u8p)
     sig("bls_fast_aggregate_verify", u8p, sz, u8p, sz, u8p)
+    sig("bls_fast_aggregate_verify_prechecked", u8p, sz, u8p, sz, u8p)
     sig("bls_aggregate_verify", u8p, sz, u8p, ctypes.POINTER(sz), u8p)
     sig("bls_hash_to_g2", u8p, sz, u8p, sz, u8p)
     sig("bls_pairing", u8p, u8p, u8p)
@@ -174,6 +175,27 @@ def AggregatePKs(pubkeys: Sequence[bytes]) -> bytes:
     return bytes(out)
 
 
+# Pubkeys that have passed a full validation (subgroup included) once; the
+# same validator keys recur in every attestation, so later aggregates skip
+# the per-key subgroup scalar mult (same idea as the oracle's lru_cache on
+# pubkey_to_point, curve.py:269-276).
+_VALIDATED_PKS: set = set()
+_VALIDATED_PKS_MAX = 1 << 20
+
+
+def _all_prechecked(pks) -> bool:
+    validated = _VALIDATED_PKS
+    unseen = [p for p in pks if p not in validated]
+    if not unseen:
+        return True
+    for p in set(unseen):
+        if not _lib.bls_key_validate(_buf(p)):
+            return False
+        if len(validated) < _VALIDATED_PKS_MAX:
+            validated.add(p)
+    return True
+
+
 def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes, signature: bytes) -> bool:
     pks = [bytes(p) for p in pubkeys]
     sig = bytes(signature)
@@ -181,9 +203,13 @@ def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes, signature: byt
         return False
     msg = bytes(message)
     flat = b"".join(pks)
-    return bool(
-        _lib.bls_fast_aggregate_verify(_buf(flat), len(pks), _buf(msg), len(msg), _buf(sig))
-    )
+    if _all_prechecked(pks):
+        return bool(
+            _lib.bls_fast_aggregate_verify_prechecked(
+                _buf(flat), len(pks), _buf(msg), len(msg), _buf(sig)
+            )
+        )
+    return False  # some pubkey invalid: the aggregate cannot verify
 
 
 def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signature: bytes) -> bool:

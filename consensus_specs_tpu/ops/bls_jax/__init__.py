@@ -22,27 +22,12 @@ workload.
 """
 from __future__ import annotations
 
-import os
 from typing import List, Sequence, Tuple
 
 import numpy as np
 
-import jax as _jax
-
-# The pairing kernels are compile-heavy (~minutes per batch shape on CPU);
-# a persistent compilation cache makes that a once-per-machine cost.  Users
-# can override via JAX_COMPILATION_CACHE_DIR or their own config.
-if _jax.config.jax_compilation_cache_dir is None and \
-        "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-    _cache_dir = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))), ".cache", "jax")
-    try:
-        os.makedirs(_cache_dir, exist_ok=True)
-        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-    except OSError:
-        pass  # read-only tree: in-memory cache only
+# persistent XLA compilation cache configured at package import
+# (consensus_specs_tpu.__init__) — the pairing kernels depend on it
 
 from consensus_specs_tpu.crypto.bls import ciphersuite as _py
 from consensus_specs_tpu.crypto.bls.curve import (
@@ -125,13 +110,28 @@ def _check_pairs_batch(
             px[k, b], py[k, b] = _g1_coords(p)
             qx[k, b], qy[k, b] = _g2_coords(q)
     if infinity_mask.any():
-        # rare path (infinity points, e.g. infinity signatures): decide on
-        # the host oracle — batching machinery would only add shapes
+        # rare path (infinity points, e.g. infinity signatures): only the
+        # affected items drop to the host oracle; the rest stay batched so
+        # one adversarial attestation can't stall the whole block
         from consensus_specs_tpu.crypto.bls.pairing import pairings_are_identity
 
-        return np.array(
-            [pairings_are_identity(ps) for ps in pairs_per_item], dtype=bool
-        )
+        out = np.zeros(B, dtype=bool)
+        dirty = infinity_mask.any(axis=0)
+        clean = [b for b in range(B) if not dirty[b]]
+        if clean:
+            # pad the clean subset to a power-of-two bucket (repeat first
+            # item) so this path reuses the standard compiled shapes
+            bucket = 2
+            while bucket < len(clean):
+                bucket *= 2
+            sel = clean + [clean[0]] * (bucket - len(clean))
+            sub = np.asarray(pairing.pairs_product_is_one(
+                px[:, sel], py[:, sel], qx[:, sel], qy[:, sel]))
+            out[clean] = sub[: len(clean)]
+        for b in range(B):
+            if dirty[b]:
+                out[b] = pairings_are_identity(pairs_per_item[b])
+        return out
     return np.asarray(pairing.pairs_product_is_one(px, py, qx, qy))
 
 

@@ -32,7 +32,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from consensus_specs_tpu import _jaxcache
+
 jax.config.update("jax_enable_x64", True)
+_jaxcache.configure()
 
 P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
 

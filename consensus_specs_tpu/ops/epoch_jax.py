@@ -24,7 +24,39 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from consensus_specs_tpu import _jaxcache
+
 jax.config.update("jax_enable_x64", True)
+_jaxcache.configure()
+
+
+# --- registry columns (cached off the validators tree root) ----------------
+
+# validator_columns saturates FAR_FUTURE_EPOCH (2^64-1) at int64 max; any
+# comparison against FAR_FUTURE therefore tests >= _SAT
+_SAT = 2**63 - 1
+
+_COLS_CACHE: dict = {}
+
+
+def registry_columns(state):
+    """Cached numpy columns of the validator registry, keyed by the
+    registry's tree root (mutation -> new root -> automatic refresh)."""
+    from consensus_specs_tpu.ssz import bulk
+
+    root = bytes(state.validators.hash_tree_root())
+    cols = _COLS_CACHE.get(root)
+    if cols is None:
+        if len(_COLS_CACHE) >= 4:
+            _COLS_CACHE.pop(next(iter(_COLS_CACHE)))
+        cols = bulk.validator_columns(state.validators)
+        _COLS_CACHE[root] = cols
+    return cols
+
+
+def active_mask(cols, epoch: int) -> np.ndarray:
+    """is_active_validator over columns: activation <= epoch < exit."""
+    return (cols["activation_epoch"] <= epoch) & (epoch < cols["exit_epoch"])
 
 
 class DeltaInputs(NamedTuple):
@@ -50,21 +82,24 @@ class DeltaInputs(NamedTuple):
 
 
 def extract_delta_inputs(spec, state) -> DeltaInputs:
-    """Host-side flattening of state + pending attestations into arrays."""
+    """Host-side flattening of state + pending attestations into arrays.
+
+    Registry columns come straight off the Merkle backing in one tree walk
+    (ssz/bulk.py) — the per-validator view loop this replaces was the real
+    end-to-end bottleneck at 400k validators."""
     n = len(state.validators)
-    prev_epoch = spec.get_previous_epoch(state)
+    prev_epoch = int(spec.get_previous_epoch(state))
 
-    eff = np.zeros(n, dtype=np.int64)
-    slashed = np.zeros(n, dtype=bool)
-    active_prev = np.zeros(n, dtype=bool)
-    withdrawable = np.zeros(n, dtype=np.float64)
-    for i, v in enumerate(state.validators):
-        eff[i] = int(v.effective_balance)
-        slashed[i] = bool(v.slashed)
-        active_prev[i] = spec.is_active_validator(v, prev_epoch)
-        withdrawable[i] = float(int(v.withdrawable_epoch))
-
-    eligible = active_prev | (slashed & (int(prev_epoch) + 1 < withdrawable))
+    cols = registry_columns(state)
+    eff = cols["effective_balance"]
+    slashed = cols["slashed"]
+    # is_active_validator: activation_epoch <= epoch < exit_epoch
+    active_prev = (cols["activation_epoch"] <= prev_epoch) & (
+        prev_epoch < cols["exit_epoch"]
+    )
+    eligible = active_prev | (
+        slashed & (prev_epoch + 1 < cols["withdrawable_epoch"])
+    )
 
     source_atts = list(spec.get_matching_source_attestations(state, prev_epoch))
     target_atts = list(spec.get_matching_target_attestations(state, prev_epoch))
@@ -179,7 +214,26 @@ def epoch_step(balances, eff, eligible, source_part, target_part, head_part,
     return jnp.where(penalties > new_balances, 0, new_balances - penalties)
 
 
-# single jitted callable; XLA caches per input shape
+# single jitted callable; XLA caches per input shape.
+#
+# Device choice: this kernel is memory-bound int64 elementwise work with
+# integer divisions — on TPU hardware int64 is emulated on 32-bit lanes and
+# the axon-tunneled transfer adds seconds of latency, so the host CPU XLA
+# backend is strictly faster at any registry size.  The TPU pays off on the
+# compute-dense batched pairing / SHA-256 pipelines instead (ops/bls_jax,
+# ops/sha256_jax); the multi-chip story for the epoch pass is the sharded
+# mesh variant in parallel/epoch_sharded.py.  CSTPU_EPOCH_BACKEND overrides.
+import os as _os
+
+
+def _kernel_device():
+    want = _os.environ.get("CSTPU_EPOCH_BACKEND", "cpu")
+    try:
+        return jax.local_devices(backend=want)[0]
+    except RuntimeError:
+        return None
+
+
 _jit_kernel = jax.jit(_deltas_kernel)
 
 
@@ -204,15 +258,17 @@ def attestation_deltas(inp: DeltaInputs):
         inp.min_epochs_to_inactivity_penalty, inp.effective_balance_increment,
     ], dtype=np.int64)
 
+    dev = _kernel_device()
+    put = (lambda a: jax.device_put(a, dev)) if dev is not None else jnp.asarray
     rewards, penalties = _jit_kernel(
-        jnp.asarray(pad(inp.effective_balance)),
-        jnp.asarray(pad(inp.eligible.astype(bool))),
-        jnp.asarray(pad(inp.source_part.astype(bool))),
-        jnp.asarray(pad(inp.target_part.astype(bool))),
-        jnp.asarray(pad(inp.head_part.astype(bool))),
-        jnp.asarray(pad(inp.incl_delay, fill=1)),
-        jnp.asarray(pad(inp.incl_proposer)),
-        jnp.asarray(scalars),
+        put(pad(inp.effective_balance)),
+        put(pad(inp.eligible.astype(bool))),
+        put(pad(inp.source_part.astype(bool))),
+        put(pad(inp.target_part.astype(bool))),
+        put(pad(inp.head_part.astype(bool))),
+        put(pad(inp.incl_delay, fill=1)),
+        put(pad(inp.incl_proposer)),
+        put(scalars),
     )
     return np.asarray(rewards)[:n], np.asarray(penalties)[:n]
 
@@ -220,3 +276,122 @@ def attestation_deltas(inp: DeltaInputs):
 def attestation_deltas_for_state(spec, state):
     """End-to-end: state -> (rewards, penalties) numpy arrays."""
     return attestation_deltas(extract_delta_inputs(spec, state))
+
+
+# ---------------------------------------------------------------------------
+# vectorized epoch-phase twins (installed by the spec builder as
+# semantics-preserving substitutions; each keeps the sequential original
+# reachable via __wrapped__, differential tests in tests/spec/phase0/)
+# ---------------------------------------------------------------------------
+
+
+def participation_mask(spec, state, attestations, n: int) -> np.ndarray:
+    mask = np.zeros(n, dtype=bool)
+    for a in attestations:
+        idx = np.fromiter(
+            spec.get_attesting_indices(state, a.data, a.aggregation_bits),
+            dtype=np.int64,
+        )
+        mask[idx] = True
+    return mask
+
+
+def attesting_balance(spec, state, attestations) -> int:
+    """get_attesting_balance: combined effective balance of unslashed
+    participants (floored at one increment, per get_total_balance)."""
+    cols = registry_columns(state)
+    mask = participation_mask(spec, state, attestations, len(cols["slashed"]))
+    mask &= ~cols["slashed"]
+    total = int(np.sum(np.where(mask, cols["effective_balance"], 0)))
+    return max(int(spec.EFFECTIVE_BALANCE_INCREMENT), total)
+
+
+def total_active_balance(spec, state) -> int:
+    cols = registry_columns(state)
+    act = active_mask(cols, int(spec.get_current_epoch(state)))
+    total = int(np.sum(np.where(act, cols["effective_balance"], 0)))
+    return max(int(spec.EFFECTIVE_BALANCE_INCREMENT), total)
+
+
+def active_validator_indices(spec, state, epoch) -> list:
+    cols = registry_columns(state)
+    return [int(i) for i in np.nonzero(active_mask(cols, int(epoch)))[0]]
+
+
+def effective_balance_updates(spec, state) -> None:
+    """Hysteresis update; only validators whose effective balance actually
+    moves touch the tree (typically a handful per epoch)."""
+    from consensus_specs_tpu.ssz import bulk
+
+    cols = registry_columns(state)
+    bal = bulk.packed_uint64_to_numpy(state.balances)
+    eff = cols["effective_balance"]
+    ebi = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    hyst = ebi // int(spec.HYSTERESIS_QUOTIENT)
+    down = hyst * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    up = hyst * int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
+    new_eff = np.minimum(bal - bal % ebi, int(spec.MAX_EFFECTIVE_BALANCE))
+    change = (bal + down < eff) | (eff + up < bal)
+    for i in np.nonzero(change)[0]:
+        state.validators[int(i)].effective_balance = int(new_eff[i])
+
+
+def slashings_sweep(spec, state, multiplier: int) -> None:
+    """process_slashings with the fork's proportional multiplier."""
+    from consensus_specs_tpu.ssz import bulk
+
+    epoch = int(spec.get_current_epoch(state))
+    total = int(spec.get_total_active_balance(state))
+    sum_slash = sum(int(x) for x in state.slashings)
+    adjusted = min(sum_slash * multiplier, total)
+    cols = registry_columns(state)
+    window = epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2
+    mask = cols["slashed"] & (cols["withdrawable_epoch"] == window)
+    if not mask.any():
+        return
+    # exact python big-int arithmetic on the (few) affected validators —
+    # penalty_numerator can exceed int64 in small-preset edge states
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    bal = bulk.packed_uint64_to_numpy(state.balances)
+    for i in np.nonzero(mask)[0]:
+        eff_i = int(cols["effective_balance"][i])
+        penalty = eff_i // increment * adjusted // total * increment
+        b = int(bal[i])
+        bal[i] = 0 if penalty > b else b - penalty
+    bulk.set_packed_uint64_from_numpy(state.balances, bal)
+
+
+def registry_updates(spec, state) -> None:
+    """process_registry_updates: vectorized scans, per-index mutations only
+    for the (few) affected validators, in spec iteration order."""
+    cols = registry_columns(state)  # snapshot before any mutation
+    cur = int(spec.get_current_epoch(state))
+    eff = cols["effective_balance"]
+
+    # activation-queue eligibility: aee == FAR_FUTURE and eff == MAX
+    elig_queue = (cols["activation_eligibility_epoch"] >= _SAT) & (
+        eff == int(spec.MAX_EFFECTIVE_BALANCE)
+    )
+    # ejections: active now and eff <= EJECTION_BALANCE
+    eject = active_mask(cols, cur) & (eff <= int(spec.config.EJECTION_BALANCE))
+    for i in np.nonzero(elig_queue | eject)[0]:
+        index = int(i)
+        if elig_queue[i]:
+            state.validators[index].activation_eligibility_epoch = cur + 1
+        if eject[i]:
+            spec.initiate_validator_exit(state, index)
+
+    # activation dequeue: aee <= finalized and activation == FAR_FUTURE,
+    # ordered by (aee, index).  The spec builds the queue AFTER the first
+    # loop, so freshly-queued validators carry aee = cur+1 — which is
+    # admissible whenever finalized >= cur+1 (artificial but legal states;
+    # caught by tests/spec/phase0/test_registry_vectorization.py).
+    aee = np.where(elig_queue, cur + 1, cols["activation_eligibility_epoch"])
+    finalized = int(state.finalized_checkpoint.epoch)
+    elig_act = (aee <= finalized) & (cols["activation_epoch"] >= _SAT)
+    idxs = np.nonzero(elig_act)[0]
+    order = np.lexsort((idxs, aee[idxs]))
+    churn = int(spec.get_validator_churn_limit(state))
+    target_epoch = int(spec.compute_activation_exit_epoch(cur))
+    for i in idxs[order][:churn]:
+        state.validators[int(i)].activation_epoch = target_epoch

@@ -295,6 +295,112 @@ def _install_optimizations(g: Dict[str, Any]) -> None:
     compute_committee.__wrapped__ = g["compute_committee"]
     g["compute_committee"] = compute_committee
 
+    _install_registry_vectorization(g)
+    if g["fork"] == "phase0":
+        _install_phase0_epoch_kernel(g)
+
+
+def _swap(g: Dict[str, Any], name: str, fn) -> None:
+    orig = g[name]
+    fn.__doc__ = orig.__doc__
+    fn.__wrapped__ = orig
+    g[name] = fn
+
+
+# process_slashings carries a fork-specific proportional multiplier constant
+_SLASHING_MULT = {
+    "phase0": "PROPORTIONAL_SLASHING_MULTIPLIER",
+    "altair": "PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR",
+    "bellatrix": "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX",
+    "capella": "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX",
+}
+
+
+def _install_registry_vectorization(g: Dict[str, Any]) -> None:
+    """Fork-independent O(n) registry scans -> columns off the Merkle
+    backing + numpy (semantics-preserving; sequential originals stay on
+    __wrapped__; differential tests in tests/spec/phase0/test_epoch_kernel.py).
+    Runs BEFORE the sundry layer so its LRU caches wrap these."""
+    from consensus_specs_tpu.ops import epoch_jax
+
+    proxy = _LiveSpecProxy(g)
+    Gwei = g["Gwei"]
+    Vidx = g["ValidatorIndex"]
+
+    _swap(g, "get_active_validator_indices",
+          lambda state, epoch: [
+              Vidx(i) for i in epoch_jax.active_validator_indices(proxy, state, epoch)
+          ])
+    _swap(g, "get_total_active_balance",
+          lambda state: Gwei(epoch_jax.total_active_balance(proxy, state)))
+    _swap(g, "process_effective_balance_updates",
+          lambda state: epoch_jax.effective_balance_updates(proxy, state))
+    _swap(g, "process_registry_updates",
+          lambda state: epoch_jax.registry_updates(proxy, state))
+
+    mult_name = _SLASHING_MULT[g["fork"]]
+
+    def process_slashings(state):
+        epoch_jax.slashings_sweep(proxy, state, int(g[mult_name]))
+
+    _swap(g, "process_slashings", process_slashings)
+
+
+class _LiveSpecProxy:
+    """Attribute view over a spec module's globals dict; hands the JAX
+    kernels a `spec`-shaped object that sees sundry-layer caches."""
+
+    def __init__(self, g: Dict[str, Any]):
+        self._g = g
+
+    def __getattr__(self, name: str):
+        try:
+            return self._g[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+def _install_phase0_epoch_kernel(g: Dict[str, Any]) -> None:
+    """Swap the O(validators x attestations) Python rewards loop for the
+    vectorized JAX deltas kernel + bulk balance write (SURVEY §7 step 7;
+    sanctioned-substitution pattern of reference setup.py:65-68).
+    Differential test: tests/spec/phase0/test_epoch_kernel.py."""
+    from consensus_specs_tpu.ops import epoch_jax
+    from consensus_specs_tpu.ssz import bulk
+
+    proxy = _LiveSpecProxy(g)
+    Gwei = g["Gwei"]
+    orig_deltas = g["get_attestation_deltas"]
+    orig_rap = g["process_rewards_and_penalties"]
+
+    def get_attestation_deltas(state):
+        rewards, penalties = epoch_jax.attestation_deltas_for_state(proxy, state)
+        return (
+            [Gwei(int(x)) for x in rewards],
+            [Gwei(int(x)) for x in penalties],
+        )
+
+    get_attestation_deltas.__doc__ = orig_deltas.__doc__
+    get_attestation_deltas.__wrapped__ = orig_deltas
+    g["get_attestation_deltas"] = get_attestation_deltas
+
+    def process_rewards_and_penalties(state):
+        if g["get_current_epoch"](state) == g["GENESIS_EPOCH"]:
+            return
+        rewards, penalties = epoch_jax.attestation_deltas_for_state(proxy, state)
+        balances = bulk.packed_uint64_to_numpy(state.balances)
+        increased = balances + rewards
+        new_balances = np.where(penalties > increased, 0, increased - penalties)
+        bulk.set_packed_uint64_from_numpy(state.balances, new_balances)
+
+    process_rewards_and_penalties.__doc__ = orig_rap.__doc__
+    process_rewards_and_penalties.__wrapped__ = orig_rap
+    g["process_rewards_and_penalties"] = process_rewards_and_penalties
+
+    _swap(g, "get_attesting_balance",
+          lambda state, attestations: g["Gwei"](
+              epoch_jax.attesting_balance(proxy, state, attestations)))
+
 
 # RLock: building fork F recursively resolves its predecessor via get_spec
 _lock = threading.RLock()
@@ -334,8 +440,10 @@ def build_spec(fork: str, preset_name: str, config=None, name: str = None) -> Mo
         # upgrade functions see the *complete* predecessor
         prev = get_spec(f, preset_name) if config is None else build_spec(f, preset_name, cfg)
 
-    _install_sundry(g)
+    # optimizations first: the sundry LRU caches then wrap the vectorized
+    # accessors (get_total_active_balance etc.), not the sequential ones
     _install_optimizations(g)
+    _install_sundry(g)
     return mod
 
 

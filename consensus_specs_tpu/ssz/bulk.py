@@ -1,0 +1,139 @@
+"""Bulk numpy <-> SSZ-backing transfers.
+
+The TPU pipeline consumes whole-registry columns (effective balances,
+participation flags, epochs) and produces whole-registry balance vectors.
+Feeding those through the per-element view protocol costs O(n) Python
+object churn per epoch; these helpers move data between numpy arrays and
+the persistent Merkle backing directly at chunk granularity.
+
+The reference has no analogue — its spec loops per validator (e.g.
+process_rewards_and_penalties, phase0/beacon-chain.md:1439-1561); this
+module is the seam that lets the compiled spec keep identical semantics
+while the state transfer runs at memcpy speed.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .node import (
+    BranchNode,
+    LeafNode,
+    Node,
+    pack_chunks,
+    subtree_fill_to_contents,
+    uint_to_leaf,
+)
+from .types import _collect_leaf_roots
+
+
+def packed_uint64_to_numpy(view) -> np.ndarray:
+    """List/Vector[uint64, N] -> int64 numpy array (values < 2^63 assumed,
+    which Gwei balances satisfy by orders of magnitude)."""
+    cls = type(view)
+    node = view.get_backing()  # flush pending writes
+    contents = node.left if cls.IS_LIST else node
+    n = len(view)
+    n_chunks = (n + 3) // 4
+    data = b"".join(_collect_leaf_roots(contents, cls.contents_depth(), n_chunks))
+    return np.frombuffer(data, dtype="<u8")[:n].astype(np.int64)
+
+
+def set_packed_uint64_from_numpy(view, arr: np.ndarray) -> None:
+    """Replace the full contents of a packed uint64 List/Vector in one
+    bottom-up rebuild, preserving view/parent dirty-tracking semantics."""
+    cls = type(view)
+    arr = np.ascontiguousarray(arr, dtype="<u8")
+    if cls.IS_LIST:
+        if len(arr) > cls.LENGTH:
+            raise ValueError(f"{len(arr)} exceeds list limit {cls.LENGTH}")
+    elif len(arr) != cls.LENGTH:
+        raise ValueError(f"vector needs exactly {cls.LENGTH} elements")
+    contents = subtree_fill_to_contents(
+        pack_chunks(arr.tobytes()), cls.contents_depth()
+    )
+    backing = (
+        BranchNode(contents, uint_to_leaf(len(arr))) if cls.IS_LIST else contents
+    )
+    # install fresh backing; drop any materialized value cache
+    view._values = None
+    view._dirty_chunks = set()
+    view._backing = backing
+    view._length = len(arr) if cls.IS_LIST else cls.LENGTH
+    view._invalidate()  # parent (e.g. the BeaconState container) sees the change
+
+
+def composite_subtrees(view) -> list:
+    """The backing subtree node of each element of a List/Vector of
+    composites, left to right (no hashing is triggered)."""
+    cls = type(view)
+    node = view.get_backing()
+    contents = node.left if cls.IS_LIST else node
+    n = len(view)
+    out: list = []
+    if n == 0:
+        return out
+    stack = [(contents, cls.contents_depth())]
+    while stack and len(out) < n:
+        nd, d = stack.pop()
+        if d == 0:
+            out.append(nd)
+            continue
+        stack.append((nd.right, d - 1))
+        stack.append((nd.left, d - 1))
+    return out
+
+
+def _field_path(field_index: int, depth: int):
+    """Descent path (True=right) for a field at the given container depth."""
+    return [bool((field_index >> (depth - 1 - b)) & 1) for b in range(depth)]
+
+
+def _walk(node: Node, path) -> Node:
+    for go_right in path:
+        node = node.right if go_right else node.left
+    return node
+
+
+# --- validator registry columns ---------------------------------------------
+
+# epoch-processing columns (phase0/beacon-chain.md Validator container; later
+# forks may append fields — e.g. the early capella draft's
+# fully_withdrawn_epoch — so paths are derived from the element class layout)
+_V_FIELDS_U64 = (
+    "effective_balance",
+    "activation_eligibility_epoch",
+    "activation_epoch",
+    "exit_epoch",
+    "withdrawable_epoch",
+)
+
+
+def validator_columns(validators) -> Dict[str, np.ndarray]:
+    """One walk over the registry subtrees -> all epoch-processing columns.
+
+    Field paths come from the element class's own layout (field count sets
+    the tree depth).  Saturates epochs at int64 max (FAR_FUTURE_EPOCH =
+    2^64-1 would wrap)."""
+    et = type(validators).ELEM_TYPE
+    depth = et._depth
+    findex = et._field_index
+    subs = composite_subtrees(validators)
+    n = len(subs)
+    cols: Dict[str, np.ndarray] = {}
+    u64_paths = {
+        name: _field_path(findex[name], depth) for name in _V_FIELDS_U64
+    }
+    slashed_path = _field_path(findex["slashed"], depth)
+    raw = {name: bytearray() for name in u64_paths}
+    slashed = np.zeros(n, dtype=bool)
+    for i, sub in enumerate(subs):
+        for name, path in u64_paths.items():
+            raw[name] += _walk(sub, path)._root[:8]
+        slashed[i] = _walk(sub, slashed_path)._root[0] != 0
+    for name, buf in raw.items():
+        u = np.frombuffer(bytes(buf), dtype="<u8")
+        cols[name] = np.minimum(u, np.uint64(2**63 - 1)).astype(np.int64)
+    cols["slashed"] = slashed
+    return cols

@@ -10,10 +10,17 @@ from consensus_specs_tpu.testing.helpers.state import next_epoch
 
 
 def _assert_deltas_match(spec, state):
-    spec_rewards, spec_penalties = spec.get_attestation_deltas(state)
+    # the installed get_attestation_deltas IS the kernel; the sequential
+    # original survives as __wrapped__ — that's the differential oracle
+    sequential = spec.get_attestation_deltas.__wrapped__
+    spec_rewards, spec_penalties = sequential(state)
     k_rewards, k_penalties = attestation_deltas_for_state(spec, state)
     assert [int(x) for x in spec_rewards] == k_rewards.tolist()
     assert [int(x) for x in spec_penalties] == k_penalties.tolist()
+    # and the substituted spec function returns the same values
+    s_rewards, s_penalties = spec.get_attestation_deltas(state)
+    assert [int(x) for x in s_rewards] == k_rewards.tolist()
+    assert [int(x) for x in s_penalties] == k_penalties.tolist()
 
 
 @with_phases(["phase0"])
@@ -67,4 +74,27 @@ def test_deltas_kernel_with_slashed_validators(spec, state):
     for index in (0, 3, 7):
         state.validators[index].slashed = True
     _assert_deltas_match(spec, state)
+    yield from ()
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_substituted_rewards_and_penalties_state_root(spec, state):
+    """The substituted process_rewards_and_penalties (kernel + bulk balance
+    write) must produce a bit-identical post-state vs the sequential spec."""
+    prepare_state_with_attestations(spec, state)
+    ref_state = state.copy()
+    spec.process_rewards_and_penalties.__wrapped__(ref_state)
+    spec.process_rewards_and_penalties(state)
+    assert [int(b) for b in state.balances] == [int(b) for b in ref_state.balances]
+    assert state.hash_tree_root() == ref_state.hash_tree_root()
+    yield from ()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_substituted_rewards_genesis_epoch_noop(spec, state):
+    root_before = state.hash_tree_root()
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    spec.process_rewards_and_penalties(state)
+    assert state.hash_tree_root() == root_before
     yield from ()

@@ -1,0 +1,157 @@
+"""Differential tests: vectorized registry-phase substitutions vs their
+sequential ``__wrapped__`` originals (installed by specs/builder.py
+_install_registry_vectorization / _install_phase0_epoch_kernel).
+
+Every scenario mutates a copy of the state through BOTH paths and demands
+bit-identical results — state root for the process_* functions, exact
+values for the accessors.  Scenarios are chosen to hit each vectorized
+branch: activation queue, ejections, dequeue ordering, slashing windows,
+hysteresis in both directions, and FAR_FUTURE saturation.
+"""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    prepare_state_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+FAR_FUTURE = 2**64 - 1
+
+
+def unwrap(fn):
+    while hasattr(fn, "__wrapped__"):
+        fn = fn.__wrapped__
+    return fn
+
+
+def _assert_same_mutation(spec, state, name):
+    """Run vectorized spec.<name> and sequential original on copies; roots
+    must match bit-for-bit."""
+    vec_state = state.copy()
+    seq_state = state.copy()
+    getattr(spec, name)(vec_state)
+    unwrap(getattr(spec, name))(seq_state)
+    assert vec_state.hash_tree_root() == seq_state.hash_tree_root(), name
+
+
+@with_all_phases
+@spec_state_test
+def test_registry_updates_differential(spec, state):
+    n = len(state.validators)
+    # queue-eligible: fresh-deposit shape
+    state.validators[1].activation_eligibility_epoch = FAR_FUTURE
+    state.validators[1].activation_epoch = FAR_FUTURE
+    # ejectable: active with balance at the ejection line
+    state.validators[2].effective_balance = spec.config.EJECTION_BALANCE
+    # dequeue candidates with distinct eligibility epochs (order matters)
+    state.finalized_checkpoint.epoch = 5
+    state.validators[3].activation_eligibility_epoch = 4
+    state.validators[3].activation_epoch = FAR_FUTURE
+    state.validators[4].activation_eligibility_epoch = 2
+    state.validators[4].activation_epoch = FAR_FUTURE
+    if n > 5:
+        state.validators[5].activation_eligibility_epoch = 2
+        state.validators[5].activation_epoch = FAR_FUTURE
+    _assert_same_mutation(spec, state, "process_registry_updates")
+    yield from ()
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_differential(spec, state):
+    epoch = spec.get_current_epoch(state)
+    window = epoch + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    for i in (0, 3):
+        state.validators[i].slashed = True
+        state.validators[i].withdrawable_epoch = window
+    # one slashed validator OUTSIDE the window: must not be penalized
+    state.validators[4].slashed = True
+    state.validators[4].withdrawable_epoch = window + 1
+    state.slashings[0] = spec.Gwei(3 * 10**9)
+    state.slashings[1] = spec.Gwei(10**9)
+    _assert_same_mutation(spec, state, "process_slashings")
+    yield from ()
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_updates_differential(spec, state):
+    ebi = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    # downward past hysteresis, upward past hysteresis, and inside the band
+    state.balances[0] = state.validators[0].effective_balance - ebi
+    state.balances[1] = int(state.validators[1].effective_balance) + 2 * ebi
+    state.validators[1].effective_balance = spec.Gwei(
+        int(state.validators[1].effective_balance) - 2 * ebi
+    )
+    state.balances[2] = int(state.validators[2].effective_balance) + ebi // 8
+    _assert_same_mutation(spec, state, "process_effective_balance_updates")
+    yield from ()
+
+
+@with_all_phases
+@spec_state_test
+def test_active_accessors_differential(spec, state):
+    # mix of exited / future-activation / slashed validators
+    epoch = spec.get_current_epoch(state)
+    state.validators[0].exit_epoch = epoch  # no longer active
+    state.validators[1].activation_epoch = epoch + 2  # not yet active
+    state.validators[2].slashed = True
+
+    vec_idx = spec.get_active_validator_indices(state, epoch)
+    seq_idx = unwrap(spec.get_active_validator_indices)(state, epoch)
+    assert [int(i) for i in vec_idx] == [int(i) for i in seq_idx]
+
+    vec_total = spec.get_total_active_balance(state)
+    seq_total = unwrap(spec.get_total_active_balance)(state)
+    assert int(vec_total) == int(seq_total)
+    yield from ()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_attesting_balance_differential(spec, state):
+    prepare_state_with_attestations(
+        spec, state,
+        participation_fn=lambda slot, index, comm: set(list(comm)[::2]),
+    )
+    state.validators[0].slashed = True  # unslashed filter must apply
+    atts = spec.get_matching_target_attestations(
+        state, spec.get_previous_epoch(state)
+    )
+    vec = spec.get_attesting_balance(state, atts)
+    seq = unwrap(spec.get_attesting_balance)(state, atts)
+    assert int(vec) == int(seq)
+    yield from ()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_full_epoch_differential_after_activity(spec, state):
+    """Several epochs of activity, then one full process_epoch through both
+    pipelines — the integration check over every substitution at once."""
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state)
+    # ejection-eligible validator (effective balance does not perturb the
+    # already-built attestations' committees, unlike activation changes)
+    state.validators[2].effective_balance = spec.config.EJECTION_BALANCE
+
+    vec_state = state.copy()
+    seq_state = state.copy()
+    spec.process_epoch(vec_state)
+    g = spec.__dict__
+    names = (
+        "process_rewards_and_penalties", "process_registry_updates",
+        "process_slashings", "process_effective_balance_updates",
+    )
+    saved = {k: g[k] for k in names}
+    try:
+        for k in names:
+            g[k] = unwrap(saved[k])
+        spec.process_epoch(seq_state)
+    finally:
+        g.update(saved)
+    assert vec_state.hash_tree_root() == seq_state.hash_tree_root()
+    yield from ()
