@@ -1,0 +1,27 @@
+# Developer entry points (reference capability: the repo Makefile's
+# test/generator targets).
+
+OUT ?= ./vectors
+PRESETS ?=
+
+test:
+	python -m pytest tests/ -x -q
+
+test-fast:
+	python -m pytest tests/ -x -q --disable-bls
+
+test-mainnet:
+	python -m pytest tests/ -x -q --preset=mainnet
+
+bench:
+	python bench.py
+
+GENERATORS = sanity operations forks ssz_static shuffling bls
+
+gen-all: $(addprefix gen-,$(GENERATORS))
+
+gen-%:
+	mkdir -p $(OUT)
+	python -m consensus_specs_tpu.gen.runners.$* -o $(OUT) $(if $(PRESETS),-l $(PRESETS),)
+
+.PHONY: test test-fast test-mainnet bench gen-all $(addprefix gen-,$(GENERATORS))

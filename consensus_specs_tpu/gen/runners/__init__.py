@@ -1,0 +1,14 @@
+"""Generator runner mains (reference capability: tests/generators/*/main.py).
+
+Each module is runnable:  python -m consensus_specs_tpu.gen.runners.<name> -o <dir>
+
+The repo root joins sys.path so the ``tests.spec.*`` vector-source modules
+import (they live beside the package, like the reference's eth2spec.test).
+"""
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)

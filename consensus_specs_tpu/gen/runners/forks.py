@@ -1,0 +1,41 @@
+"""Fork-upgrade vector generator (reference capability:
+tests/generators/forks/main.py): upgrade_to_<fork> transition cases;
+tests run against the PRE-fork spec with the post-fork spec in phases.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from consensus_specs_tpu.gen import gen_runner
+from consensus_specs_tpu.gen.gen_from_tests import generate_from_tests
+from consensus_specs_tpu.gen.gen_typing import TestProvider
+
+
+def _create_provider(tests_src_mod_name: str, preset_name: str,
+                     pre_fork: str, post_fork: str) -> TestProvider:
+    def cases_fn() -> Iterable:
+        from importlib import import_module
+
+        tests_src = import_module(tests_src_mod_name)
+        yield from generate_from_tests(
+            runner_name="fork",
+            handler_name="fork",
+            src=tests_src,
+            fork_name=post_fork,
+            preset_name=preset_name,
+            phase=pre_fork,
+        )
+
+    return TestProvider(prepare=lambda: None, make_cases=cases_fn)
+
+
+def main(argv=None):
+    providers = [
+        _create_provider("tests.spec.altair.test_fork", preset, "phase0", "altair")
+        for preset in ("minimal", "mainnet")
+    ]
+    gen_runner.run_generator("forks", providers, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Operations vector generator (reference capability:
+tests/generators/operations/main.py): per-operation block-processing
+handlers across forks, generated from the pytest-mode test modules.
+"""
+from __future__ import annotations
+
+from consensus_specs_tpu.gen.gen_from_tests import (
+    combine_mods,
+    run_state_test_generators,
+)
+
+
+def main(argv=None):
+    phase_0_mods = {
+        key: "tests.spec.phase0.block_processing.test_process_" + key
+        for key in (
+            "attestation",
+            "attester_slashing",
+            "block_header",
+            "deposit",
+            "proposer_slashing",
+            "voluntary_exit",
+        )
+    }
+    _new_altair_mods = {
+        "sync_aggregate": "tests.spec.altair.test_sync_aggregate",
+    }
+    altair_mods = combine_mods(_new_altair_mods, phase_0_mods)
+    bellatrix_mods = altair_mods
+    _new_capella_mods = {
+        "withdrawals": "tests.spec.capella.test_withdrawals",
+        "bls_to_execution_change": "tests.spec.capella.test_bls_to_execution_change",
+    }
+    capella_mods = combine_mods(_new_capella_mods, bellatrix_mods)
+
+    all_mods = {
+        "phase0": phase_0_mods,
+        "altair": altair_mods,
+        "bellatrix": bellatrix_mods,
+        "capella": capella_mods,
+    }
+    run_state_test_generators(runner_name="operations", all_mods=all_mods, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

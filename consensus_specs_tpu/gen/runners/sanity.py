@@ -1,0 +1,25 @@
+"""Sanity vector generator (reference capability:
+tests/generators/sanity/main.py): blocks + slots handlers across all
+forks, generated from the pytest-mode test modules via reflection.
+"""
+from __future__ import annotations
+
+from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+
+
+def main(argv=None):
+    phase_0_mods = {
+        "blocks": "tests.spec.phase0.sanity.test_blocks",
+        "slots": "tests.spec.phase0.sanity.test_slots",
+    }
+    all_mods = {
+        "phase0": phase_0_mods,
+        "altair": phase_0_mods,
+        "bellatrix": phase_0_mods,
+        "capella": phase_0_mods,
+    }
+    run_state_test_generators(runner_name="sanity", all_mods=all_mods, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,8 +26,9 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
-# persistent XLA compilation cache configured at package import
-# (consensus_specs_tpu.__init__) — the pairing kernels depend on it
+# persistent XLA compilation cache: _jaxcache.configure() runs when
+# limbs.py (imported below via `pairing`) first imports jax — the pairing
+# kernels' minutes-long per-shape compiles depend on it
 
 from consensus_specs_tpu.crypto.bls import ciphersuite as _py
 from consensus_specs_tpu.crypto.bls.curve import (
@@ -105,7 +106,7 @@ def _check_pairs_batch(
     for b, ps in enumerate(pairs_per_item):
         for k, (p, q) in enumerate(ps):
             if p.is_infinity() or q.is_infinity():
-                infinity_mask[k, b] = True  # whole batch falls back below
+                infinity_mask[k, b] = True  # this item falls back below
                 continue
             px[k, b], py[k, b] = _g1_coords(p)
             qx[k, b], qy[k, b] = _g2_coords(q)
@@ -172,14 +173,21 @@ def batch_fast_aggregate_verify(
         except (DeserializationError, ValueError):
             continue
     if todo:
-        # pad to a power-of-two bucket (min 2) by repeating the first item:
-        # bounded set of compiled batch shapes, shared across callers
+        # pad to a power-of-two bucket (min 2): bounded set of compiled
+        # batch shapes, shared across callers.  Pad with an infinity-free
+        # item when one exists — duplicating a dirty (infinity-carrying)
+        # item would multiply its slow host-oracle fallback by the pad count
         n = len(todo)
         bucket = 2
         while bucket < n:
             bucket *= 2
         padded = [pairs for _, pairs in todo]
-        padded.extend([todo[0][1]] * (bucket - n))
+        pad_src = next(
+            (pairs for pairs in padded
+             if not any(p.is_infinity() or q.is_infinity() for p, q in pairs)),
+            padded[0],
+        )
+        padded.extend([pad_src] * (bucket - n))
         verdicts = _check_pairs_batch(padded)
         for (b, _), v in zip(todo, verdicts[:n]):
             results[b] = bool(v)

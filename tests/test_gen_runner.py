@@ -1,0 +1,135 @@
+"""Generator pipeline tests: runner lifecycle (INCOMPLETE/resume/error
+log), part writers, and the reflection bridge over a real test module."""
+import os
+
+import yaml
+
+import pytest
+
+from consensus_specs_tpu.gen import gen_runner
+from consensus_specs_tpu.gen.gen_from_tests import combine_mods, generate_from_tests
+from consensus_specs_tpu.gen.gen_typing import TestCase, TestProvider
+from consensus_specs_tpu.gen.snappy import decompress
+from consensus_specs_tpu.testing import context
+
+
+@pytest.fixture(autouse=True)
+def _restore_pytest_flag():
+    yield
+    context.is_pytest = True
+
+
+def _case(name, fn):
+    return TestCase(
+        fork_name="phase0", preset_name="minimal", runner_name="demo",
+        handler_name="h", suite_name="s", case_name=name, case_fn=fn,
+    )
+
+
+def _provider(cases):
+    return TestProvider(prepare=lambda: None, make_cases=lambda: iter(cases))
+
+
+def _run(tmp_path, cases, extra_args=()):
+    gen_runner.run_generator(
+        "demo", [_provider(cases)], argv=["-o", str(tmp_path), *extra_args]
+    )
+
+
+def test_writes_all_part_kinds(tmp_path):
+    def fn():
+        yield "pre", "ssz", b"\x01\x02\x03"
+        yield "mapping", "data", {"a": 1}
+        yield "bls_setting", "meta", 2
+
+    _run(tmp_path, [_case("case_a", fn)])
+    case_dir = tmp_path / "minimal/phase0/demo/h/s/case_a"
+    assert decompress((case_dir / "pre.ssz_snappy").read_bytes()) == b"\x01\x02\x03"
+    assert yaml.safe_load((case_dir / "mapping.yaml").read_text()) == {"a": 1}
+    assert yaml.safe_load((case_dir / "meta.yaml").read_text()) == {"bls_setting": 2}
+    assert not (case_dir / "INCOMPLETE").exists()
+
+
+def test_existing_complete_case_skipped_without_force(tmp_path):
+    calls = []
+
+    def fn():
+        calls.append(1)
+        yield "x", "data", 1
+
+    _run(tmp_path, [_case("case_a", fn)])
+    _run(tmp_path, [_case("case_a", fn)])
+    assert len(calls) == 1
+    _run(tmp_path, [_case("case_a", fn)], extra_args=["-f"])
+    assert len(calls) == 2
+
+
+def test_incomplete_case_regenerated(tmp_path):
+    def fn():
+        yield "x", "data", 1
+
+    _run(tmp_path, [_case("case_a", fn)])
+    case_dir = tmp_path / "minimal/phase0/demo/h/s/case_a"
+    (case_dir / "INCOMPLETE").write_text("\n")
+    (case_dir / "stale.yaml").write_text("junk\n")
+    _run(tmp_path, [_case("case_a", fn)])
+    assert not (case_dir / "INCOMPLETE").exists()
+    assert not (case_dir / "stale.yaml").exists()
+    assert (case_dir / "x.yaml").exists()
+
+
+def test_error_leaves_incomplete_and_logs(tmp_path):
+    def fn():
+        yield "x", "data", 1
+        raise RuntimeError("boom")
+
+    _run(tmp_path, [_case("case_bad", fn)])
+    case_dir = tmp_path / "minimal/phase0/demo/h/s/case_bad"
+    assert (case_dir / "INCOMPLETE").exists()
+    log = (tmp_path / "testgen_error_log.txt").read_text()
+    assert "case_bad" in log and "boom" in log
+
+
+def test_skipped_test_removes_dir(tmp_path):
+    from consensus_specs_tpu.testing.exceptions import SkippedTest
+
+    def fn():
+        raise SkippedTest("not applicable")
+        yield  # pragma: no cover
+
+    _run(tmp_path, [_case("case_skip", fn)])
+    assert not (tmp_path / "minimal/phase0/demo/h/s/case_skip").exists()
+
+
+def test_preset_filter(tmp_path):
+    def fn():
+        yield "x", "data", 1
+
+    _run(tmp_path, [_case("case_a", fn)], extra_args=["-l", "mainnet"])
+    assert not (tmp_path / "minimal").exists()
+
+
+def test_generate_from_tests_reflection(tmp_path):
+    import tests.spec.phase0.sanity.test_slots as mod
+
+    cases = list(generate_from_tests(
+        runner_name="sanity", handler_name="slots", src=mod,
+        fork_name="phase0", preset_name="minimal",
+    ))
+    assert cases, "no cases discovered"
+    assert all(c.case_name and not c.case_name.startswith("test_") for c in cases)
+    context.is_pytest = False
+    try:
+        parts = list(cases[0].case_fn())
+    finally:
+        context.is_pytest = True
+    kinds = {kind for (_, kind, _) in parts}
+    assert "ssz" in kinds  # pre/post states at minimum
+
+
+def test_combine_mods():
+    a = {"x": "mod_a", "y": "mod_y"}
+    b = {"x": "mod_b"}
+    merged = combine_mods(a, b)
+    assert merged["y"] == "mod_y"
+    assert sorted(merged["x"]) == ["mod_a", "mod_b"]

@@ -1,0 +1,72 @@
+"""Snappy block-format codec tests: roundtrips, wire-format cases, and
+hand-built streams exercising every tag type."""
+import random
+
+import pytest
+
+from consensus_specs_tpu.gen.snappy import compress, decompress
+
+
+@pytest.mark.parametrize("data", [
+    b"",
+    b"a",
+    b"abc",
+    b"\x00" * 100,
+    b"ab" * 5000,
+    bytes(range(256)) * 10,
+    b"the quick brown fox jumps over the lazy dog " * 50,
+])
+def test_roundtrip(data):
+    assert decompress(compress(data)) == data
+
+
+def test_roundtrip_random():
+    rng = random.Random(5)
+    for _ in range(20):
+        n = rng.randint(0, 5000)
+        # mixture of compressible runs and random bytes
+        data = b"".join(
+            bytes([rng.randrange(4)]) * rng.randint(1, 40) for _ in range(n // 20 + 1)
+        )[:n]
+        assert decompress(compress(data)) == data
+
+
+def test_compression_actually_compresses():
+    # runs compress to ~3 bytes per 64-byte copy element (same order as
+    # reference snappy, which also caps copies at 64 bytes)
+    data = b"\x00" * 10000
+    assert len(compress(data)) < 600
+
+
+def test_decompress_handcrafted_all_tags():
+    # literal "abcd", copy1 (offset 4 len 4), copy2 (offset 2 len 5),
+    # copy4 (offset 8 len 4)
+    stream = bytearray()
+    stream += bytes([17])  # varint uncompressed length = 4+4+5+4
+    stream += bytes([(4 - 1) << 2]) + b"abcd"          # literal len 4
+    stream += bytes([((4 - 4) << 2) | 0b01, 4])        # copy1: off 4 len 4
+    stream += bytes([((5 - 1) << 2) | 0b10, 2, 0])     # copy2: off 2 len 5 (overlap)
+    stream += bytes([((4 - 1) << 2) | 0b11, 8, 0, 0, 0])  # copy4: off 8 len 4
+    out = decompress(bytes(stream))
+    assert out[:8] == b"abcdabcd"
+    assert out[8:13] == b"cdcdc"  # overlapping copy repeats the pair
+    assert len(out) == 17
+
+
+def test_decompress_long_literal_lengths():
+    for n in (59, 60, 61, 300, 70000):
+        data = bytes([7]) * n
+        assert decompress(compress(data)) == data
+
+
+def test_decompress_rejects_bad_streams():
+    with pytest.raises(ValueError):
+        decompress(b"")  # truncated varint? empty input
+    with pytest.raises(ValueError):
+        decompress(bytes([5, (4 - 1) << 2, 65]))  # truncated literal
+    with pytest.raises(ValueError):
+        # copy with offset beyond output
+        decompress(bytes([4, ((4 - 1) << 2) | 0b10, 9, 0]))
+    with pytest.raises(ValueError):
+        # length mismatch vs header
+        decompress(bytes([9, (4 - 1) << 2]) + b"abcd")
