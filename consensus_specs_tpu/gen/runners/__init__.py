@@ -1,14 +1,18 @@
 """Generator runner mains (reference capability: tests/generators/*/main.py).
 
 Each module is runnable:  python -m consensus_specs_tpu.gen.runners.<name> -o <dir>
-
-The repo root joins sys.path so the ``tests.spec.*`` vector-source modules
-import (they live beside the package, like the reference's eth2spec.test).
 """
 import os
 import sys
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))))
-if _REPO_ROOT not in sys.path:
-    sys.path.insert(0, _REPO_ROOT)
+
+def ensure_vector_sources_importable() -> None:
+    """Put the repo root on sys.path so ``tests.spec.*`` vector-source
+    modules import.  Called from runner mains only (never as an import
+    side effect): the path is added solely when it actually contains the
+    test tree, so site-packages installs don't grow a stray entry."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    if os.path.isdir(os.path.join(repo_root, "tests", "spec")) and \
+            repo_root not in sys.path:
+        sys.path.insert(0, repo_root)

@@ -1,0 +1,36 @@
+"""Epoch-processing vector generator (reference capability:
+tests/generators/epoch_processing/main.py)."""
+from __future__ import annotations
+
+from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+
+
+def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
+    phase_0_mods = {
+        key: "tests.spec.phase0.epoch_processing.test_process_" + key
+        for key in (
+            "justification_and_finalization",
+            "registry_updates",
+            "slashings",
+            "effective_balance_updates",
+        )
+    }
+    phase_0_mods["resets_and_rotations"] = (
+        "tests.spec.phase0.epoch_processing.test_resets_and_rotations"
+    )
+    all_mods = {
+        "phase0": phase_0_mods,
+        "altair": phase_0_mods,
+        "bellatrix": phase_0_mods,
+        "capella": phase_0_mods,
+    }
+    run_state_test_generators(
+        runner_name="epoch_processing", all_mods=all_mods, argv=argv
+    )
+
+
+if __name__ == "__main__":
+    main()

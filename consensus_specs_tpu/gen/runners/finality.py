@@ -1,0 +1,20 @@
+"""Finality vector generator (reference capability:
+tests/generators/finality/main.py)."""
+from __future__ import annotations
+
+from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+
+
+def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
+    mods = {"finality": "tests.spec.phase0.test_finality"}
+    all_mods = {
+        "phase0": mods, "altair": mods, "bellatrix": mods, "capella": mods,
+    }
+    run_state_test_generators(runner_name="finality", all_mods=all_mods, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,9 @@ def _create_provider(tests_src_mod_name: str, preset_name: str,
 
 
 def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
     providers = [
         _create_provider("tests.spec.altair.test_fork", preset, "phase0", "altair")
         for preset in ("minimal", "mainnet")

@@ -1,0 +1,27 @@
+"""Genesis vector generator (reference capability:
+tests/generators/genesis/main.py)."""
+from __future__ import annotations
+
+from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+
+
+def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
+    mods = {
+        "initialization": "tests.spec.phase0.genesis.test_initialization",
+        "validity": "tests.spec.phase0.genesis.test_validity",
+    }
+    all_mods = {"phase0": mods}
+    # mainnet genesis = MIN_GENESIS_ACTIVE_VALIDATOR_COUNT (16384) deposit
+    # signature verifications per case; the reference likewise excludes
+    # mainnet generation from CI (tests/generators/README.md)
+    run_state_test_generators(
+        runner_name="genesis", all_mods=all_mods, presets=("minimal",),
+        argv=argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,9 @@ from consensus_specs_tpu.gen.gen_from_tests import (
 
 
 def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
     phase_0_mods = {
         key: "tests.spec.phase0.block_processing.test_process_" + key
         for key in (

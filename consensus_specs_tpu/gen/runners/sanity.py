@@ -8,6 +8,9 @@ from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
 
 
 def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
     phase_0_mods = {
         "blocks": "tests.spec.phase0.sanity.test_blocks",
         "slots": "tests.spec.phase0.sanity.test_slots",

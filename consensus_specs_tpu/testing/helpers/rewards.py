@@ -1,0 +1,116 @@
+"""Rewards-suite helpers (reference capability: test/helpers/rewards.py).
+
+``run_deltas`` yields every reward component's (rewards, penalties) pair
+as an SSZ ``Deltas`` vector part and cross-checks each against the
+attester sets the state actually contains — then pins their sum to
+``get_attestation_deltas`` (which is the installed JAX kernel, so every
+rewards test is also a kernel differential test).
+NOTE: no ``from __future__ import annotations`` here — the Deltas
+container needs live type annotations for the SSZ field machinery.
+"""
+from consensus_specs_tpu.ssz.types import Container, List, uint64
+
+VALIDATOR_REGISTRY_LIMIT = 2**40
+Gwei = uint64
+
+
+class Deltas(Container):
+    rewards: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+    penalties: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+
+
+def has_enough_for_reward(spec, state, index) -> bool:
+    """Rewards are nonzero only when the base reward quotient is."""
+    return (
+        int(state.validators[index].effective_balance)
+        * int(spec.BASE_REWARD_FACTOR)
+        > int(spec.integer_squareroot(spec.get_total_active_balance(state)))
+        * int(spec.BASE_REWARDS_PER_EPOCH)
+    )
+
+
+def _component(spec, state, name):
+    rewards, penalties = getattr(spec, f"get_{name}_deltas")(state)
+    return Deltas(rewards=rewards, penalties=penalties)
+
+
+def _eligible_indices(spec, state):
+    prev = spec.get_previous_epoch(state)
+    return [
+        i for i, v in enumerate(state.validators)
+        if spec.is_active_validator(v, prev)
+        or (v.slashed and prev + 1 < v.withdrawable_epoch)
+    ]
+
+
+def run_deltas(spec, state):
+    """Yield all five phase0 component deltas + consistency checks."""
+    yield "pre", state
+
+    source = _component(spec, state, "source")
+    target = _component(spec, state, "target")
+    head = _component(spec, state, "head")
+    inclusion = _component(spec, state, "inclusion_delay")
+    inactivity = _component(spec, state, "inactivity_penalty")
+
+    yield "source_deltas", source
+    yield "target_deltas", target
+    yield "head_deltas", head
+    yield "inclusion_delay_deltas", inclusion
+    yield "inactivity_penalty_deltas", inactivity
+
+    # component-level sanity vs the attester sets in the state
+    matching = {
+        "source": spec.get_matching_source_attestations(
+            state, spec.get_previous_epoch(state)),
+        "target": spec.get_matching_target_attestations(
+            state, spec.get_previous_epoch(state)),
+        "head": spec.get_matching_head_attestations(
+            state, spec.get_previous_epoch(state)),
+    }
+    eligible = set(_eligible_indices(spec, state))
+    for name, deltas in (("source", source), ("target", target), ("head", head)):
+        attesters = spec.get_unslashed_attesting_indices(state, matching[name])
+        for index in range(len(state.validators)):
+            if index not in eligible:
+                assert int(deltas.rewards[index]) == 0
+                assert int(deltas.penalties[index]) == 0
+            elif index in attesters:
+                if has_enough_for_reward(spec, state, index):
+                    assert int(deltas.rewards[index]) > 0
+                assert int(deltas.penalties[index]) == 0
+            else:
+                assert int(deltas.rewards[index]) == 0
+                if has_enough_for_reward(spec, state, index):
+                    assert int(deltas.penalties[index]) > 0
+
+    # the components must sum to the full attestation deltas (the installed
+    # vectorized kernel), proving kernel == sum-of-sequential-components
+    total_r, total_p = spec.get_attestation_deltas(state)
+    for index in range(len(state.validators)):
+        assert int(total_r[index]) == sum(
+            int(d.rewards[index])
+            for d in (source, target, head, inclusion, inactivity)
+        )
+        assert int(total_p[index]) == sum(
+            int(d.penalties[index])
+            for d in (source, target, head, inclusion, inactivity)
+        )
+
+
+def leaking(epochs_extra: int = 0):
+    """Advance a state into the inactivity leak before running deltas."""
+    def deco(fn):
+        def entry(*args, spec, state, **kw):
+            from .state import next_epoch
+
+            for _ in range(
+                int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2 + epochs_extra
+            ):
+                next_epoch(spec, state)
+            assert spec.is_in_inactivity_leak(state)
+            return fn(*args, spec=spec, state=state, **kw)
+
+        return entry
+
+    return deco

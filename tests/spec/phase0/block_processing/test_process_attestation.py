@@ -1,0 +1,140 @@
+"""process_attestation operation suite (spec conformance scenarios:
+phase0/beacon-chain.md process_attestation validity rules; reference
+suite: test/phase0/block_processing/test_process_attestation.py)."""
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+    run_attestation_processing,
+    sign_attestation,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    next_slot,
+    next_slots,
+    transition_to,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_one_basic_attestation(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_previous_epoch_attestation(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_epoch(spec, state)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_attestation_signature(spec, state):
+    attestation = get_valid_attestation(spec, state)  # unsigned: zero sig
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_before_inclusion_delay(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # state.slot == attestation slot: inclusion delay not yet satisfied
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_after_epoch_slots(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH + 1)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_wrong_source_checkpoint(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.source.epoch += 10
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_bad_source_root(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.source.root = b"\x77" * 32
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_mismatched_target_and_slot(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(spec, state, slot=state.slot - spec.SLOTS_PER_EPOCH)
+    attestation.data.slot = attestation.data.slot + spec.SLOTS_PER_EPOCH
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_future_target_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.target.epoch = spec.get_current_epoch(state) + 1
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_committee_index(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    # committee count is per-slot; an index at the count is out of range
+    attestation.data.index = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state)
+    )
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_too_few_aggregation_bits(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.aggregation_bits = type(attestation.aggregation_bits)(
+        list(attestation.aggregation_bits)[:-1]
+    )
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_too_many_aggregation_bits(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.aggregation_bits = type(attestation.aggregation_bits)(
+        list(attestation.aggregation_bits) + [False]
+    )
+    yield from run_attestation_processing(spec, state, attestation, valid=False)

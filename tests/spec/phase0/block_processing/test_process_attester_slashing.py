@@ -1,0 +1,179 @@
+"""process_attester_slashing operation suite (spec rules:
+phase0/beacon-chain.md process_attester_slashing / is_slashable_attestation_data;
+reference suite: test/phase0/block_processing/test_process_attester_slashing.py)."""
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import sign_indexed_attestation
+from consensus_specs_tpu.testing.helpers.attester_slashings import (
+    get_indexed_attestation_participants,
+    get_valid_attester_slashing,
+    get_valid_attester_slashing_by_indices,
+)
+from consensus_specs_tpu.testing.helpers.state import get_balance, next_epoch
+
+
+def run_attester_slashing_processing(spec, state, attester_slashing, valid=True):
+    yield "pre", state
+    yield "attester_slashing", attester_slashing
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_attester_slashing(state, attester_slashing)
+        )
+        yield "post", None
+        return
+
+    # only the intersection of the two attestations' participants is slashed
+    slashed_indices = sorted(
+        set(get_indexed_attestation_participants(spec, attester_slashing.attestation_1))
+        & set(get_indexed_attestation_participants(spec, attester_slashing.attestation_2))
+    )
+    proposer_index = spec.get_beacon_proposer_index(state)
+    pre_proposer_balance = get_balance(state, proposer_index)
+    pre_balances = {i: get_balance(state, i) for i in slashed_indices}
+
+    spec.process_attester_slashing(state, attester_slashing)
+    yield "post", state
+
+    for i in slashed_indices:
+        assert state.validators[i].slashed
+        if i != proposer_index:
+            assert get_balance(state, i) < pre_balances[i]
+    assert get_balance(state, proposer_index) > pre_proposer_balance - (
+        pre_balances.get(proposer_index, 0) // spec.MIN_SLASHING_PENALTY_QUOTIENT
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_double(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True
+    )
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_surround(spec, state):
+    next_epoch(spec, state)
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, slot=state.slot - spec.SLOTS_PER_EPOCH, signed_1=False
+    )
+    # surround: att_1 strictly surrounds att_2 (source_1 < source_2 and
+    # target_2 < target_1), built by nudging epochs upward only
+    att_1 = attester_slashing.attestation_1
+    att_2 = attester_slashing.attestation_2
+    att_2.data.source.epoch = att_1.data.source.epoch + 1
+    att_1.data.target.epoch = att_2.data.target.epoch + 1
+    sign_indexed_attestation(spec, state, att_1)
+    sign_indexed_attestation(spec, state, att_2)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=False, signed_2=True
+    )
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_2(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=False
+    )
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_same_data(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=False
+    )
+    indexed_att_2 = attester_slashing.attestation_2
+    indexed_att_2.data = attester_slashing.attestation_1.data
+    sign_indexed_attestation(spec, state, indexed_att_2)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_no_double_or_surround(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=False
+    )
+    attester_slashing.attestation_2.data.target.epoch += 1  # disjoint
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_2)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_participants_already_slashed(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True
+    )
+    validator_indices = get_indexed_attestation_participants(
+        spec, attester_slashing.attestation_1
+    )
+    for index in validator_indices:
+        state.validators[index].slashed = True
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_unsorted_att_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=False, signed_2=True
+    )
+    indices = get_indexed_attestation_participants(
+        spec, attester_slashing.attestation_1
+    )
+    assert len(indices) >= 3
+    indices[1], indices[2] = indices[2], indices[1]  # break sorting
+    attester_slashing.attestation_1.attesting_indices = indices
+    sign_indexed_attestation(spec, state, attester_slashing.attestation_1)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_empty_indices(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=False, signed_2=True
+    )
+    attester_slashing.attestation_1.attesting_indices = []
+    attester_slashing.attestation_1.signature = spec.bls.G2_POINT_AT_INFINITY
+    yield from run_attester_slashing_processing(spec, state, attester_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_partially_overlapping_participants(spec, state):
+    # slash only the overlap of two differently-filtered attestations
+    indices = sorted(
+        get_indexed_attestation_participants(
+            spec,
+            get_valid_attester_slashing(spec, state).attestation_1,
+        )
+    )
+    assert len(indices) >= 4
+    half = len(indices) // 2
+    attester_slashing = get_valid_attester_slashing_by_indices(
+        spec, state,
+        indices_1=indices[: half + 1],
+        indices_2=indices[half - 1:],
+        signed_1=True, signed_2=True,
+    )
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)

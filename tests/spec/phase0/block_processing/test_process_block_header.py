@@ -1,0 +1,77 @@
+"""process_block_header suite (spec rules: phase0/beacon-chain.md
+process_block_header; reference suite:
+test/phase0/block_processing/test_process_block_header.py)."""
+from consensus_specs_tpu.testing.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.block import build_empty_block_for_next_slot
+
+
+def _prepare(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    return block
+
+
+def run_block_header_processing(spec, state, block, valid=True):
+    yield "pre", state
+    yield "block", block
+    if not valid:
+        expect_assertion_error(lambda: spec.process_block_header(state, block))
+        yield "post", None
+        return
+    spec.process_block_header(state, block)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_block_header(spec, state):
+    block = _prepare(spec, state)
+    yield from run_block_header_processing(spec, state, block)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_slot_block_header(spec, state):
+    block = _prepare(spec, state)
+    block.slot = state.slot + 2  # mismatched slot
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_index(spec, state):
+    block = _prepare(spec, state)
+    active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    wrong = next(i for i in active if i != block.proposer_index)
+    block.proposer_index = wrong
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_parent_root(spec, state):
+    block = _prepare(spec, state)
+    block.parent_root = b"\x12" * 32
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_multiple_blocks_single_slot(spec, state):
+    block = _prepare(spec, state)
+    spec.process_block_header(state, block)
+    child_block = block.copy()
+    child_block.parent_root = state.latest_block_header.hash_tree_root()
+    yield from run_block_header_processing(spec, state, child_block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashed(spec, state):
+    block = _prepare(spec, state)
+    state.validators[block.proposer_index].slashed = True
+    yield from run_block_header_processing(spec, state, block, valid=False)

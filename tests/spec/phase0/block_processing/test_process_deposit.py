@@ -1,0 +1,152 @@
+"""process_deposit operation suite (spec rules: phase0/beacon-chain.md
+process_deposit incl. merkle proof validation, top-ups, invalid-signature
+tolerance; reference suite:
+test/phase0/block_processing/test_process_deposit.py)."""
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.deposits import prepare_state_and_deposit
+from consensus_specs_tpu.testing.helpers.keys import privkeys, pubkeys
+from consensus_specs_tpu.testing.helpers.state import get_balance
+
+
+def run_deposit_processing(spec, state, deposit, validator_index, valid=True,
+                           effective=True):
+    pre_validator_count = len(state.validators)
+    pre_balance = 0
+    is_top_up = validator_index < pre_validator_count
+    if is_top_up:
+        pre_balance = get_balance(state, validator_index)
+
+    yield "pre", state
+    yield "deposit", deposit
+    if not valid:
+        expect_assertion_error(lambda: spec.process_deposit(state, deposit))
+        yield "post", None
+        return
+
+    spec.process_deposit(state, deposit)
+    yield "post", state
+
+    if not effective:
+        # invalid signature on a NEW deposit: no-op, never a failure
+        assert len(state.validators) == pre_validator_count
+        assert len(state.balances) == pre_validator_count
+        if is_top_up:
+            assert get_balance(state, validator_index) == pre_balance
+    else:
+        if is_top_up:
+            assert len(state.validators) == pre_validator_count
+            assert get_balance(state, validator_index) == (
+                pre_balance + deposit.data.amount
+            )
+        else:
+            assert len(state.validators) == pre_validator_count + 1
+            assert len(state.balances) == pre_validator_count + 1
+            assert get_balance(state, validator_index) == deposit.data.amount
+    assert state.eth1_deposit_index == state.eth1_data.deposit_count
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_under_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE - 1
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_over_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE + 1
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__max_effective_balance(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    state.balances[validator_index] = spec.MAX_EFFECTIVE_BALANCE
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+    assert state.validators[validator_index].effective_balance == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__zero_balance(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    state.balances[validator_index] = 0
+    state.validators[validator_index].effective_balance = 0
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_new_deposit_invalid_sig_is_noop(spec, state):
+    # unsigned new deposit: proof checks out, signature doesn't -> skipped,
+    # but processing itself MUST succeed
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, effective=False
+    )
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_top_up_invalid_sig_still_effective(spec, state):
+    # top-ups skip signature verification entirely
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_wrong_deposit_for_deposit_count(spec, state):
+    # two deposits prepared; contract count points at the first, second given
+    deposit_1 = prepare_state_and_deposit(spec, state, len(state.validators),
+                                          spec.MAX_EFFECTIVE_BALANCE, signed=True)
+    root_1 = state.eth1_data.deposit_root
+    deposit_2 = prepare_state_and_deposit(spec, state, len(state.validators) + 1,
+                                          spec.MAX_EFFECTIVE_BALANCE, signed=True)
+    state.eth1_data.deposit_root = root_1
+    state.eth1_data.deposit_count = 1
+    yield from run_deposit_processing(
+        spec, state, deposit_2, len(state.validators), valid=False
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_bad_merkle_proof(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    deposit.proof[5] = b"\x66" * 32
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, valid=False
+    )

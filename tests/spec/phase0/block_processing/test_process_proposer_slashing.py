@@ -1,0 +1,143 @@
+"""process_proposer_slashing operation suite (spec rules:
+phase0/beacon-chain.md process_proposer_slashing; reference suite:
+test/phase0/block_processing/test_process_proposer_slashing.py)."""
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.block_header import sign_block_header
+from consensus_specs_tpu.testing.helpers.keys import pubkey_to_privkey
+from consensus_specs_tpu.testing.helpers.proposer_slashings import (
+    check_proposer_slashing_effect,
+    get_valid_proposer_slashing,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+def run_proposer_slashing_processing(spec, state, proposer_slashing, valid=True):
+    """Yield the operation vector parts; apply or expect rejection."""
+    from consensus_specs_tpu.testing.context import expect_assertion_error
+
+    pre_state = state.copy()
+    yield "pre", state
+    yield "proposer_slashing", proposer_slashing
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_proposer_slashing(state, proposer_slashing)
+        )
+        yield "post", None
+        return
+    spec.process_proposer_slashing(state, proposer_slashing)
+    yield "post", state
+    slashed_index = proposer_slashing.signed_header_1.message.proposer_index
+    check_proposer_slashing_effect(spec, pre_state, state, slashed_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_basic(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_slashed_and_proposer_index_the_same(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, slashed_index=spec.get_beacon_proposer_index(state),
+        signed_1=True, signed_2=True,
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=False, signed_2=True
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_2(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=False
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_headers_are_same(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True)
+    proposer_slashing.signed_header_2 = proposer_slashing.signed_header_1
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_index_mismatch(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=False
+    )
+    header = proposer_slashing.signed_header_2.message
+    header.proposer_index = int(header.proposer_index) - 1
+    privkey = pubkey_to_privkey[
+        state.validators[proposer_slashing.signed_header_1.message.proposer_index].pubkey
+    ]
+    proposer_slashing.signed_header_2 = sign_block_header(spec, state, header, privkey)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_slots_mismatch(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=False
+    )
+    header = proposer_slashing.signed_header_2.message
+    header.slot = int(header.slot) + 1
+    privkey = pubkey_to_privkey[state.validators[header.proposer_index].pubkey]
+    proposer_slashing.signed_header_2 = sign_block_header(spec, state, header, privkey)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_not_activated(spec, state):
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    state.validators[index].activation_epoch = spec.get_current_epoch(state) + 1
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, slashed_index=index, signed_1=True, signed_2=True
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_slashed(spec, state):
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    state.validators[index].slashed = True
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, slashed_index=index, signed_1=True, signed_2=True
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_withdrawn(spec, state):
+    next_epoch(spec, state)
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    state.validators[index].withdrawable_epoch = spec.get_current_epoch(state) - 1
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, slashed_index=index, signed_1=True, signed_2=True
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, False)

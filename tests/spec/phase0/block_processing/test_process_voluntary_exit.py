@@ -1,0 +1,133 @@
+"""process_voluntary_exit operation suite (spec rules:
+phase0/beacon-chain.md process_voluntary_exit; reference suite:
+test/phase0/block_processing/test_process_voluntary_exit.py)."""
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.keys import pubkey_to_privkey
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+from consensus_specs_tpu.testing.helpers.voluntary_exits import sign_voluntary_exit
+
+FAR_FUTURE = 2**64 - 1
+
+
+def run_voluntary_exit_processing(spec, state, signed_exit, valid=True):
+    validator_index = signed_exit.message.validator_index
+    yield "pre", state
+    yield "voluntary_exit", signed_exit
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_voluntary_exit(state, signed_exit)
+        )
+        yield "post", None
+        return
+    pre_exit_epoch = state.validators[validator_index].exit_epoch
+    spec.process_voluntary_exit(state, signed_exit)
+    yield "post", state
+    assert pre_exit_epoch == spec.FAR_FUTURE_EPOCH
+    assert state.validators[validator_index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+def _eligible_state(spec, state):
+    """Fast-forward past the PERSISTENT shard committee period so exits
+    are admissible."""
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+
+
+def _signed_exit(spec, state, index, epoch=None):
+    exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state) if epoch is None else epoch,
+        validator_index=index,
+    )
+    privkey = pubkey_to_privkey[state.validators[index].pubkey]
+    return sign_voluntary_exit(spec, state, exit, privkey)
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_exit(spec, state):
+    _eligible_state(spec, state)
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    yield from run_voluntary_exit_processing(spec, state, _signed_exit(spec, state, index))
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_signature(spec, state):
+    _eligible_state(spec, state)
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    signed = _signed_exit(spec, state, index)
+    wrong_key_holder = spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state))[1]
+    signed = sign_voluntary_exit(
+        spec, state, signed.message,
+        pubkey_to_privkey[state.validators[wrong_key_holder].pubkey],
+    )
+    yield from run_voluntary_exit_processing(spec, state, signed, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_not_active(spec, state):
+    _eligible_state(spec, state)
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    yield from run_voluntary_exit_processing(
+        spec, state, _signed_exit(spec, state, index), valid=False
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_already_exited(spec, state):
+    _eligible_state(spec, state)
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    state.validators[index].exit_epoch = spec.get_current_epoch(state) + 3
+    yield from run_voluntary_exit_processing(
+        spec, state, _signed_exit(spec, state, index), valid=False
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_future_exit_epoch(spec, state):
+    _eligible_state(spec, state)
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    signed = _signed_exit(
+        spec, state, index, epoch=spec.get_current_epoch(state) + 1
+    )
+    yield from run_voluntary_exit_processing(spec, state, signed, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_before_shard_committee_period(spec, state):
+    # fresh validator: active for fewer than SHARD_COMMITTEE_PERIOD epochs
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[0]
+    yield from run_voluntary_exit_processing(
+        spec, state, _signed_exit(spec, state, index), valid=False
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_success_exit_queue_ordering(spec, state):
+    """Churn-limit worth of exits in one epoch share the exit epoch; one
+    more spills into the next."""
+    _eligible_state(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    indices = spec.get_active_validator_indices(state, current_epoch)
+    churn = spec.get_validator_churn_limit(state)
+    first = list(indices[:churn])
+    for index in first:
+        spec.process_voluntary_exit(state, _signed_exit(spec, state, index))
+    overflow_index = indices[churn]
+    signed = _signed_exit(spec, state, overflow_index)
+    yield from run_voluntary_exit_processing(spec, state, signed)
+    assert state.validators[overflow_index].exit_epoch == (
+        state.validators[first[0]].exit_epoch + 1
+    )

@@ -1,0 +1,48 @@
+"""process_effective_balance_updates suite: hysteresis thresholds in both
+directions (spec: phase0/beacon-chain.md process_effective_balance_updates;
+reference suite: test/phase0/epoch_processing/test_process_effective_balance_updates.py)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_hysteresis(spec, state):
+    # laid out as (balance, pre-effective, post-effective) probes around the
+    # hysteresis thresholds
+    max_eff = int(spec.MAX_EFFECTIVE_BALANCE)
+    min_dep = int(spec.config.EJECTION_BALANCE)  # just a small anchor
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    half_inc = inc // 2
+    quarter_inc = inc // 4
+
+    # change happens iff balance + DOWNWARD(inc/4) < eff, or
+    # eff + UPWARD(5*inc/4) < balance; new eff = min(floor(balance), MAX)
+    cases = [
+        (max_eff, max_eff, max_eff, "as-is"),
+        (max_eff, max_eff - 1, max_eff - 1, "tiny drift inside upward band: unchanged"),
+        (max_eff + 1, max_eff, max_eff, "above max: unchanged"),
+        (max_eff - quarter_inc, max_eff, max_eff, "inside downward band"),
+        (max_eff - half_inc - 1, max_eff, max_eff - inc, "outside downward band"),
+        (max_eff + inc, max_eff, max_eff, "upward inside band (capped anyway)"),
+        (max_eff - inc - half_inc - 1, max_eff, max_eff - 2 * inc, "two increments down"),
+        (max_eff - inc + quarter_inc, max_eff - inc, max_eff - inc, "inside band from below"),
+        (max_eff + quarter_inc + 1, max_eff - inc, max_eff, "outside upward band: rises"),
+        (min_dep, max_eff, min_dep - min_dep % inc, "collapse to floor"),
+    ]
+    assert len(state.validators) >= len(cases)
+    for i, (balance, pre_eff, _, _) in enumerate(cases):
+        state.balances[i] = balance
+        state.validators[i].effective_balance = pre_eff
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates"
+    )
+
+    for i, (_, _, post_eff, label) in enumerate(cases):
+        assert int(state.validators[i].effective_balance) == post_eff, label

@@ -1,0 +1,103 @@
+"""process_justification_and_finalization suite: the four FFG finality
+rules driven by crafted checkpoint/bit patterns (spec:
+phase0/beacon-chain.md weigh_justification_and_finalization; reference
+suite: test/phase0/epoch_processing/test_process_justification_and_finalization.py)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    prepare_state_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch, transition_to
+
+
+def _skip_to_epoch(spec, state, epoch):
+    transition_to(spec, state, epoch * spec.SLOTS_PER_EPOCH)
+
+
+def _fill_prev_epoch_target_attestations(spec, state):
+    """Craft full-weight previous-epoch target attestations directly (no
+    slot transitions, so justification state is untouched until the
+    handler under test runs)."""
+    prev = spec.get_previous_epoch(state)
+    start = int(spec.compute_start_slot_at_epoch(prev))
+    for slot in range(start, start + int(spec.SLOTS_PER_EPOCH)):
+        for index in range(int(spec.get_committee_count_per_slot(state, prev))):
+            committee = spec.get_beacon_committee(state, slot, index)
+            data = spec.AttestationData(
+                slot=slot, index=index,
+                beacon_block_root=spec.get_block_root_at_slot(state, slot),
+                source=state.previous_justified_checkpoint,
+                target=spec.Checkpoint(
+                    epoch=prev, root=spec.get_block_root(state, prev)),
+            )
+            state.previous_epoch_attestations.append(spec.PendingAttestation(
+                aggregation_bits=[True] * len(committee),
+                data=data, inclusion_delay=1, proposer_index=0,
+            ))
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_full_participation_justifies_previous_epoch(spec, state):
+    _skip_to_epoch(spec, state, 3)
+    _fill_prev_epoch_target_attestations(spec, state)
+    prev = spec.get_previous_epoch(state)
+    assert int(state.current_justified_checkpoint.epoch) < prev
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization"
+    )
+    assert int(state.current_justified_checkpoint.epoch) == int(prev)
+
+
+@with_all_phases
+@spec_state_test
+def test_no_attestations_no_justification(spec, state):
+    _skip_to_epoch(spec, state, 3)
+    pre_cp = state.current_justified_checkpoint.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization"
+    )
+    assert state.current_justified_checkpoint == pre_cp
+    assert int(state.finalized_checkpoint.epoch) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_first_two_epochs_skip_ffg(spec, state):
+    # current epoch <= GENESIS_EPOCH + 1: checkpoints/bits must not move
+    pre_bits = state.justification_bits.encode_bytes()
+    pre_cp = state.current_justified_checkpoint.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization"
+    )
+    assert state.justification_bits.encode_bytes() == pre_bits
+    assert state.current_justified_checkpoint == pre_cp
+    assert int(state.finalized_checkpoint.epoch) == 0
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_sustained_participation_finalizes(spec, state):
+    """Two consecutively-justified epochs finalize the older one (rule 23):
+    justify epochs 2 and 3 by hand, fill epoch-3-target attestations, and
+    the handler must finalize epoch 2."""
+    _skip_to_epoch(spec, state, 4)
+    b = state.justification_bits
+    b[0] = True  # epoch 3 justified (bit 0 = previous epoch slot)
+    b[1] = True  # epoch 2 justified
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=2, root=spec.get_block_root(state, 2))
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=3, root=spec.get_block_root(state, 3))
+    _fill_prev_epoch_target_attestations(spec, state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization"
+    )
+    assert int(state.current_justified_checkpoint.epoch) == 3
+    assert int(state.finalized_checkpoint.epoch) >= 2

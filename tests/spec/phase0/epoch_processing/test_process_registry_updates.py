@@ -1,0 +1,98 @@
+"""process_registry_updates suite: activation queueing/dequeueing under
+the churn limit and ejections (spec: phase0/beacon-chain.md
+process_registry_updates; reference suite:
+test/phase0/epoch_processing/test_process_registry_updates.py)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.deposits import mock_deposit
+from consensus_specs_tpu.testing.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+def run_process_registry_updates(spec, state):
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+
+
+@with_all_phases
+@spec_state_test
+def test_add_to_activation_queue(spec, state):
+    index = 0
+    mock_deposit(spec, state, index)
+    yield from run_process_registry_updates(spec, state)
+    # queued but not yet eligible for activation (not finalized yet)
+    assert state.validators[index].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+    assert state.validators[index].activation_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_to_activated_if_finalized(spec, state):
+    # advance so a finalized epoch > eligibility epoch is coherent (the
+    # rewards pass computes prev_epoch - finalized_epoch in uint64)
+    for _ in range(5):
+        next_epoch(spec, state)
+    index = 0
+    mock_deposit(spec, state, index)
+    state.validators[index].activation_eligibility_epoch = 1
+    state.finalized_checkpoint.epoch = 3
+    assert not spec.is_active_validator(
+        state.validators[index], spec.get_current_epoch(state))
+    yield from run_process_registry_updates(spec, state)
+    v = state.validators[index]
+    assert v.activation_epoch != spec.FAR_FUTURE_EPOCH
+    assert spec.is_active_validator(v, v.activation_epoch)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_not_finalized_stays_queued(spec, state):
+    index = 0
+    mock_deposit(spec, state, index)
+    state.validators[index].activation_eligibility_epoch = (
+        state.finalized_checkpoint.epoch + 1
+    )
+    yield from run_process_registry_updates(spec, state)
+    assert state.validators[index].activation_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_sorted_by_eligibility_then_index(spec, state):
+    churn = int(spec.get_validator_churn_limit(state))
+    n_candidates = churn + 2
+    assert len(state.validators) > n_candidates
+    state.finalized_checkpoint.epoch = 10
+    # later indices get EARLIER eligibility epochs: they must win the queue
+    for i in range(n_candidates):
+        mock_deposit(spec, state, i)
+        state.validators[i].activation_eligibility_epoch = n_candidates - i
+    yield from run_process_registry_updates(spec, state)
+    activated = [
+        i for i in range(n_candidates)
+        if state.validators[i].activation_epoch != spec.FAR_FUTURE_EPOCH
+    ]
+    # the churn-many validators with smallest (eligibility, index) activate
+    expected = sorted(
+        range(n_candidates),
+        key=lambda i: (int(state.validators[i].activation_eligibility_epoch), i),
+    )[:churn]
+    assert sorted(activated) == sorted(expected)
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection(spec, state):
+    index = 0
+    assert spec.is_active_validator(state.validators[index],
+                                    spec.get_current_epoch(state))
+    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+    yield from run_process_registry_updates(spec, state)
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+    assert not spec.is_active_validator(
+        state.validators[index],
+        spec.compute_activation_exit_epoch(spec.get_current_epoch(state)),
+    )

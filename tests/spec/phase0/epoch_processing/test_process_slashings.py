@@ -1,0 +1,95 @@
+"""process_slashings suite: correlated-penalty application at the
+half-way-to-withdrawable epoch (spec: phase0/beacon-chain.md
+process_slashings; reference suite:
+test/phase0/epoch_processing/test_process_slashings.py)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.epoch_processing import (
+    run_epoch_processing_to,
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testing.helpers.state import get_balance
+
+
+def _slash_validators(spec, state, indices, out_epochs):
+    total_slashed_balance = 0
+    for index, out_epoch in zip(indices, out_epochs):
+        v = state.validators[index]
+        v.slashed = True
+        spec.initiate_validator_exit(state, index)
+        v.withdrawable_epoch = out_epoch
+        total_slashed_balance += int(v.effective_balance)
+    state.slashings[
+        int(spec.get_current_epoch(state)) % int(spec.EPOCHS_PER_SLASHINGS_VECTOR)
+    ] = total_slashed_balance
+
+
+def _in_window(spec, state):
+    return int(spec.get_current_epoch(state) + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+
+
+@with_all_phases
+@spec_state_test
+def test_max_penalties(spec, state):
+    # slash enough stake that the proportional multiplier saturates
+    slashed_count = len(state.validators) // 3 + 1
+    # the sub-transition runs at the boundary slot, current epoch unchanged
+    out_epoch = _in_window(spec, state)
+    indices = list(range(slashed_count))
+    _slash_validators(spec, state, indices, [out_epoch] * slashed_count)
+
+    total_balance = int(spec.get_total_active_balance(state))
+    total_penalties = sum(int(x) for x in state.slashings)
+    assert total_balance // 3 <= total_penalties
+
+    pre_balances = [int(state.balances[i]) for i in indices]
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+    # per-fork proportional multiplier (later forks raise it).  All fork
+    # constants exist as module globals (preset-injected), so select by
+    # the module's fork name, not by attribute presence.
+    mult_name = {
+        "phase0": "PROPORTIONAL_SLASHING_MULTIPLIER",
+        "altair": "PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR",
+    }.get(spec.fork, "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX")
+    mult = getattr(spec, mult_name)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    adjusted = min(total_penalties * int(mult), total_balance)
+    for i, pre in zip(indices, pre_balances):
+        eff = int(state.validators[i].effective_balance)
+        expected_penalty = eff // inc * adjusted // total_balance * inc
+        assert int(state.balances[i]) == max(0, pre - expected_penalty)
+
+
+@with_all_phases
+@spec_state_test
+def test_low_penalty(spec, state):
+    # one slashed validator out of many: penalty is proportional, small
+    _slash_validators(spec, state, [5], [_in_window(spec, state)])
+    pre = get_balance(state, 5)
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+    post = get_balance(state, 5)
+    assert post < pre
+
+
+@with_all_phases
+@spec_state_test
+def test_no_penalty_outside_window(spec, state):
+    # withdrawable epoch NOT at the halfway point: no penalty this epoch
+    out_epoch = _in_window(spec, state) + 10
+    _slash_validators(spec, state, [3], [out_epoch])
+    run_epoch_processing_to(spec, state, "process_slashings")
+    pre = get_balance(state, 3)
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+    assert get_balance(state, 3) == pre
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_slashings(spec, state):
+    pre_balances = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+    assert [int(b) for b in state.balances] == pre_balances

@@ -1,0 +1,108 @@
+"""Small epoch sub-transitions: eth1 vote reset, slashings vector reset,
+randao mix rotation, historical roots accumulator, participation record
+rotation (spec: phase0/beacon-chain.md process_* final updates; reference
+suites: test/phase0/epoch_processing/test_process_{eth1_data_reset,
+slashings_reset,randao_mixes_reset,historical_roots_update,
+participation_record_updates}.py)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testing.helpers.state import transition_to
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_no_reset(spec, state):
+    assert spec.EPOCHS_PER_ETH1_VOTING_PERIOD > 1
+    # skip ahead to the epoch BEFORE the voting period boundary
+    transition_to(
+        spec, state,
+        spec.SLOTS_PER_EPOCH * (spec.EPOCHS_PER_ETH1_VOTING_PERIOD - 2),
+    )
+    for i in range(state.slot + 1):
+        state.eth1_data_votes.append(spec.Eth1Data(deposit_count=i))
+    pre_count = len(state.eth1_data_votes)
+    yield from run_epoch_processing_with(spec, state, "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == pre_count
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_reset(spec, state):
+    transition_to(
+        spec, state,
+        spec.SLOTS_PER_EPOCH * spec.EPOCHS_PER_ETH1_VOTING_PERIOD - spec.SLOTS_PER_EPOCH,
+    )
+    for i in range(3):
+        state.eth1_data_votes.append(spec.Eth1Data(deposit_count=i))
+    yield from run_epoch_processing_with(spec, state, "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_reset(spec, state):
+    next_epoch_slot_index = (
+        int(spec.get_current_epoch(state)) + 1
+    ) % int(spec.EPOCHS_PER_SLASHINGS_VECTOR)
+    state.slashings[next_epoch_slot_index] = spec.Gwei(5 * 10**9)
+    yield from run_epoch_processing_with(spec, state, "process_slashings_reset")
+    assert int(state.slashings[next_epoch_slot_index]) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_randao_mixes_rotation(spec, state):
+    current_epoch = int(spec.get_current_epoch(state))
+    vector_len = int(spec.EPOCHS_PER_HISTORICAL_VECTOR)
+    mix = spec.get_randao_mix(state, current_epoch)
+    yield from run_epoch_processing_with(spec, state, "process_randao_mixes_reset")
+    assert state.randao_mixes[(current_epoch + 1) % vector_len] == mix
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_roots_accumulator(spec, state):
+    from consensus_specs_tpu.testing.helpers.epoch_processing import (
+        run_epoch_processing_to,
+    )
+
+    period_slots = int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    transition_to(spec, state, period_slots - 2)
+    pre_len = len(state.historical_roots)
+    # snapshot the roots AFTER the runner's slot processing, right before
+    # the sub-transition itself
+    run_epoch_processing_to(spec, state, "process_historical_roots_update")
+    expected = spec.hash_tree_root(spec.HistoricalBatch(
+        block_roots=state.block_roots,
+        state_roots=state.state_roots,
+    ))
+    yield "pre", state
+    spec.process_historical_roots_update(state)
+    yield "post", state
+    assert len(state.historical_roots) == pre_len + 1
+    assert state.historical_roots[-1] == expected
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_participation_record_rotation(spec, state):
+    from consensus_specs_tpu.testing.helpers.attestations import (
+        prepare_state_with_attestations,
+    )
+
+    prepare_state_with_attestations(spec, state)
+    current = [a.copy() for a in state.current_epoch_attestations]
+    assert len(state.previous_epoch_attestations) > 0
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_record_updates"
+    )
+    assert len(state.current_epoch_attestations) == 0
+    assert [a.hash_tree_root() for a in state.previous_epoch_attestations] == [
+        a.hash_tree_root() for a in current
+    ]

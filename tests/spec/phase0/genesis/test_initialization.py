@@ -1,0 +1,87 @@
+"""Genesis initialization suite (spec: phase0/beacon-chain.md
+initialize_beacon_state_from_eth1; reference suite:
+test/phase0/genesis/test_initialization.py)."""
+from consensus_specs_tpu.testing.context import (
+    single_phase,
+    spec_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.deposits import (
+    prepare_full_genesis_deposits,
+)
+
+GENESIS_TIME = 1578009600
+
+
+@with_phases(["phase0"])
+@spec_test
+@single_phase
+def test_initialize_beacon_state_from_eth1(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, deposit_root, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True,
+    )
+    eth1_block_hash = b"\x12" * 32
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, GENESIS_TIME, deposits
+    )
+    assert state.genesis_time == GENESIS_TIME + spec.config.GENESIS_DELAY
+    assert len(state.validators) == deposit_count
+    assert state.eth1_data.deposit_root == deposit_root
+    assert state.eth1_data.deposit_count == deposit_count
+    assert state.eth1_data.block_hash == eth1_block_hash
+    assert spec.get_total_active_balance(state) == (
+        deposit_count * spec.MAX_EFFECTIVE_BALANCE
+    )
+    yield "eth1_block_hash", eth1_block_hash
+    yield "deposits", deposits
+    yield "state", state
+
+
+@with_phases(["phase0"])
+@spec_test
+@single_phase
+def test_initialize_beacon_state_some_small_balances(spec):
+    main_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    main_deposits, _, deposit_data_list = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, main_count, signed=True,
+    )
+    # additional deposits below the activation threshold
+    small_deposits, deposit_root, _ = prepare_full_genesis_deposits(
+        spec, spec.MIN_DEPOSIT_AMOUNT, 2,
+        min_pubkey_index=main_count, signed=True,
+        deposit_data_list=deposit_data_list,
+    )
+    deposits = main_deposits + small_deposits
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, GENESIS_TIME, deposits
+    )
+    assert len(state.validators) == main_count + 2
+    # only the full-balance validators are active at genesis
+    assert len(spec.get_active_validator_indices(state, 0)) == main_count
+    yield "state", state
+
+
+@with_phases(["phase0"])
+@spec_test
+@single_phase
+def test_initialize_beacon_state_one_topup_activation(spec):
+    count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    # validator 0 deposits in two halves; the top-up must activate it
+    half = spec.MAX_EFFECTIVE_BALANCE // 2
+    first_deposits, _, deposit_data_list = prepare_full_genesis_deposits(
+        spec, half, 1, signed=True,
+    )
+    rest_deposits, _, deposit_data_list = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, count - 1,
+        min_pubkey_index=1, signed=True, deposit_data_list=deposit_data_list,
+    )
+    topup_deposits, _, _ = prepare_full_genesis_deposits(
+        spec, half, 1, signed=True, deposit_data_list=deposit_data_list,
+    )
+    deposits = first_deposits + rest_deposits + topup_deposits
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, GENESIS_TIME, deposits
+    )
+    assert len(spec.get_active_validator_indices(state, 0)) == count
+    yield "state", state

@@ -1,0 +1,69 @@
+"""Genesis validity suite (spec: phase0/beacon-chain.md
+is_valid_genesis_state; reference suite:
+test/phase0/genesis/test_validity.py)."""
+from consensus_specs_tpu.testing.context import (
+    single_phase,
+    spec_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.deposits import (
+    prepare_full_genesis_deposits,
+)
+
+
+def create_valid_beacon_state(spec):
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE,
+        spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT, signed=True,
+    )
+    return spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, spec.config.MIN_GENESIS_TIME, deposits
+    )
+
+
+def run_is_valid_genesis_state(spec, state, valid=True):
+    yield "genesis", state
+    assert spec.is_valid_genesis_state(state) == valid
+    yield "is_valid", "meta", valid
+
+
+@with_phases(["phase0"])
+@spec_test
+@single_phase
+def test_full_genesis_deposits_valid(spec):
+    state = create_valid_beacon_state(spec)
+    yield from run_is_valid_genesis_state(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_test
+@single_phase
+def test_invalid_before_genesis_time(spec):
+    state = create_valid_beacon_state(spec)
+    state.genesis_time = spec.config.MIN_GENESIS_TIME - 3
+    yield from run_is_valid_genesis_state(spec, state, valid=False)
+
+
+@with_phases(["phase0"])
+@spec_test
+@single_phase
+def test_invalid_too_few_validators(spec):
+    state = create_valid_beacon_state(spec)
+    for index in range(2):
+        v = state.validators[index]
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH  # not active at genesis
+    assert len(spec.get_active_validator_indices(state, 0)) < (
+        spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    )
+    yield from run_is_valid_genesis_state(spec, state, valid=False)
+
+
+@with_phases(["phase0"])
+@spec_test
+@single_phase
+def test_exactly_min_validator_count(spec):
+    state = create_valid_beacon_state(spec)
+    assert len(spec.get_active_validator_indices(state, 0)) == (
+        spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    )
+    yield from run_is_valid_genesis_state(spec, state)

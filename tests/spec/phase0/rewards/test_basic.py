@@ -1,0 +1,93 @@
+"""Rewards suite — basic participation patterns (reference suite:
+test/phase0/rewards/test_basic.py); every case is simultaneously a
+differential test of the installed deltas kernel (helpers/rewards.py
+pins component sums against spec.get_attestation_deltas)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    prepare_state_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.rewards import leaking, run_deltas
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_empty(spec, state):
+    next_epoch(spec, state)
+    yield from run_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_full_all_correct(spec, state):
+    prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_half_full(spec, state):
+    prepare_state_with_attestations(
+        spec, state,
+        participation_fn=lambda slot, index, comm: set(list(comm)[: len(comm) // 2]),
+    )
+    yield from run_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_one_attestation_one_correct(spec, state):
+    prepare_state_with_attestations(
+        spec, state,
+        participation_fn=lambda slot, index, comm: (
+            set(list(comm)[:1]) if (slot == 0 and index == 0) else set()
+        ),
+    )
+    yield from run_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_with_slashed_validators(spec, state):
+    prepare_state_with_attestations(spec, state)
+    for index in (0, 5, 10):
+        state.validators[index].slashed = True
+    yield from run_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_some_very_low_effective_balances(spec, state):
+    prepare_state_with_attestations(spec, state)
+    for index in (0, 1, 2):
+        state.validators[index].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    yield from run_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@leaking()
+def test_empty_leak(spec, state):
+    yield from run_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@leaking()
+def test_full_leak(spec, state):
+    prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@leaking(epochs_extra=4)
+def test_half_full_deep_leak(spec, state):
+    prepare_state_with_attestations(
+        spec, state,
+        participation_fn=lambda slot, index, comm: set(list(comm)[: len(comm) // 2]),
+    )
+    yield from run_deltas(spec, state)

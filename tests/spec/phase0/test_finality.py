@@ -1,0 +1,130 @@
+"""Finality rule suite: multi-epoch block-driven scenarios exercising the
+four FFG finalization rules (spec: phase0/beacon-chain.md
+weigh_justification_and_finalization; reference suite:
+test/phase0/finality/test_finality.py)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    next_epoch_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+def check_finality(spec, state, prev_state,
+                   current_justified_changed,
+                   previous_justified_changed,
+                   finalized_changed):
+    if current_justified_changed:
+        assert state.current_justified_checkpoint.epoch > prev_state.current_justified_checkpoint.epoch
+        assert state.current_justified_checkpoint.root != prev_state.current_justified_checkpoint.root
+    else:
+        assert state.current_justified_checkpoint == prev_state.current_justified_checkpoint
+    if previous_justified_changed:
+        assert state.previous_justified_checkpoint.epoch > prev_state.previous_justified_checkpoint.epoch
+    else:
+        assert state.previous_justified_checkpoint == prev_state.previous_justified_checkpoint
+    if finalized_changed:
+        assert state.finalized_checkpoint.epoch > prev_state.finalized_checkpoint.epoch
+    else:
+        assert state.finalized_checkpoint == prev_state.finalized_checkpoint
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_finality_no_updates_at_genesis(spec, state):
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    yield "pre", state
+    blocks = []
+    for _ in range(2):
+        prev_state, new_blocks, state = next_epoch_with_attestations(
+            spec, state, True, False)
+        blocks += new_blocks
+        # FFG is frozen for the first two epochs
+        check_finality(spec, state, prev_state, False, False, False)
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_finality_rule_4(spec, state):
+    # two consecutive justified epochs: 2nd-newest finalizes (rule 4: 12)
+    yield "pre", state
+    blocks = []
+    for epoch in range(4):
+        prev_state, new_blocks, state = next_epoch_with_attestations(
+            spec, state, True, False)
+        blocks += new_blocks
+        if epoch == 2:
+            check_finality(spec, state, prev_state, True, False, False)
+        elif epoch == 3:
+            check_finality(spec, state, prev_state, True, True, True)
+            assert state.finalized_checkpoint == prev_state.current_justified_checkpoint
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_finality_rule_1(spec, state):
+    # previous-epoch attestations justify; rule 1 (234) finalizes
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    yield "pre", state
+    blocks = []
+    for epoch in range(3):
+        prev_state, new_blocks, state = next_epoch_with_attestations(
+            spec, state, False, True)
+        blocks += new_blocks
+        if epoch == 2:
+            check_finality(spec, state, prev_state, True, True, True)
+            assert state.finalized_checkpoint == prev_state.previous_justified_checkpoint
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_finality_rule_2(spec, state):
+    # justify with previous-epoch attestations only after a skipped epoch
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    yield "pre", state
+    blocks = []
+    for epoch in range(3):
+        if epoch == 0:
+            prev_state, new_blocks, state = next_epoch_with_attestations(
+                spec, state, True, False)
+            check_finality(spec, state, prev_state, True, False, False)
+        elif epoch == 1:
+            prev_state, new_blocks, state = next_epoch_with_attestations(
+                spec, state, False, False)
+            check_finality(spec, state, prev_state, False, True, False)
+        elif epoch == 2:
+            prev_state, new_blocks, state = next_epoch_with_attestations(
+                spec, state, False, True)
+            # rule 2 (23): previous justified finalizes over the gap;
+            # previous_justified itself was already rotated during the
+            # attestation-free epoch, so it does not move again here
+            check_finality(spec, state, prev_state, True, False, True)
+        blocks += new_blocks
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_no_finality_without_justification(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    yield "pre", state
+    blocks = []
+    for _ in range(2):
+        prev_state, new_blocks, state = next_epoch_with_attestations(
+            spec, state, False, False)
+        blocks += new_blocks
+        check_finality(spec, state, prev_state, False, False, False)
+    yield "blocks", blocks
+    yield "post", state
