@@ -16,7 +16,7 @@ test-mainnet:
 bench:
 	python bench.py
 
-GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing finality rewards genesis
+GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing finality rewards genesis random
 
 gen-all: $(addprefix gen-,$(GENERATORS))
 

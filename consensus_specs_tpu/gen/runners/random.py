@@ -1,0 +1,18 @@
+"""Randomized-scenario vector generator (reference capability:
+tests/generators/random/main.py)."""
+from __future__ import annotations
+
+from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+
+
+def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
+    mods = {"random": "tests.spec.phase0.random.test_random"}
+    all_mods = {"phase0": mods}
+    run_state_test_generators(runner_name="random", all_mods=all_mods, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Cross-fork transition machinery (reference capability:
+test/helpers/fork_transition.py): drive a state up to a fork epoch under
+the pre-fork spec, apply the upgrade function, and keep producing blocks
+under the post-fork spec — with slot/block filters for gap scenarios.
+"""
+from __future__ import annotations
+
+from .block import build_empty_block_for_next_slot, sign_block
+from .state import next_slot, state_transition_and_sign_block, transition_to
+
+UPGRADE_FN = {
+    "altair": "upgrade_to_altair",
+    "bellatrix": "upgrade_to_bellatrix",
+    "capella": "upgrade_to_capella",
+}
+
+
+def _all_blocks(_):
+    return True
+
+
+def skip_slots(*slots):
+    """Block filter: no proposal at the given slots."""
+    def f(state_at_prior_slot):
+        return state_at_prior_slot.slot + 1 not in slots
+
+    return f
+
+
+def no_blocks(_):
+    return False
+
+
+def only_at(slot):
+    def f(state_at_prior_slot):
+        return state_at_prior_slot.slot + 1 == slot
+
+    return f
+
+
+def state_transition_across_slots(spec, state, to_slot, block_filter=_all_blocks):
+    """Advance to ``to_slot``, yielding a signed block per admitted slot."""
+    assert state.slot < to_slot
+    while state.slot < to_slot:
+        if block_filter(state):
+            block = build_empty_block_for_next_slot(spec, state)
+            yield state_transition_and_sign_block(spec, state, block)
+        else:
+            next_slot(spec, state)
+
+
+def transition_until_fork(spec, state, fork_epoch):
+    """Pre-fork spec drives the state to the last pre-fork slot."""
+    transition_to(spec, state, fork_epoch * spec.SLOTS_PER_EPOCH - 1)
+
+
+def do_fork(state, spec, post_spec, fork_epoch, with_block=True):
+    """Process the fork-boundary slot: slot processing under the pre-fork
+    spec, the upgrade function, then optionally the first post-fork block.
+
+    Returns (state, signed_block | None).
+    """
+    spec.process_slots(state, state.slot + 1)
+    assert state.slot % spec.SLOTS_PER_EPOCH == 0
+    assert spec.compute_epoch_at_slot(state.slot) == fork_epoch
+
+    state = getattr(post_spec, UPGRADE_FN[post_spec.fork])(state)
+
+    assert state.fork.epoch == fork_epoch
+    version_name = f"{post_spec.fork.upper()}_FORK_VERSION"
+    assert state.fork.current_version == getattr(post_spec.config, version_name)
+
+    if not with_block:
+        return state, None
+    block = build_empty_block_for_next_slot(post_spec, state)
+    # the first post-fork block is produced and signed under the new spec
+    signed_block = state_transition_and_sign_block(post_spec, state, block)
+    return state, signed_block
+
+
+def transition_to_next_epoch_and_append_blocks(spec, state, post_tag, blocks,
+                                               only_last_block=False):
+    """Fill the rest of the current epoch with post-fork blocks, appending
+    tagged signed blocks to ``blocks``."""
+    to_slot = spec.SLOTS_PER_EPOCH + state.slot
+    if only_last_block:
+        block_filter = only_at(to_slot)
+    else:
+        block_filter = _all_blocks
+    blocks.extend([
+        post_tag(b)
+        for b in state_transition_across_slots(
+            spec, state, to_slot, block_filter=block_filter)
+    ])

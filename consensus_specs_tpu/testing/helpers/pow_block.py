@@ -1,0 +1,40 @@
+"""PoW-chain mocks for bellatrix terminal-block tests (reference
+capability: test/helpers/pow_block.py)."""
+from __future__ import annotations
+
+from random import Random
+
+
+class PowChain:
+    def __init__(self, blocks):
+        self.blocks = list(blocks)
+
+    def __iter__(self):
+        return iter(self.blocks)
+
+    def head(self, offset=0):
+        assert offset <= 0
+        return self.blocks[offset - 1]
+
+    def to_dict(self):
+        return {block.block_hash: block for block in self.blocks}
+
+
+def prepare_random_pow_block(spec, rng=None):
+    rng = rng or Random(3131)
+    return spec.PowBlock(
+        block_hash=spec.hash(bytes(rng.getrandbits(8) for _ in range(32))),
+        parent_hash=spec.hash(bytes(rng.getrandbits(8) for _ in range(32))),
+        total_difficulty=0,
+    )
+
+
+def prepare_random_pow_chain(spec, length, rng=None) -> PowChain:
+    assert length > 0
+    rng = rng or Random(3131)
+    chain = [prepare_random_pow_block(spec, rng)]
+    for i in range(1, length):
+        block = prepare_random_pow_block(spec, rng)
+        block.parent_hash = chain[i - 1].block_hash
+        chain.append(block)
+    return PowChain(chain)

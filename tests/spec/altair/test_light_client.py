@@ -1,0 +1,72 @@
+"""Altair light-client sync protocol tests using the light_client and
+merkle helpers (reference capability: test/altair/unittests/test_sync_protocol.py)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testing.helpers.light_client import (
+    get_sync_aggregate,
+    initialize_light_client_store,
+)
+from consensus_specs_tpu.testing.helpers.merkle import build_proof
+from consensus_specs_tpu.testing.helpers.state import (
+    next_slots,
+    state_transition_and_sign_block,
+)
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_initialize_store(spec, state):
+    store = initialize_light_client_store(spec, state)
+    assert store.current_sync_committee == state.current_sync_committee
+    assert store.next_sync_committee == state.next_sync_committee
+    assert store.best_valid_update is None
+    yield from ()
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_sync_aggregate_helper_is_block_valid(spec, state):
+    """get_sync_aggregate output passes the real process_sync_aggregate."""
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = get_sync_aggregate(
+        spec, state, block,
+        block_root=block.parent_root,
+    )
+    state_transition_and_sign_block(spec, state, block)
+    yield from ()
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_next_sync_committee_merkle_proof(spec, state):
+    """build_proof produces a branch is_valid_merkle_branch accepts for
+    NEXT_SYNC_COMMITTEE_INDEX — the exact proof light-client updates carry."""
+    proof = build_proof(state, int(spec.NEXT_SYNC_COMMITTEE_INDEX))
+    assert len(proof) == int(spec.floorlog2(spec.NEXT_SYNC_COMMITTEE_INDEX))
+    assert spec.is_valid_merkle_branch(
+        leaf=state.next_sync_committee.hash_tree_root(),
+        branch=proof,
+        depth=int(spec.floorlog2(spec.NEXT_SYNC_COMMITTEE_INDEX)),
+        index=int(spec.get_subtree_index(spec.NEXT_SYNC_COMMITTEE_INDEX)),
+        root=state.hash_tree_root(),
+    )
+    yield from ()
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_finalized_root_merkle_proof(spec, state):
+    proof = build_proof(state, int(spec.FINALIZED_ROOT_INDEX))
+    assert spec.is_valid_merkle_branch(
+        leaf=state.finalized_checkpoint.root,
+        branch=proof,
+        depth=int(spec.floorlog2(spec.FINALIZED_ROOT_INDEX)),
+        index=int(spec.get_subtree_index(spec.FINALIZED_ROOT_INDEX)),
+        root=state.hash_tree_root(),
+    )
+    yield from ()

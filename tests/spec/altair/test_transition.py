@@ -1,0 +1,105 @@
+"""Cross-fork transition scenarios using the fork_transition machinery
+(reference capability: test/altair/transition/test_transition.py over
+helpers/fork_transition.py): blocks before, at, and after the fork
+boundary, including skipped-slot gaps."""
+from consensus_specs_tpu.testing.context import (
+    spec_test,
+    with_phases,
+    with_state,
+)
+from consensus_specs_tpu.testing.helpers.constants import ALTAIR, PHASE0
+from consensus_specs_tpu.testing.helpers.fork_transition import (
+    do_fork,
+    skip_slots,
+    state_transition_across_slots,
+    transition_until_fork,
+    transition_to_next_epoch_and_append_blocks,
+)
+from consensus_specs_tpu.testing.utils import with_meta_tags
+
+FORK_EPOCH = 2
+META = {"fork": ALTAIR, "fork_epoch": FORK_EPOCH}
+
+
+def _pre_tag(b):
+    return b
+
+
+def _post_tag(b):
+    return b
+
+
+@with_phases(phases=[PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_state
+@with_meta_tags(META)
+def test_normal_transition(spec, phases, state):
+    """Blocks every slot up to, across, and past the fork boundary."""
+    post_spec = phases[ALTAIR]
+    yield "pre", state
+
+    blocks = []
+    transition_until_fork(spec, state, FORK_EPOCH)
+    blocks.extend(
+        _pre_tag(b) for b in []
+    )
+    assert spec.compute_epoch_at_slot(state.slot + 1) == FORK_EPOCH
+
+    state, fork_block = do_fork(state, spec, post_spec, FORK_EPOCH)
+    blocks.append(_post_tag(fork_block))
+
+    transition_to_next_epoch_and_append_blocks(post_spec, state, _post_tag, blocks)
+
+    yield "blocks", blocks
+    yield "post", state
+    assert state.fork.current_version == post_spec.config.ALTAIR_FORK_VERSION
+    # participation flags replaced pending attestations
+    assert len(state.previous_epoch_participation) == len(state.validators)
+
+
+@with_phases(phases=[PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_state
+@with_meta_tags(META)
+def test_transition_with_leading_blocks(spec, phases, state):
+    """Pre-fork epoch full of blocks, then the fork."""
+    post_spec = phases[ALTAIR]
+    yield "pre", state
+
+    blocks = []
+    target = FORK_EPOCH * spec.SLOTS_PER_EPOCH - 1
+    blocks.extend(
+        _pre_tag(b)
+        for b in state_transition_across_slots(spec, state, target)
+    )
+    state, fork_block = do_fork(state, spec, post_spec, FORK_EPOCH)
+    blocks.append(_post_tag(fork_block))
+
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_phases(phases=[PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_state
+@with_meta_tags(META)
+def test_transition_with_skipped_slots_around_fork(spec, phases, state):
+    post_spec = phases[ALTAIR]
+    yield "pre", state
+
+    blocks = []
+    target = FORK_EPOCH * spec.SLOTS_PER_EPOCH - 1
+    # skip the last two pre-fork proposals
+    blocks.extend(
+        _pre_tag(b)
+        for b in state_transition_across_slots(
+            spec, state, target, block_filter=skip_slots(target - 1, target))
+    )
+    state, fork_block = do_fork(state, spec, post_spec, FORK_EPOCH)
+    blocks.append(_post_tag(fork_block))
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, _post_tag, blocks, only_last_block=True)
+
+    yield "blocks", blocks
+    yield "post", state
+    assert state.fork.current_version == post_spec.config.ALTAIR_FORK_VERSION

@@ -1,0 +1,68 @@
+"""Bellatrix terminal PoW block validity tests via the pow_block helpers
+(reference capability: test/bellatrix/unittests/test_validate_merge_block.py
+family)."""
+from random import Random
+
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.pow_block import (
+    prepare_random_pow_block,
+    prepare_random_pow_chain,
+)
+
+
+@with_phases(["bellatrix", "capella"])
+@spec_state_test
+def test_is_valid_terminal_pow_block_success(spec, state):
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    rng = Random(11)
+    parent = prepare_random_pow_block(spec, rng)
+    parent.total_difficulty = ttd - 1
+    block = prepare_random_pow_block(spec, rng)
+    block.parent_hash = parent.block_hash
+    block.total_difficulty = ttd
+    assert spec.is_valid_terminal_pow_block(block, parent)
+    yield from ()
+
+
+@with_phases(["bellatrix", "capella"])
+@spec_state_test
+def test_is_valid_terminal_pow_block_fails_before_ttd(spec, state):
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    rng = Random(12)
+    parent = prepare_random_pow_block(spec, rng)
+    parent.total_difficulty = max(0, ttd - 2)
+    block = prepare_random_pow_block(spec, rng)
+    block.parent_hash = parent.block_hash
+    block.total_difficulty = max(0, ttd - 1)
+    assert not spec.is_valid_terminal_pow_block(block, parent)
+    yield from ()
+
+
+@with_phases(["bellatrix", "capella"])
+@spec_state_test
+def test_is_valid_terminal_pow_block_fails_parent_at_ttd(spec, state):
+    # parent already reached TTD: the child is not the terminal block
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    rng = Random(13)
+    parent = prepare_random_pow_block(spec, rng)
+    parent.total_difficulty = ttd
+    block = prepare_random_pow_block(spec, rng)
+    block.parent_hash = parent.block_hash
+    block.total_difficulty = ttd + 1
+    assert not spec.is_valid_terminal_pow_block(block, parent)
+    yield from ()
+
+
+@with_phases(["bellatrix", "capella"])
+@spec_state_test
+def test_pow_chain_linkage(spec, state):
+    chain = prepare_random_pow_chain(spec, 5, Random(14))
+    blocks = list(chain)
+    for parent, child in zip(blocks, blocks[1:]):
+        assert child.parent_hash == parent.block_hash
+    assert chain.head() == blocks[-1]
+    assert chain.to_dict()[blocks[2].block_hash] == blocks[2]
+    yield from ()

@@ -11,8 +11,6 @@ from consensus_specs_tpu.testing.helpers.keys import pubkey_to_privkey
 from consensus_specs_tpu.testing.helpers.state import next_epoch
 from consensus_specs_tpu.testing.helpers.voluntary_exits import sign_voluntary_exit
 
-FAR_FUTURE = 2**64 - 1
-
 
 def run_voluntary_exit_processing(spec, state, signed_exit, valid=True):
     validator_index = signed_exit.message.validator_index

@@ -44,16 +44,17 @@ def test_max_penalties(spec, state):
     total_penalties = sum(int(x) for x in state.slashings)
     assert total_balance // 3 <= total_penalties
 
+    run_epoch_processing_to(spec, state, "process_slashings")
     pre_balances = [int(state.balances[i]) for i in indices]
-    yield from run_epoch_processing_with(spec, state, "process_slashings")
-    # per-fork proportional multiplier (later forks raise it).  All fork
-    # constants exist as module globals (preset-injected), so select by
-    # the module's fork name, not by attribute presence.
-    mult_name = {
-        "phase0": "PROPORTIONAL_SLASHING_MULTIPLIER",
-        "altair": "PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR",
-    }.get(spec.fork, "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX")
-    mult = getattr(spec, mult_name)
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+    # per-fork proportional multiplier: reuse the builder's single
+    # fork->constant mapping (all fork constants are preset-injected
+    # globals, so presence probing would pick the wrong one)
+    from consensus_specs_tpu.specs.builder import _SLASHING_MULT
+
+    mult = getattr(spec, _SLASHING_MULT[spec.fork])
     inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
     adjusted = min(total_penalties * int(mult), total_balance)
     for i, pre in zip(indices, pre_balances):

@@ -1,0 +1,45 @@
+"""Randomized block scenarios (reference capability: the code-generated
+test/phase0/random/test_random.py suite): seeded random walks through
+time skips, empty and operation-bearing blocks, with and without the
+inactivity leak."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.random_scenarios import run_random_scenario
+
+
+def _make(seed, with_leak=False, stages=6):
+    @spec_state_test
+    def case(spec, state):
+        yield from run_random_scenario(
+            spec, state, seed=seed, stages=stages, with_leak=with_leak)
+
+    return with_phases(["phase0"])(case)
+
+
+test_random_0 = _make(100)
+test_random_1 = _make(201)
+test_random_2 = _make(302)
+test_random_3 = _make(403)
+test_random_leak_0 = _make(504, with_leak=True, stages=4)
+test_random_leak_1 = _make(605, with_leak=True, stages=4)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_randomized_state_scenario(spec, state):
+    """Compound state randomizer (helpers/random.py) feeding the scenario
+    engine: exits, slashings and balance drift survive full transitions."""
+    from random import Random
+
+    from consensus_specs_tpu.testing.helpers.random import (
+        patch_state_to_non_leaking,
+        randomize_state,
+    )
+    from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+    next_epoch(spec, state)
+    randomize_state(spec, state, Random(909), exit_fraction=0.1, slash_fraction=0.05)
+    patch_state_to_non_leaking(spec, state)
+    yield from run_random_scenario(spec, state, seed=909, stages=4)

@@ -1,0 +1,14 @@
+"""Full-random-operations block test (reference capability:
+test/helpers/multi_operations.py driving sanity blocks)."""
+import random
+
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.helpers.multi_operations import (
+    run_test_full_random_operations,
+)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_full_random_operations(spec, state):
+    yield from run_test_full_random_operations(spec, state, random.Random(77))
