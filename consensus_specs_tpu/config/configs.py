@@ -54,6 +54,12 @@ _MAINNET = {
     "CAPELLA_FORK_EPOCH": _UINT64_MAX,
     "SHARDING_FORK_VERSION": bytes.fromhex("04000000"),
     "SHARDING_FORK_EPOCH": _UINT64_MAX,
+    "EIP4844_FORK_VERSION": bytes.fromhex("05000000"),
+    "EIP4844_FORK_EPOCH": _UINT64_MAX,
+    "CUSTODY_GAME_FORK_VERSION": bytes.fromhex("06000000"),
+    "CUSTODY_GAME_FORK_EPOCH": _UINT64_MAX,
+    "DAS_FORK_VERSION": bytes.fromhex("07000000"),
+    "DAS_FORK_EPOCH": _UINT64_MAX,
     # Time parameters
     "SECONDS_PER_SLOT": 12,
     "SECONDS_PER_ETH1_BLOCK": 14,
@@ -87,6 +93,9 @@ _MINIMAL = dict(
     BELLATRIX_FORK_VERSION=bytes.fromhex("02000001"),
     CAPELLA_FORK_VERSION=bytes.fromhex("03000001"),
     SHARDING_FORK_VERSION=bytes.fromhex("04000001"),
+    EIP4844_FORK_VERSION=bytes.fromhex("05000001"),
+    CUSTODY_GAME_FORK_VERSION=bytes.fromhex("06000001"),
+    DAS_FORK_VERSION=bytes.fromhex("07000001"),
     SECONDS_PER_SLOT=6,
     SHARD_COMMITTEE_PERIOD=64,
     ETH1_FOLLOW_DISTANCE=16,

@@ -108,9 +108,62 @@ _CAPELLA_BOTH = {
     "MAX_WITHDRAWALS_PER_PAYLOAD": 16,
 }
 
+# EIP-4844 preset (specs/eip4844/beacon-chain.md:56-60, p2p MAX_BLOBS):
+# minimal shrinks the blob domain — the spec explicitly allows an insecure
+# minimal trusted-setup variant for testing.
+_EIP4844_MAINNET = {
+    "FIELD_ELEMENTS_PER_BLOB": 4096,
+    "MAX_BLOBS_PER_BLOCK": 16,
+}
+_EIP4844_MINIMAL = {
+    "FIELD_ELEMENTS_PER_BLOB": 16,
+    "MAX_BLOBS_PER_BLOCK": 16,
+}
+
+# Sharding preset (specs/sharding/beacon-chain.md:147-182); minimal
+# shrinks the sample-blob domain so insecure setups stay instant.
+_SHARDING_MAINNET = {
+    "MAX_SHARDS": 2**10,
+    "INITIAL_ACTIVE_SHARDS": 2**6,
+    "SAMPLE_PRICE_ADJUSTMENT_COEFFICIENT": 2**3,
+    "MAX_SHARD_PROPOSER_SLASHINGS": 2**4,
+    "MAX_SHARD_HEADERS_PER_SHARD": 4,
+    "SHARD_STATE_MEMORY_SLOTS": 2**8,
+    "BLOB_BUILDER_REGISTRY_LIMIT": 2**40,
+    "MAX_SAMPLES_PER_BLOB": 2**11,
+    "TARGET_SAMPLES_PER_BLOB": 2**10,
+    "MAX_SAMPLE_PRICE": 2**33,
+    "MIN_SAMPLE_PRICE": 2**3,
+}
+_SHARDING_MINIMAL = dict(
+    _SHARDING_MAINNET,
+    MAX_SHARDS=2**4,
+    INITIAL_ACTIVE_SHARDS=2**1,
+    MAX_SAMPLES_PER_BLOB=2**3,
+    TARGET_SAMPLES_PER_BLOB=2**2,
+)
+
+# Custody game preset (specs/custody_game/beacon-chain.md preset tables)
+_CUSTODY_BOTH = {
+    "MAX_CUSTODY_CHUNK_CHALLENGE_RECORDS": 2**20,
+    "EPOCHS_PER_CUSTODY_PERIOD": 2**14,
+    "CUSTODY_PERIOD_TO_RANDAO_PADDING": 2**11,
+    "MAX_CHUNK_CHALLENGE_DELAY": 2**15,
+    "MAX_CUSTODY_KEY_REVEALS": 2**8,
+    "MAX_EARLY_DERIVED_SECRET_REVEALS": 2**0,
+    "MAX_CUSTODY_CHUNK_CHALLENGES": 2**2,
+    "MAX_CUSTODY_CHUNK_CHALLENGE_RESPONSES": 2**4,
+    "MAX_CUSTODY_SLASHINGS": 2**0,
+}
+
+_EXPERIMENTAL_MAINNET = {**_EIP4844_MAINNET, **_SHARDING_MAINNET, **_CUSTODY_BOTH}
+_EXPERIMENTAL_MINIMAL = {**_EIP4844_MINIMAL, **_SHARDING_MINIMAL, **_CUSTODY_BOTH}
+
 _PRESETS: Dict[str, Dict[str, int]] = {
-    "mainnet": {**_PHASE0_MAINNET, **_ALTAIR_MAINNET, **_BELLATRIX_BOTH, **_CAPELLA_BOTH},
-    "minimal": {**_PHASE0_MINIMAL, **_ALTAIR_MINIMAL, **_BELLATRIX_BOTH, **_CAPELLA_BOTH},
+    "mainnet": {**_PHASE0_MAINNET, **_ALTAIR_MAINNET, **_BELLATRIX_BOTH,
+                **_CAPELLA_BOTH, **_EXPERIMENTAL_MAINNET},
+    "minimal": {**_PHASE0_MINIMAL, **_ALTAIR_MINIMAL, **_BELLATRIX_BOTH,
+                **_CAPELLA_BOTH, **_EXPERIMENTAL_MINIMAL},
 }
 
 

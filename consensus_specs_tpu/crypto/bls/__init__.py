@@ -140,3 +140,17 @@ def AggregatePKs(pubkeys):
 @only_with_bls(alt_return=STUB_PUBKEY)
 def SkToPk(SK):
     return bls.SkToPk(SK)
+
+
+@only_with_bls(alt_return=None)
+def Pairing(p_g1, q_g2):
+    """e(P, Q) as a comparable GT element (the sharding spec's degree-proof
+    check compares two pairings; reference analogue: py_ecc pairing via
+    the bls wrapper).  Accepts 48-byte G1 / 96-byte G2 encodings or curve
+    Points.  With BLS disabled both sides stub to None and compare equal."""
+    from .curve import Point, g1_from_bytes, g2_from_bytes
+    from .pairing import pairing
+
+    p = p_g1 if isinstance(p_g1, Point) else g1_from_bytes(bytes(p_g1))
+    q = q_g2 if isinstance(q_g2, Point) else g2_from_bytes(bytes(q_g2))
+    return pairing(p, q)

@@ -1,0 +1,187 @@
+"""Batched G1 scalar multiplication for KZG commitments (BASELINE config
+5; reference analogue: the G1 MSM inside eip4844's blob_to_kzg,
+specs/eip4844/beacon-chain.md:112-120).
+
+Device layout: N lanes of (affine point, 255-bit scalar); a lax.scan over
+bit-planes runs the double-and-add for ALL lanes at once on the Montgomery
+limb representation from ops/bls_jax.  The per-lane products return to the
+host, which finishes the (tiny) N-way sum on the oracle curve — the
+O(N * 255) field work is the device's, the O(N) tail is not worth a
+collective.  Multi-chip: shard the lane axis with shard_map (the scan body
+is purely elementwise over lanes, so sharding is trivial).
+
+Degenerate add cases (equal-x, infinity) are resolved branchlessly with
+canonical-equality selects, so structured scalars cannot corrupt lanes.
+Differential test vs the host oracle: tests/crypto/test_kzg.py.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from consensus_specs_tpu.crypto.bls.curve import Point, g1_infinity
+from consensus_specs_tpu.crypto.fr import R as FR_ORDER
+
+from .bls_jax import limbs
+
+_N_BITS = 255
+
+
+def _sel(mask, a, b):
+    """mask [...] selecting between limb arrays [..., 16]."""
+    return jnp.where(mask[..., None], a, b)
+
+
+def _is_zero(a):
+    return limbs.is_zero_canonical(limbs.canonical(a))
+
+
+def _eq(a, b):
+    return limbs.eq_canonical(limbs.canonical(a), limbs.canonical(b))
+
+
+def _dbl(X, Y, Z):
+    """Jacobian doubling (dbl-2009-l), lazy adds + renorm; Z=0 stays 0."""
+    mul, rn = limbs.mul, limbs.renorm
+    A = mul(X, X)
+    B = mul(Y, Y)
+    C = mul(B, B)
+    D = rn(2 * (mul(rn(X + B), rn(X + B)) - A - C))
+    E = rn(3 * A)
+    F = mul(E, E)
+    X3 = rn(F - 2 * D)
+    Y3 = rn(mul(E, rn(D - X3)) - 8 * C)
+    Z3 = rn(2 * mul(Y, Z))
+    return X3, Y3, Z3
+
+
+def _madd(X1, Y1, Z1, x2, y2):
+    """Mixed add (madd-2007-bl) of jacobian (X1,Y1,Z1) + affine (x2,y2),
+    with branchless handling of P1 = inf, equal-x double, and inverse."""
+    mul, rn = limbs.mul, limbs.renorm
+    Z1Z1 = mul(Z1, Z1)
+    U2 = mul(x2, Z1Z1)
+    S2 = mul(mul(y2, Z1), Z1Z1)
+    H = rn(U2 - X1)
+    HH = mul(H, H)
+    I = rn(4 * HH)
+    J = mul(H, I)
+    r = rn(2 * (S2 - Y1))
+    V = mul(X1, I)
+    rr = mul(r, r)
+    X3 = rn(rr - J - 2 * V)
+    Y3 = rn(mul(r, rn(V - X3)) - 2 * mul(Y1, J))
+    Z3 = rn(mul(rn(Z1 + H), rn(Z1 + H)) - Z1Z1 - HH)
+
+    p1_inf = _is_zero(Z1)
+    h_zero = _is_zero(H)
+    r_zero = _is_zero(r)
+    # equal-x, equal-y: the true result is double(P1)
+    dX, dY, dZ = _dbl(X1, Y1, Z1)
+    # equal-x, opposite-y: infinity (Z=0)
+    zero = jnp.zeros_like(Z3)
+
+    X3 = _sel(h_zero & r_zero, dX, _sel(h_zero & ~r_zero, X3, X3))
+    Y3 = _sel(h_zero & r_zero, dY, Y3)
+    Z3 = _sel(h_zero & r_zero, dZ, _sel(h_zero & ~r_zero, zero, Z3))
+
+    one = jnp.broadcast_to(jnp.asarray(limbs.MONT_ONE_LIMBS), x2.shape)
+    X3 = _sel(p1_inf, x2, X3)
+    Y3 = _sel(p1_inf, y2, Y3)
+    Z3 = _sel(p1_inf, one, Z3)
+    return X3, Y3, Z3
+
+
+# Device choice: the scan is int64 limb arithmetic — TPU hardware emulates
+# int64 on 32-bit lanes and the axon-tunneled chip faults on the 4096-lane
+# scan, so the host CPU XLA backend is the default.  CSTPU_KZG_BACKEND=tpu
+# opts into the accelerator (appropriate on non-tunneled TPU VMs with an
+# int32-limb rework).
+import os as _os
+
+
+def _msm_device():
+    want = _os.environ.get("CSTPU_KZG_BACKEND", "cpu")
+    try:
+        return jax.local_devices(backend=want)[0]
+    except RuntimeError:
+        return None
+
+
+@jax.jit
+def _msm_lanes(px, py, bits):
+    """Per-lane scalar multiplication.
+
+    px, py: [N, 16] affine Montgomery limbs; bits: [255, N] int32
+    (MSB-first).  Returns jacobian [N, 16] triples."""
+    N = px.shape[0]
+    X = jnp.zeros((N, limbs.N_LIMBS), dtype=jnp.int64)
+    Y = jnp.broadcast_to(jnp.asarray(limbs.MONT_ONE_LIMBS), (N, limbs.N_LIMBS))
+    Z = jnp.zeros((N, limbs.N_LIMBS), dtype=jnp.int64)  # infinity
+
+    def step(carry, bit_row):
+        X, Y, Z = carry
+        X, Y, Z = _dbl(X, Y, Z)
+        aX, aY, aZ = _madd(X, Y, Z, px, py)
+        m = bit_row > 0
+        return (_sel(m, aX, X), _sel(m, aY, Y), _sel(m, aZ, Z)), None
+
+    (X, Y, Z), _ = jax.lax.scan(step, (X, Y, Z), bits)
+    return limbs.canonical(X), limbs.canonical(Y), limbs.canonical(Z)
+
+
+def _to_bits(scalars: Sequence[int]) -> np.ndarray:
+    out = np.zeros((_N_BITS, len(scalars)), dtype=np.int32)
+    for lane, s in enumerate(scalars):
+        s %= FR_ORDER
+        for b in range(_N_BITS):
+            out[_N_BITS - 1 - b, lane] = (s >> b) & 1
+    return out
+
+
+def _points_to_limbs(points: Sequence[Point]) -> tuple:
+    px = np.zeros((len(points), limbs.N_LIMBS), dtype=np.int64)
+    py = np.zeros_like(px)
+    for i, p in enumerate(points):
+        x, y = p.to_affine()
+        px[i] = limbs.host_to_mont(x.n)
+        py[i] = limbs.host_to_mont(y.n)
+    return px, py
+
+
+def batch_scalar_mul(points: Sequence[Point], scalars: Sequence[int]) -> List[Point]:
+    """[k_i * P_i] for all lanes in one device dispatch."""
+    from consensus_specs_tpu.crypto.bls.curve import B_G1
+    from consensus_specs_tpu.crypto.bls.fields import Fq
+
+    assert len(points) == len(scalars)
+    px, py = _points_to_limbs(points)
+    bits = _to_bits(scalars)
+    dev = _msm_device()
+    put = (lambda a: jax.device_put(a, dev)) if dev is not None else jnp.asarray
+    X, Y, Z = (np.asarray(a) for a in _msm_lanes(put(px), put(py), put(bits)))
+    out = []
+    for i in range(len(points)):
+        z = limbs.host_from_mont(Z[i])
+        if z == 0:
+            out.append(g1_infinity())
+            continue
+        out.append(Point(
+            Fq(limbs.host_from_mont(X[i])),
+            Fq(limbs.host_from_mont(Y[i])),
+            Fq(z),
+            B_G1,
+        ))
+    return out
+
+
+def msm(points: Sequence[Point], scalars: Sequence[int]) -> Point:
+    """sum_i k_i * P_i: device per-lane products, host tail sum."""
+    acc = g1_infinity()
+    for p in batch_scalar_mul(points, scalars):
+        acc = acc + p
+    return acc

@@ -70,8 +70,34 @@ from consensus_specs_tpu.ssz.types import (
 
 SRC_DIR = Path(__file__).parent / "src"
 
-# Fork order; a spec for fork F execs sources [phase0 .. F] in sequence.
+# Fork topology: each fork execs its parent chain's sources then its own.
+# Mainline ladder phase0 -> altair -> bellatrix -> capella; experimental
+# branches hang off bellatrix (mirrors the reference's spec-fork layout:
+# eip4844/fork.md builds on bellatrix, sharding/custody_game/das are
+# bellatrix-era research forks).
+FORK_PARENTS = {
+    "phase0": None,
+    "altair": "phase0",
+    "bellatrix": "altair",
+    "capella": "bellatrix",
+    "eip4844": "bellatrix",
+    "sharding": "bellatrix",
+    "custody_game": "sharding",
+    "das": "sharding",
+}
+
+# Mainline order (kept for callers that iterate the production ladder).
 FORK_ORDER = ("phase0", "altair", "bellatrix", "capella")
+
+
+def fork_chain(fork: str) -> Tuple[str, ...]:
+    """Ancestor chain root-first, ending at ``fork``."""
+    chain = []
+    cur: Optional[str] = fork
+    while cur is not None:
+        chain.append(cur)
+        cur = FORK_PARENTS[cur]
+    return tuple(reversed(chain))
 
 # Config vars are typed when materialized (reference types them in the
 # Configuration NamedTuple, setup.py:632-639).
@@ -83,6 +109,9 @@ _CONFIG_TYPES = {
     "BELLATRIX_FORK_VERSION": ByteVector[4],
     "CAPELLA_FORK_VERSION": ByteVector[4],
     "SHARDING_FORK_VERSION": ByteVector[4],
+    "EIP4844_FORK_VERSION": ByteVector[4],
+    "CUSTODY_GAME_FORK_VERSION": ByteVector[4],
+    "DAS_FORK_VERSION": ByteVector[4],
     "DEPOSIT_CONTRACT_ADDRESS": ByteVector[20],
     "PRESET_BASE": str,
     "CONFIG_NAME": str,
@@ -307,12 +336,17 @@ def _swap(g: Dict[str, Any], name: str, fn) -> None:
     g[name] = fn
 
 
-# process_slashings carries a fork-specific proportional multiplier constant
+# process_slashings carries a fork-specific proportional multiplier
+# constant; experimental forks inherit their parent's epoch processing
 _SLASHING_MULT = {
     "phase0": "PROPORTIONAL_SLASHING_MULTIPLIER",
     "altair": "PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR",
     "bellatrix": "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX",
     "capella": "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX",
+    "eip4844": "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX",
+    "sharding": "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX",
+    "custody_game": "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX",
+    "das": "PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX",
 }
 
 
@@ -410,7 +444,7 @@ _spec_cache: Dict[Tuple[str, str], ModuleType] = {}
 def build_spec(fork: str, preset_name: str, config=None, name: str = None) -> ModuleType:
     """Build a fresh spec module (uncached). ``config`` may be a Config
     override (used by the test framework's config-override machinery)."""
-    assert fork in FORK_ORDER, f"unknown fork {fork}"
+    assert fork in FORK_PARENTS, f"unknown fork {fork}"
     preset = get_preset(preset_name)
     cfg = config if config is not None else _typed_config(get_config(preset_name).to_dict())
 
@@ -424,7 +458,7 @@ def build_spec(fork: str, preset_name: str, config=None, name: str = None) -> Mo
     sys.modules[mod_name] = mod
 
     prev: Optional[ModuleType] = None
-    for f in FORK_ORDER:
+    for f in fork_chain(fork):
         if prev is not None:
             # predecessor module available under its fork name for
             # upgrade_to_* functions

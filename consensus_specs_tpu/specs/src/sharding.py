@@ -1,0 +1,623 @@
+# Sharding executable spec (transcribes specs/sharding/beacon-chain.md of
+# the reference snapshot; builds on bellatrix).  The KZG size-verification
+# setup is the insecure deterministic variant (crypto/kzg.py), generated
+# lazily at the preset's sample-domain size.
+
+# Custom types (sharding/beacon-chain.md:85-94)
+Shard = uint64
+BLSCommitment = Bytes48
+BLSPoint = uint256
+BuilderIndex = uint64
+
+# Constants (sharding/beacon-chain.md:96-145)
+PRIMITIVE_ROOT_OF_UNITY = 7
+DATA_AVAILABILITY_INVERSE_CODING_RATE = 2**1
+POINTS_PER_SAMPLE = uint64(2**3)
+MODULUS = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+DOMAIN_SHARD_BLOB = Bytes4(bytes.fromhex("80000000"))
+# used by process_shard_proposer_slashing (the draft md references it
+# without a table entry; value chosen in the unused-domain range)
+DOMAIN_SHARD_PROPOSER = Bytes4(bytes.fromhex("81000000"))
+
+SHARD_WORK_UNCONFIRMED = 0
+SHARD_WORK_CONFIRMED = 1
+SHARD_WORK_PENDING = 2
+
+TIMELY_SHARD_FLAG_INDEX = 3
+TIMELY_SHARD_WEIGHT = uint64(8)
+PARTICIPATION_FLAG_WEIGHTS = [
+    TIMELY_SOURCE_WEIGHT, TIMELY_TARGET_WEIGHT, TIMELY_HEAD_WEIGHT,
+    TIMELY_SHARD_WEIGHT,
+]
+
+ROOT_OF_UNITY = pow(
+    PRIMITIVE_ROOT_OF_UNITY,
+    (MODULUS - 1) // int(MAX_SAMPLES_PER_BLOB * POINTS_PER_SAMPLE),
+    MODULUS,
+)
+
+
+def _kzg_setups():
+    """(G1_SETUP, G2_SETUP) at the preset's sample-domain size."""
+    from consensus_specs_tpu.crypto import kzg as _kzg
+
+    n = int(MAX_SAMPLES_PER_BLOB * POINTS_PER_SAMPLE)
+    return _kzg.setup_monomial(n), _kzg.setup_g2_monomial(n)
+
+
+# Updated containers (sharding/beacon-chain.md:188-225)
+class AttestationData(Container):
+    slot: Slot
+    index: CommitteeIndex
+    beacon_block_root: Root
+    source: Checkpoint
+    target: Checkpoint
+    shard_blob_root: Root  # [New in Sharding]
+
+
+class Attestation(Container):
+    aggregation_bits: Bitlist[MAX_VALIDATORS_PER_COMMITTEE]
+    data: AttestationData
+    signature: BLSSignature
+
+
+# New containers (sharding/beacon-chain.md:227-410)
+class Builder(Container):
+    pubkey: BLSPubkey
+
+
+class DataCommitment(Container):
+    point: BLSCommitment
+    samples_count: uint64
+
+
+class AttestedDataCommitment(Container):
+    commitment: DataCommitment
+    root: Root
+    includer_index: ValidatorIndex
+
+
+class ShardBlobBody(Container):
+    commitment: DataCommitment
+    degree_proof: BLSCommitment
+    data: List[BLSPoint, POINTS_PER_SAMPLE * MAX_SAMPLES_PER_BLOB]
+    max_priority_fee_per_sample: Gwei
+    max_fee_per_sample: Gwei
+
+
+class ShardBlobBodySummary(Container):
+    commitment: DataCommitment
+    degree_proof: BLSCommitment
+    data_root: Root
+    max_priority_fee_per_sample: Gwei
+    max_fee_per_sample: Gwei
+
+
+class ShardBlob(Container):
+    slot: Slot
+    shard: Shard
+    builder_index: BuilderIndex
+    proposer_index: ValidatorIndex
+    body: ShardBlobBody
+
+
+class ShardBlobHeader(Container):
+    slot: Slot
+    shard: Shard
+    builder_index: BuilderIndex
+    proposer_index: ValidatorIndex
+    body_summary: ShardBlobBodySummary
+
+
+class SignedShardBlob(Container):
+    message: ShardBlob
+    signature: BLSSignature
+
+
+class SignedShardBlobHeader(Container):
+    message: ShardBlobHeader
+    signature: BLSSignature
+
+
+class PendingShardHeader(Container):
+    attested: AttestedDataCommitment
+    votes: Bitlist[MAX_VALIDATORS_PER_COMMITTEE]
+    weight: Gwei
+    update_slot: Slot
+
+
+class ShardBlobReference(Container):
+    slot: Slot
+    shard: Shard
+    builder_index: BuilderIndex
+    proposer_index: ValidatorIndex
+    body_root: Root
+
+
+class ShardProposerSlashing(Container):
+    slot: Slot
+    shard: Shard
+    proposer_index: ValidatorIndex
+    builder_index_1: BuilderIndex
+    builder_index_2: BuilderIndex
+    body_root_1: Root
+    body_root_2: Root
+    signature_1: BLSSignature
+    signature_2: BLSSignature
+
+
+class ShardWork(Container):
+    status: Union[
+        None,                                                   # UNCONFIRMED
+        AttestedDataCommitment,                                 # CONFIRMED
+        List[PendingShardHeader, MAX_SHARD_HEADERS_PER_SHARD],  # PENDING
+    ]
+
+
+class BeaconBlockBody(BeaconBlockBody):  # extends bellatrix body
+    shard_proposer_slashings: List[ShardProposerSlashing, MAX_SHARD_PROPOSER_SLASHINGS]
+    shard_headers: List[SignedShardBlobHeader, MAX_SHARDS * MAX_SHARD_HEADERS_PER_SHARD]
+
+
+class BeaconBlock(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: BLSSignature
+
+
+class BeaconState(BeaconState):  # extends bellatrix state
+    blob_builders: List[Builder, BLOB_BUILDER_REGISTRY_LIMIT]
+    blob_builder_balances: List[Gwei, BLOB_BUILDER_REGISTRY_LIMIT]
+    shard_buffer: Vector[List[ShardWork, MAX_SHARDS], SHARD_STATE_MEMORY_SLOTS]
+    shard_sample_price: uint64
+
+
+# Helper functions (sharding/beacon-chain.md:412-545)
+def next_power_of_two(x: int) -> int:
+    return 2 ** ((x - 1).bit_length())
+
+
+def compute_previous_slot(slot: Slot) -> Slot:
+    if slot > 0:
+        return Slot(slot - 1)
+    else:
+        return Slot(0)
+
+
+def compute_updated_sample_price(prev_price: Gwei, samples_length: uint64, active_shards: uint64) -> Gwei:
+    adjustment_quotient = active_shards * SLOTS_PER_EPOCH * SAMPLE_PRICE_ADJUSTMENT_COEFFICIENT
+    if samples_length > TARGET_SAMPLES_PER_BLOB:
+        delta = max(1, prev_price * (samples_length - TARGET_SAMPLES_PER_BLOB) // TARGET_SAMPLES_PER_BLOB // adjustment_quotient)
+        return min(prev_price + delta, MAX_SAMPLE_PRICE)
+    else:
+        delta = max(1, prev_price * (TARGET_SAMPLES_PER_BLOB - samples_length) // TARGET_SAMPLES_PER_BLOB // adjustment_quotient)
+        return max(prev_price, MIN_SAMPLE_PRICE + delta) - delta
+
+
+def compute_committee_source_epoch(epoch: Epoch, period: uint64) -> Epoch:
+    """
+    Return the source epoch for computing the committee.
+    """
+    source_epoch = Epoch(epoch - epoch % period)
+    if source_epoch >= period:
+        source_epoch -= period  # `period` epochs lookahead
+    return source_epoch
+
+
+def batch_apply_participation_flag(state: BeaconState, bits: Bitlist[MAX_VALIDATORS_PER_COMMITTEE],
+                                   epoch: Epoch, full_committee: Sequence[ValidatorIndex], flag_index: int):
+    if epoch == get_current_epoch(state):
+        epoch_participation = state.current_epoch_participation
+    else:
+        epoch_participation = state.previous_epoch_participation
+    for bit, index in zip(bits, full_committee):
+        if bit:
+            epoch_participation[index] = add_flag(epoch_participation[index], flag_index)
+
+
+def get_committee_count_per_slot(state: BeaconState, epoch: Epoch) -> uint64:
+    """
+    Return the number of committees in each slot for the given ``epoch``.
+    """
+    return max(uint64(1), min(
+        get_active_shard_count(state, epoch),
+        uint64(len(get_active_validator_indices(state, epoch))) // SLOTS_PER_EPOCH // TARGET_COMMITTEE_SIZE,
+    ))
+
+
+def get_active_shard_count(state: BeaconState, epoch: Epoch) -> uint64:
+    """
+    Return the number of active shards.
+    Note that this puts an upper bound on the number of committees per slot.
+    """
+    return INITIAL_ACTIVE_SHARDS
+
+
+def get_shard_proposer_index(state: BeaconState, slot: Slot, shard: Shard) -> ValidatorIndex:
+    """
+    Return the proposer's index of shard block at ``slot``.
+    """
+    epoch = compute_epoch_at_slot(slot)
+    seed = hash(get_seed(state, epoch, DOMAIN_SHARD_BLOB) + uint_to_bytes(slot) + uint_to_bytes(shard))
+    indices = get_active_validator_indices(state, epoch)
+    return compute_proposer_index(state, indices, seed)
+
+
+def get_start_shard(state: BeaconState, slot: Slot) -> Shard:
+    """
+    Return the start shard at ``slot``.
+    """
+    epoch = compute_epoch_at_slot(Slot(slot))
+    committee_count = get_committee_count_per_slot(state, epoch)
+    active_shard_count = get_active_shard_count(state, epoch)
+    return committee_count * slot % active_shard_count
+
+
+def compute_shard_from_committee_index(state: BeaconState, slot: Slot, index: CommitteeIndex) -> Shard:
+    active_shards = get_active_shard_count(state, compute_epoch_at_slot(slot))
+    assert index < active_shards
+    return Shard((index + get_start_shard(state, slot)) % active_shards)
+
+
+def compute_committee_index_from_shard(state: BeaconState, slot: Slot, shard: Shard) -> CommitteeIndex:
+    epoch = compute_epoch_at_slot(slot)
+    active_shards = get_active_shard_count(state, epoch)
+    index = CommitteeIndex((active_shards + shard - get_start_shard(state, slot)) % active_shards)
+    assert index < get_committee_count_per_slot(state, epoch)
+    return index
+
+
+# Block processing (sharding/beacon-chain.md:546-805)
+def process_block(state: BeaconState, block: BeaconBlock) -> None:
+    process_block_header(state, block)
+    # is_execution_enabled is omitted, execution is enabled by default.
+    process_execution_payload(state, block.body.execution_payload, EXECUTION_ENGINE)
+    process_randao(state, block.body)
+    process_eth1_data(state, block.body)
+    process_operations(state, block.body)  # [Modified in Sharding]
+    process_sync_aggregate(state, block.body.sync_aggregate)
+
+
+def process_operations(state: BeaconState, body: BeaconBlockBody) -> None:
+    # Verify that outstanding deposits are processed up to the maximum number of deposits
+    assert len(body.deposits) == min(MAX_DEPOSITS, state.eth1_data.deposit_count - state.eth1_deposit_index)
+
+    def for_ops(operations: Sequence[Any], fn: Callable[[BeaconState, Any], None]) -> None:
+        for operation in operations:
+            fn(state, operation)
+
+    for_ops(body.proposer_slashings, process_proposer_slashing)
+    for_ops(body.attester_slashings, process_attester_slashing)
+    # New shard proposer slashing processing
+    for_ops(body.shard_proposer_slashings, process_shard_proposer_slashing)
+
+    # Limit is dynamic: based on active shard count
+    assert len(body.shard_headers) <= MAX_SHARD_HEADERS_PER_SHARD * get_active_shard_count(state, get_current_epoch(state))
+    for_ops(body.shard_headers, process_shard_header)
+
+    # New attestation processing
+    for_ops(body.attestations, process_attestation)
+    for_ops(body.deposits, process_deposit)
+    for_ops(body.voluntary_exits, process_voluntary_exit)
+
+
+def process_attestation(state: BeaconState, attestation: Attestation) -> None:
+    altair.process_attestation(state, attestation)
+    process_attested_shard_work(state, attestation)
+
+
+def process_attested_shard_work(state: BeaconState, attestation: Attestation) -> None:
+    attestation_shard = compute_shard_from_committee_index(
+        state,
+        attestation.data.slot,
+        attestation.data.index,
+    )
+    full_committee = get_beacon_committee(state, attestation.data.slot, attestation.data.index)
+
+    buffer_index = attestation.data.slot % SHARD_STATE_MEMORY_SLOTS
+    committee_work = state.shard_buffer[buffer_index][attestation_shard]
+
+    # Skip attestation vote accounting if the header is not pending
+    if committee_work.status.selector != SHARD_WORK_PENDING:
+        # If the data was already confirmed, check if this matches, to apply the flag to the attesters.
+        if committee_work.status.selector == SHARD_WORK_CONFIRMED:
+            attested = committee_work.status.value
+            if attested.root == attestation.data.shard_blob_root:
+                batch_apply_participation_flag(state, attestation.aggregation_bits,
+                                               attestation.data.target.epoch,
+                                               full_committee, TIMELY_SHARD_FLAG_INDEX)
+        return
+
+    current_headers = committee_work.status.value
+
+    # Find the corresponding header, abort if it cannot be found
+    header_index = len(current_headers)
+    for i, header in enumerate(current_headers):
+        if attestation.data.shard_blob_root == header.attested.root:
+            header_index = i
+            break
+
+    # Attestations for an unknown header do not count towards shard confirmations, but can otherwise be valid.
+    if header_index == len(current_headers):
+        # Note: Attestations may be re-included if headers are included late.
+        return
+
+    pending_header = current_headers[header_index]
+
+    # The weight may be outdated if it is not the initial weight, and from a previous epoch
+    if pending_header.weight != 0 and compute_epoch_at_slot(pending_header.update_slot) < get_current_epoch(state):
+        pending_header.weight = sum(state.validators[index].effective_balance for index, bit
+                                    in zip(full_committee, pending_header.votes) if bit)
+
+    pending_header.update_slot = state.slot
+
+    full_committee_balance = Gwei(0)
+    # Update votes bitfield in the state, update weights
+    for i, bit in enumerate(attestation.aggregation_bits):
+        weight = state.validators[full_committee[i]].effective_balance
+        full_committee_balance += weight
+        if bit:
+            if not pending_header.votes[i]:
+                pending_header.weight += weight
+                pending_header.votes[i] = True
+
+    # Check if the PendingShardHeader is eligible for expedited confirmation, requiring 2/3 of balance attesting
+    if pending_header.weight * 3 >= full_committee_balance * 2:
+        # participants of the winning header are remembered with participation flags
+        batch_apply_participation_flag(state, pending_header.votes, attestation.data.target.epoch,
+                                       full_committee, TIMELY_SHARD_FLAG_INDEX)
+
+        if pending_header.attested.commitment == DataCommitment():
+            # The committee voted to not confirm anything
+            state.shard_buffer[buffer_index][attestation_shard].status.change(
+                selector=SHARD_WORK_UNCONFIRMED,
+                value=None,
+            )
+        else:
+            state.shard_buffer[buffer_index][attestation_shard].status.change(
+                selector=SHARD_WORK_CONFIRMED,
+                value=pending_header.attested,
+            )
+
+
+def process_shard_header(state: BeaconState, signed_header: SignedShardBlobHeader) -> None:
+    header = signed_header.message
+    slot = header.slot
+    shard = header.shard
+
+    # Verify the header is not 0, and not from the future.
+    assert Slot(0) < slot <= state.slot
+    header_epoch = compute_epoch_at_slot(slot)
+    # Verify that the header is within the processing time window
+    assert header_epoch in [get_previous_epoch(state), get_current_epoch(state)]
+    # Verify that the shard is valid
+    shard_count = get_active_shard_count(state, header_epoch)
+    assert shard < shard_count
+    # Verify that a committee is able to attest this (slot, shard)
+    start_shard = get_start_shard(state, slot)
+    committee_index = (shard_count + shard - start_shard) % shard_count
+    committees_per_slot = get_committee_count_per_slot(state, header_epoch)
+    assert committee_index <= committees_per_slot
+
+    # Check that this data is still pending
+    committee_work = state.shard_buffer[slot % SHARD_STATE_MEMORY_SLOTS][shard]
+    assert committee_work.status.selector == SHARD_WORK_PENDING
+
+    # Check that this header is not yet in the pending list
+    current_headers = committee_work.status.value
+    header_root = hash_tree_root(header)
+    assert header_root not in [pending_header.attested.root for pending_header in current_headers]
+
+    # Verify proposer matches
+    assert header.proposer_index == get_shard_proposer_index(state, slot, shard)
+
+    # Verify builder and proposer aggregate signature
+    blob_signing_root = compute_signing_root(header, get_domain(state, DOMAIN_SHARD_BLOB))
+    builder_pubkey = state.blob_builders[header.builder_index].pubkey
+    proposer_pubkey = state.validators[header.proposer_index].pubkey
+    assert bls.FastAggregateVerify([builder_pubkey, proposer_pubkey], blob_signing_root, signed_header.signature)
+
+    # Verify the length by verifying the degree.
+    g1_setup, g2_setup = _kzg_setups()
+    body_summary = header.body_summary
+    points_count = body_summary.commitment.samples_count * POINTS_PER_SAMPLE
+    if points_count == 0:
+        from consensus_specs_tpu.crypto.bls.curve import g1_to_bytes
+        assert body_summary.degree_proof == g1_to_bytes(g1_setup[0])
+    assert (
+        bls.Pairing(body_summary.degree_proof, g2_setup[0])
+        == bls.Pairing(body_summary.commitment.point, g2_setup[-int(points_count)])
+    )
+
+    # Charge EIP 1559 fee, builder pays for opportunity, and is responsible for later availability,
+    # or fail to publish at their own expense.
+    samples = body_summary.commitment.samples_count
+    max_fee = body_summary.max_fee_per_sample * samples
+
+    # Builder must have sufficient balance, even if max_fee is not completely utilized
+    assert state.blob_builder_balances[header.builder_index] >= max_fee
+
+    base_fee = state.shard_sample_price * samples
+    # Base fee must be paid
+    assert max_fee >= base_fee
+
+    # Remaining fee goes towards proposer for prioritizing, up to a maximum
+    max_priority_fee = body_summary.max_priority_fee_per_sample * samples
+    priority_fee = min(max_fee - base_fee, max_priority_fee)
+
+    # Burn base fee, take priority fee
+    state.blob_builder_balances[header.builder_index] -= base_fee + priority_fee
+    # Pay out priority fee
+    increase_balance(state, header.proposer_index, priority_fee)
+
+    # Initialize the pending header
+    index = compute_committee_index_from_shard(state, slot, shard)
+    committee_length = len(get_beacon_committee(state, slot, index))
+    initial_votes = Bitlist[MAX_VALIDATORS_PER_COMMITTEE]([0] * committee_length)
+    pending_header = PendingShardHeader(
+        attested=AttestedDataCommitment(
+            commitment=body_summary.commitment,
+            root=header_root,
+            includer_index=get_beacon_proposer_index(state),
+        ),
+        votes=initial_votes,
+        weight=0,
+        update_slot=state.slot,
+    )
+
+    # Include it in the pending list
+    current_headers.append(pending_header)
+
+
+def process_shard_proposer_slashing(state: BeaconState, proposer_slashing: ShardProposerSlashing) -> None:
+    slot = proposer_slashing.slot
+    shard = proposer_slashing.shard
+    proposer_index = proposer_slashing.proposer_index
+
+    reference_1 = ShardBlobReference(slot=slot, shard=shard,
+                                     proposer_index=proposer_index,
+                                     builder_index=proposer_slashing.builder_index_1,
+                                     body_root=proposer_slashing.body_root_1)
+    reference_2 = ShardBlobReference(slot=slot, shard=shard,
+                                     proposer_index=proposer_index,
+                                     builder_index=proposer_slashing.builder_index_2,
+                                     body_root=proposer_slashing.body_root_2)
+
+    # Verify the signed messages are different
+    assert reference_1 != reference_2
+
+    # Verify the proposer is slashable
+    proposer = state.validators[proposer_index]
+    assert is_slashable_validator(proposer, get_current_epoch(state))
+
+    # The builders are not slashed, the proposer co-signed with them
+    builder_pubkey_1 = state.blob_builders[proposer_slashing.builder_index_1].pubkey
+    builder_pubkey_2 = state.blob_builders[proposer_slashing.builder_index_2].pubkey
+    domain = get_domain(state, DOMAIN_SHARD_PROPOSER, compute_epoch_at_slot(slot))
+    signing_root_1 = compute_signing_root(reference_1, domain)
+    signing_root_2 = compute_signing_root(reference_2, domain)
+    assert bls.FastAggregateVerify([builder_pubkey_1, proposer.pubkey], signing_root_1, proposer_slashing.signature_1)
+    assert bls.FastAggregateVerify([builder_pubkey_2, proposer.pubkey], signing_root_2, proposer_slashing.signature_2)
+
+    slash_validator(state, proposer_index)
+
+
+# Epoch transition (sharding/beacon-chain.md:805-888)
+def process_epoch(state: BeaconState) -> None:
+    # Sharding pre-processing
+    process_pending_shard_confirmations(state)
+    reset_pending_shard_work(state)
+
+    # Base functionality
+    process_justification_and_finalization(state)
+    process_inactivity_updates(state)
+    process_rewards_and_penalties(state)
+    process_registry_updates(state)
+    process_slashings(state)
+    process_eth1_data_reset(state)
+    process_effective_balance_updates(state)
+    process_slashings_reset(state)
+    process_randao_mixes_reset(state)
+    process_historical_roots_update(state)
+    process_participation_flag_updates(state)
+    process_sync_committee_updates(state)
+
+
+def process_pending_shard_confirmations(state: BeaconState) -> None:
+    # Pending header processing applies to the previous epoch.
+    # Skip if `GENESIS_EPOCH` because no prior epoch to process.
+    if get_current_epoch(state) == GENESIS_EPOCH:
+        return
+
+    previous_epoch = get_previous_epoch(state)
+    previous_epoch_start_slot = compute_start_slot_at_epoch(previous_epoch)
+
+    # Mark stale headers as unconfirmed
+    for slot in range(previous_epoch_start_slot, previous_epoch_start_slot + SLOTS_PER_EPOCH):
+        buffer_index = slot % SHARD_STATE_MEMORY_SLOTS
+        for shard_index in range(len(state.shard_buffer[buffer_index])):
+            committee_work = state.shard_buffer[buffer_index][shard_index]
+            if committee_work.status.selector == SHARD_WORK_PENDING:
+                winning_header = max(committee_work.status.value, key=lambda header: header.weight)
+                if winning_header.attested.commitment == DataCommitment():
+                    committee_work.status.change(selector=SHARD_WORK_UNCONFIRMED, value=None)
+                else:
+                    committee_work.status.change(selector=SHARD_WORK_CONFIRMED, value=winning_header.attested)
+
+
+def reset_pending_shard_work(state: BeaconState) -> None:
+    # Add dummy "empty" PendingShardHeader (default vote if no shard header is available)
+    next_epoch = get_current_epoch(state) + 1
+    next_epoch_start_slot = compute_start_slot_at_epoch(next_epoch)
+    committees_per_slot = get_committee_count_per_slot(state, next_epoch)
+    active_shards = get_active_shard_count(state, next_epoch)
+
+    for slot in range(next_epoch_start_slot, next_epoch_start_slot + SLOTS_PER_EPOCH):
+        buffer_index = slot % SHARD_STATE_MEMORY_SLOTS
+
+        # Reset the shard work tracking
+        state.shard_buffer[buffer_index] = [ShardWork() for _ in range(active_shards)]
+
+        start_shard = get_start_shard(state, slot)
+        for committee_index in range(committees_per_slot):
+            shard = (start_shard + committee_index) % active_shards
+            # a committee is available, initialize a pending shard-header list
+            committee_length = len(get_beacon_committee(state, slot, CommitteeIndex(committee_index)))
+            state.shard_buffer[buffer_index][shard].status.change(
+                selector=SHARD_WORK_PENDING,
+                value=List[PendingShardHeader, MAX_SHARD_HEADERS_PER_SHARD]([
+                    PendingShardHeader(
+                        attested=AttestedDataCommitment(),
+                        votes=Bitlist[MAX_VALIDATORS_PER_COMMITTEE]([0] * committee_length),
+                        weight=0,
+                        update_slot=slot,
+                    )
+                ])
+            )
+        # a shard without committee available defaults to SHARD_WORK_UNCONFIRMED.
+
+
+# Fork
+def upgrade_to_sharding(pre: bellatrix.BeaconState) -> BeaconState:
+    epoch = bellatrix.get_current_epoch(pre)
+    post = BeaconState(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=Fork(
+            previous_version=pre.fork.current_version,
+            current_version=config.SHARDING_FORK_VERSION,
+            epoch=epoch,
+        ),
+        latest_block_header=pre.latest_block_header,
+        block_roots=pre.block_roots,
+        state_roots=pre.state_roots,
+        historical_roots=pre.historical_roots,
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=pre.eth1_data_votes,
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=pre.randao_mixes,
+        slashings=pre.slashings,
+        previous_epoch_participation=pre.previous_epoch_participation,
+        current_epoch_participation=pre.current_epoch_participation,
+        justification_bits=pre.justification_bits,
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=pre.inactivity_scores,
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+        latest_execution_payload_header=pre.latest_execution_payload_header,
+        shard_sample_price=MIN_SAMPLE_PRICE,
+    )
+    return post

@@ -1571,6 +1571,23 @@ class Union(View):
     def _child_changed(self, key):
         self._invalidate()
 
+    def change(self, selector: int, value) -> None:
+        """In-place re-tag (the sharding spec's ShardWork status flips:
+        ``committee_work.status.change(selector=..., value=...)``)."""
+        cls = type(self)
+        assert 0 <= selector < len(cls.OPTIONS)
+        opt = cls.OPTIONS[selector]
+        if opt is None:
+            assert value is None
+            new_value = None
+        else:
+            new_value = opt.coerce_for_store(
+                value if value is not None else opt.default(), self, "value"
+            )
+        self._selector = selector
+        self._value = new_value
+        self._invalidate()
+
     def __eq__(self, other):
         if isinstance(other, Union):
             return (

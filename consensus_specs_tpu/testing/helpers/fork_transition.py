@@ -5,14 +5,8 @@ under the post-fork spec — with slot/block filters for gap scenarios.
 """
 from __future__ import annotations
 
-from .block import build_empty_block_for_next_slot, sign_block
+from .block import build_empty_block_for_next_slot
 from .state import next_slot, state_transition_and_sign_block, transition_to
-
-UPGRADE_FN = {
-    "altair": "upgrade_to_altair",
-    "bellatrix": "upgrade_to_bellatrix",
-    "capella": "upgrade_to_capella",
-}
 
 
 def _all_blocks(_):
@@ -64,7 +58,7 @@ def do_fork(state, spec, post_spec, fork_epoch, with_block=True):
     assert state.slot % spec.SLOTS_PER_EPOCH == 0
     assert spec.compute_epoch_at_slot(state.slot) == fork_epoch
 
-    state = getattr(post_spec, UPGRADE_FN[post_spec.fork])(state)
+    state = getattr(post_spec, f"upgrade_to_{post_spec.fork}")(state)
 
     assert state.fork.epoch == fork_epoch
     version_name = f"{post_spec.fork.upper()}_FORK_VERSION"

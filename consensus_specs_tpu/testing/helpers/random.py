@@ -7,7 +7,6 @@ from random import Random
 
 from consensus_specs_tpu.testing.context import is_post_altair
 
-from .attestations import cached_prepare_state_with_attestations
 from .deposits import mock_deposit
 from .state import next_epoch
 
@@ -82,9 +81,16 @@ def slash_random_validators(spec, state, rng, fraction=0.5):
 
 
 def randomize_attestation_participation(spec, state, rng=None):
-    """Phase0: fill pending attestations with randomized participation."""
+    """Phase0: fill pending attestations with rng-driven participation."""
+    from .attestations import prepare_state_with_attestations
+
     rng = rng or Random(8020)
-    cached_prepare_state_with_attestations(spec, state)
+    prepare_state_with_attestations(
+        spec, state,
+        participation_fn=lambda slot, index, comm: {
+            i for i in comm if rng.random() < 0.75
+        },
+    )
 
 
 def patch_state_to_non_leaking(spec, state):

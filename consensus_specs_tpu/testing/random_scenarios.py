@@ -98,6 +98,11 @@ def run_random_scenario(spec, state, seed: int, stages: int = 8,
         rng.choice(_BLOCK_STAGES)(spec, state, rng, blocks)
     yield "blocks", blocks
     yield "post", state
-    # the transition applied every block; the last one is the head
-    assert state.latest_block_header.hash_tree_root() is not None
+    # the transition applied every block; the last one must be the head
+    # (the cached header's state_root stays zeroed until the next slot, so
+    # compare the slot + body root rather than the full header root)
+    assert blocks, "scenario produced no blocks"
+    last = blocks[-1].message
+    assert int(state.latest_block_header.slot) == int(last.slot)
+    assert state.latest_block_header.body_root == last.body.hash_tree_root()
     assert int(state.slot) >= stages

@@ -1,0 +1,98 @@
+"""KZG + Fr FFT + device MSM tests: host oracle self-consistency and the
+batched JAX scalar-mult differential."""
+import random
+
+import pytest
+
+from consensus_specs_tpu.crypto import fr, kzg
+from consensus_specs_tpu.crypto.bls.curve import g1_generator, g1_to_bytes
+
+rng = random.Random(1717)
+
+
+def test_fft_roundtrip_and_convolution_theorem():
+    vals = [rng.randrange(fr.R) for _ in range(128)]
+    assert fr.ifft(fr.fft(vals)) == [v % fr.R for v in vals]
+    # multiplication in evaluation form == poly_mul in coefficient form
+    a = [rng.randrange(fr.R) for _ in range(8)] + [0] * 8
+    b = [rng.randrange(fr.R) for _ in range(8)] + [0] * 8
+    ea, eb = fr.fft(a), fr.fft(b)
+    prod_evals = [x * y % fr.R for x, y in zip(ea, eb)]
+    expected = fr.poly_mul(a[:8], b[:8])
+    assert fr.ifft(prod_evals)[:15] == expected
+
+
+def test_reverse_bit_order_involution():
+    xs = list(range(16))
+    assert fr.reverse_bit_order_list(fr.reverse_bit_order_list(xs)) == xs
+
+
+@pytest.mark.parametrize("erased", [1, 16, 32])
+def test_recover_polynomial(erased):
+    evals = fr.fft([rng.randrange(fr.R) for _ in range(32)] + [0] * 32)
+    samples = list(evals)
+    for i in rng.sample(range(64), erased):
+        samples[i] = None
+    assert fr.recover_polynomial(samples) == evals
+
+
+def test_recover_rejects_too_many_erasures():
+    evals = fr.fft([1] * 8 + [0] * 8)
+    samples = [None] * 9 + list(evals[9:])
+    with pytest.raises(AssertionError):
+        fr.recover_polynomial(samples)
+
+
+def test_commitment_linearity():
+    n = 16
+    setup = kzg.setup_lagrange(n)
+    blob_a = [rng.randrange(fr.R) for _ in range(n)]
+    blob_b = [rng.randrange(fr.R) for _ in range(n)]
+    blob_sum = [(a + b) % fr.R for a, b in zip(blob_a, blob_b)]
+    ca = kzg.commitment_to_point(kzg.blob_to_kzg(blob_a, setup))
+    cb = kzg.commitment_to_point(kzg.blob_to_kzg(blob_b, setup))
+    csum = kzg.blob_to_kzg(blob_sum, setup)
+    assert g1_to_bytes(ca + cb) == csum
+
+
+def test_commitment_matches_secret_evaluation():
+    n = 32
+    setup = kzg.setup_lagrange(n)
+    blob = [rng.randrange(fr.R) for _ in range(n)]
+    c = kzg.blob_to_kzg(blob, setup)
+    assert kzg.verify_commitment_matches_poly(c, blob)
+    assert not kzg.verify_commitment_matches_poly(c, blob[::-1])
+
+
+def test_device_batch_scalar_mul_differential():
+    from consensus_specs_tpu.ops import kzg_jax
+
+    g = g1_generator()
+    points = [g.mul(i + 1) for i in range(8)]
+    scalars = [
+        0, 1, 2, fr.R - 1, rng.randrange(fr.R), rng.randrange(fr.R),
+        (fr.R + 1) // 2, 3,
+    ]
+    got = kzg_jax.batch_scalar_mul(points, scalars)
+    for p, s, out in zip(points, scalars, got):
+        assert out == p.mul(s % fr.R), f"lane with scalar {s}"
+
+
+def test_device_msm_matches_host_lincomb():
+    from consensus_specs_tpu.ops import kzg_jax
+
+    n = 8
+    setup = kzg.setup_lagrange(n)
+    blob = [rng.randrange(fr.R) for _ in range(n)]
+    host = kzg.g1_lincomb(setup, blob)
+    dev = kzg_jax.msm(setup, blob)
+    assert dev == host
+
+
+def test_pippenger_matches_naive_lincomb():
+    g = g1_generator()
+    points = [g.mul(i + 2) for i in range(40)]
+    scalars = [rng.randrange(fr.R) for _ in range(38)] + [0, 1]
+    naive = kzg.g1_lincomb(points, scalars)
+    fast = kzg.g1_msm_pippenger(points, scalars)
+    assert fast == naive

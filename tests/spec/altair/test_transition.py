@@ -12,7 +12,6 @@ from consensus_specs_tpu.testing.helpers.fork_transition import (
     do_fork,
     skip_slots,
     state_transition_across_slots,
-    transition_until_fork,
     transition_to_next_epoch_and_append_blocks,
 )
 from consensus_specs_tpu.testing.utils import with_meta_tags
@@ -39,9 +38,9 @@ def test_normal_transition(spec, phases, state):
     yield "pre", state
 
     blocks = []
-    transition_until_fork(spec, state, FORK_EPOCH)
+    target = FORK_EPOCH * spec.SLOTS_PER_EPOCH - 1
     blocks.extend(
-        _pre_tag(b) for b in []
+        _pre_tag(b) for b in state_transition_across_slots(spec, state, target)
     )
     assert spec.compute_epoch_at_slot(state.slot + 1) == FORK_EPOCH
 

@@ -1,0 +1,268 @@
+"""Experimental-fork suites: eip4844 (KZG blobs), sharding (headers, fees,
+shard work), das (extension/sampling/recovery), custody_game (custody-bit
+math and period machinery).  References: specs/{eip4844,sharding,das,
+custody_game}/ of the reference snapshot."""
+import random
+
+import pytest
+
+from consensus_specs_tpu.crypto import fr, kzg
+from consensus_specs_tpu.crypto.bls.curve import g1_to_bytes
+from consensus_specs_tpu.specs.builder import get_spec
+
+rng = random.Random(808)
+
+
+@pytest.fixture(scope="module")
+def eip4844():
+    return get_spec("eip4844", "minimal")
+
+
+@pytest.fixture(scope="module")
+def sharding():
+    return get_spec("sharding", "minimal")
+
+
+@pytest.fixture(scope="module")
+def das():
+    return get_spec("das", "minimal")
+
+
+@pytest.fixture(scope="module")
+def custody():
+    return get_spec("custody_game", "minimal")
+
+
+# --- eip4844 ----------------------------------------------------------------
+
+
+def test_blob_commitment_and_versioned_hash(eip4844):
+    spec = eip4844
+    blob = spec.Blob([rng.randrange(int(spec.BLS_MODULUS))
+                      for _ in range(int(spec.FIELD_ELEMENTS_PER_BLOB))])
+    c = spec.blob_to_kzg(blob)
+    assert kzg.verify_commitment_matches_poly(bytes(c), [int(v) for v in blob])
+    vh = spec.kzg_to_versioned_hash(c)
+    assert vh[0] == 1 and len(vh) == 32
+
+
+def _mock_blob_tx(spec, versioned_hashes):
+    """SSZ-shaped SignedBlobTransaction mock: 1-byte type + 4-byte message
+    offset + message whose bytes 156:160 hold the hashes' position (the
+    draft reads that offset as an absolute index into the opaque tx)."""
+    message_offset = 5
+    hashes_abs = message_offset + 160  # right after the offset field
+    message = bytearray(b"\x00" * 156)
+    message += int(hashes_abs).to_bytes(4, "little")
+    message += b"".join(versioned_hashes)
+    tx = bytes([int(spec.BLOB_TX_TYPE)]) + int(message_offset - 1).to_bytes(4, "little") + bytes(message)
+    return spec.Transaction(tx)
+
+
+def test_tx_peek_and_kzg_verification(eip4844):
+    spec = eip4844
+    blob = spec.Blob([3] * int(spec.FIELD_ELEMENTS_PER_BLOB))
+    commitment = spec.blob_to_kzg(blob)
+    vh = spec.kzg_to_versioned_hash(commitment)
+    tx = _mock_blob_tx(spec, [bytes(vh)])
+    assert list(spec.tx_peek_blob_versioned_hashes(tx)) == [vh]
+    assert spec.verify_kzgs_against_transactions([tx], [commitment])
+    other = spec.blob_to_kzg(spec.Blob([4] * int(spec.FIELD_ELEMENTS_PER_BLOB)))
+    assert not spec.verify_kzgs_against_transactions([tx], [other])
+
+
+def test_blobs_sidecar_verification(eip4844):
+    spec = eip4844
+    blob = spec.Blob([rng.randrange(int(spec.BLS_MODULUS))
+                      for _ in range(int(spec.FIELD_ELEMENTS_PER_BLOB))])
+    c = spec.blob_to_kzg(blob)
+    sidecar = spec.BlobsSidecar(
+        beacon_block_root=b"\x22" * 32, beacon_block_slot=7, blobs=[blob])
+    spec.verify_blobs_sidecar(7, b"\x22" * 32, [c], sidecar)
+    with pytest.raises(AssertionError):
+        spec.verify_blobs_sidecar(8, b"\x22" * 32, [c], sidecar)
+
+
+def test_eip4844_block_body_has_blob_kzgs(eip4844):
+    body = eip4844.BeaconBlockBody()
+    assert len(body.blob_kzgs) == 0
+    assert "blob_kzgs" in type(body)._field_names
+
+
+# --- sharding ---------------------------------------------------------------
+
+
+def test_sample_price_updates(sharding):
+    spec = sharding
+    target = int(spec.TARGET_SAMPLES_PER_BLOB)
+    price = spec.Gwei(1000)
+    up = spec.compute_updated_sample_price(price, spec.uint64(target * 2), spec.uint64(2))
+    down = spec.compute_updated_sample_price(price, spec.uint64(0), spec.uint64(2))
+    flat = spec.compute_updated_sample_price(price, spec.uint64(target), spec.uint64(2))
+    assert int(up) > 1000 and int(down) < 1000 and int(flat) <= 1000
+    # bounds respected
+    assert int(spec.compute_updated_sample_price(
+        spec.MAX_SAMPLE_PRICE, spec.uint64(target * 2), spec.uint64(1))) <= int(spec.MAX_SAMPLE_PRICE)
+
+
+def test_shard_committee_index_roundtrip(sharding):
+    spec = sharding
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    slot = spec.Slot(3)
+    count = int(spec.get_committee_count_per_slot(state, spec.compute_epoch_at_slot(slot)))
+    for index in range(count):
+        shard = spec.compute_shard_from_committee_index(state, slot, spec.CommitteeIndex(index))
+        back = spec.compute_committee_index_from_shard(state, slot, shard)
+        assert int(back) == index
+
+
+def test_reset_and_confirm_pending_shard_work(sharding):
+    spec = sharding
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    spec.reset_pending_shard_work(state)
+    # next epoch's slots now carry PENDING work for shards with committees
+    next_start = int(spec.compute_start_slot_at_epoch(spec.get_current_epoch(state) + 1))
+    buffer_index = next_start % int(spec.SHARD_STATE_MEMORY_SLOTS)
+    statuses = [int(w.status.selector) for w in state.shard_buffer[buffer_index]]
+    assert spec.SHARD_WORK_PENDING in statuses
+
+
+def test_degree_proof_pairing_identity(sharding):
+    """The process_shard_header degree check: D = commit(B(X) * X^(N-l))
+    satisfies e(D, H) == e(commit(B), s^(N-l) H) iff deg(B) < l."""
+    spec = sharding
+    g1_setup, g2_setup = spec._kzg_setups()
+    n = len(g1_setup)
+    l = 4
+    coeffs = [rng.randrange(fr.R) for _ in range(l)]  # deg < l
+    commitment = kzg.g1_lincomb(g1_setup[:l], coeffs)
+    degree_proof = kzg.g1_lincomb(g1_setup[n - l:], coeffs)
+    from consensus_specs_tpu.crypto import bls
+
+    assert bls.Pairing(degree_proof, g2_setup[0]) == bls.Pairing(commitment, g2_setup[-l])
+    # a degree-l polynomial (one too high) must fail against the same slot
+    bad = coeffs + [1]
+    bad_commit = kzg.g1_lincomb(g1_setup[:l + 1], bad)
+    bad_proof = kzg.g1_lincomb(g1_setup[n - l - 1:], bad)  # honest shift for deg l+1
+    assert bls.Pairing(bad_proof, g2_setup[0]) != bls.Pairing(bad_commit, g2_setup[-l])
+
+
+def test_upgrade_to_sharding(sharding):
+    spec = sharding
+    bella = get_spec("bellatrix", "minimal")
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+    pre = create_genesis_state(
+        bella, default_balances(bella), default_activation_threshold(bella))
+    post = spec.upgrade_to_sharding(pre)
+    assert post.fork.current_version == spec.config.SHARDING_FORK_VERSION
+    assert int(post.shard_sample_price) == int(spec.MIN_SAMPLE_PRICE)
+    assert post.validators.hash_tree_root() == pre.validators.hash_tree_root()
+
+
+# --- das --------------------------------------------------------------------
+
+
+def test_das_extend_unextend_roundtrip(das):
+    spec = das
+    pps = int(spec.POINTS_PER_SAMPLE)
+    data = [rng.randrange(fr.R) for _ in range(2 * pps)]
+    ext = spec.extend_data(data)
+    assert len(ext) == 2 * len(data)
+    assert list(spec.unextend_data(ext)) == data
+
+
+def test_das_sample_verify_and_reconstruct(das):
+    spec = das
+    pps = int(spec.POINTS_PER_SAMPLE)
+    data = [rng.randrange(fr.R) for _ in range(2 * pps)]
+    ext = spec.extend_data(data)
+    poly = spec.inverse_fft(spec.reverse_bit_order_list([int(v) for v in ext]))
+    assert all(v == 0 for v in poly[len(poly) // 2:])
+    commitment = spec.BLSCommitment(g1_to_bytes(
+        kzg.g1_lincomb(kzg.setup_monomial(len(poly)), poly)))
+
+    samples = spec.sample_data(spec.Slot(3), spec.Shard(1), ext)
+    for s in samples:
+        spec.verify_sample(s, len(samples), commitment)
+
+    # tampered data is rejected
+    bad = samples[0].copy()
+    bad.data[0] = int(bad.data[0]) ^ 1
+    with pytest.raises(AssertionError):
+        spec.verify_sample(bad, len(samples), commitment)
+
+    # half the samples reconstruct everything
+    partial = [None if i % 2 == 0 else s for i, s in enumerate(samples)]
+    rec = spec.reconstruct_extended_data(partial)
+    assert rec == [int(v) for v in ext]
+
+
+# --- custody game -----------------------------------------------------------
+
+
+def test_custody_bit_is_deterministic(custody):
+    spec = custody
+    from consensus_specs_tpu.crypto.bls import ciphersuite
+
+    sig = spec.BLSSignature(ciphersuite.Sign(99, b"reveal"))
+    data = b"shard data " * 100
+    assert spec.compute_custody_bit(sig, data) == spec.compute_custody_bit(sig, data)
+    secrets = spec.get_custody_secrets(sig)
+    assert len(secrets) == 3 and all(isinstance(s, int) for s in secrets)
+
+
+def test_custody_period_machinery(custody):
+    spec = custody
+    period = spec.get_custody_period_for_validator(spec.ValidatorIndex(5), spec.Epoch(0))
+    randao_epoch = spec.get_randao_epoch_for_custody_period(period, spec.ValidatorIndex(5))
+    assert int(randao_epoch) > 0
+    # later epochs map to same-or-later periods
+    later = spec.get_custody_period_for_validator(
+        spec.ValidatorIndex(5), spec.Epoch(int(spec.EPOCHS_PER_CUSTODY_PERIOD) * 3))
+    assert int(later) > int(period)
+
+
+def test_replace_empty_or_append(custody):
+    spec = custody
+    records = spec.List[spec.CustodyChunkChallengeRecord, 8]()
+    r1 = spec.CustodyChunkChallengeRecord(challenge_index=1)
+    idx = spec.replace_empty_or_append(records, r1)
+    assert idx == 0 and len(records) == 1
+    r2 = spec.CustodyChunkChallengeRecord(challenge_index=2)
+    idx = spec.replace_empty_or_append(records, r2)
+    assert idx == 1 and len(records) == 2
+    # clearing the first slot makes it reusable
+    records[0] = spec.CustodyChunkChallengeRecord()
+    r3 = spec.CustodyChunkChallengeRecord(challenge_index=3)
+    idx = spec.replace_empty_or_append(records, r3)
+    assert idx == 0 and len(records) == 2
+
+
+def test_custody_state_and_body_fields(custody):
+    spec = custody
+    state = spec.BeaconState()
+    assert int(state.custody_chunk_challenge_index) == 0
+    body = spec.BeaconBlockBody()
+    for field in ("chunk_challenges", "chunk_challenge_responses",
+                  "custody_key_reveals", "early_derived_secret_reveals",
+                  "custody_slashings", "shard_headers"):
+        assert field in type(body)._field_names, field
