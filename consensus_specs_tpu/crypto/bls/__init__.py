@@ -95,6 +95,9 @@ def only_with_bls(alt_return=None):
 
 @only_with_bls(alt_return=True)
 def Verify(PK, message, signature):
+    from consensus_specs_tpu import tracing
+
+    tracing.count("bls.verify")
     try:
         return bls.Verify(PK, message, signature)
     except Exception:
@@ -111,6 +114,10 @@ def AggregateVerify(pubkeys, messages, signature):
 
 @only_with_bls(alt_return=True)
 def FastAggregateVerify(pubkeys, message, signature):
+    from consensus_specs_tpu import tracing
+
+    tracing.count("bls.fast_aggregate_verify")
+    tracing.count("bls.fast_aggregate_verify.pubkeys", len(pubkeys))
     try:
         return bls.FastAggregateVerify(pubkeys, message, signature)
     except Exception:

@@ -28,10 +28,14 @@ _CACHE_MAX = 16
 def compute_shuffle_permutation(seed: bytes, index_count: int, round_count: int) -> np.ndarray:
     """Return an int64 array p of length index_count with
     p[i] = compute_shuffled_index(i, index_count, seed)."""
+    from consensus_specs_tpu import tracing
+
     key = (bytes(seed), int(index_count), int(round_count))
     hit = _cache.get(key)
     if hit is not None:
+        tracing.count("shuffle.permutation_cache_hit")
         return hit
+    tracing.count("shuffle.permutation_compute")
     n = int(index_count)
     if n == 0:
         return np.zeros(0, dtype=np.int64)

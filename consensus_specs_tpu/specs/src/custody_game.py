@@ -237,12 +237,18 @@ def get_custody_period_for_validator(validator_index: ValidatorIndex, epoch: Epo
     return (epoch + validator_index % EPOCHS_PER_CUSTODY_PERIOD) // EPOCHS_PER_CUSTODY_PERIOD
 
 
-# Per-block processing (custody_game/beacon-chain.md:359-626)
+# Per-block processing (custody_game/beacon-chain.md:359-626).
+# [legacy-draft] the md's order references process_light_client_aggregate
+# (an old-draft name) and omits the payload/sync-aggregate steps the
+# MODERN (sharding-inherited) body carries; both are processed here so no
+# field of the actual container set escapes validation.
 def process_block(state: BeaconState, block: BeaconBlock) -> None:
     process_block_header(state, block)
+    process_execution_payload(state, block.body.execution_payload, EXECUTION_ENGINE)
     process_randao(state, block.body)
     process_eth1_data(state, block.body)
     process_operations(state, block.body)
+    process_sync_aggregate(state, block.body.sync_aggregate)
     process_custody_game_operations(state, block.body)
 
 

@@ -69,11 +69,9 @@ def recover_data(data: Sequence) -> Sequence[int]:
     flat = []
     chunk_len = None
     for chunk in data:
-        if chunk is None:
-            assert chunk_len is not None or data.index(chunk) == 0
-        else:
+        if chunk is not None:
             chunk_len = len(chunk)
-    assert chunk_len is not None
+    assert chunk_len is not None, "at least one sample subgroup required"
     for chunk in data:
         if chunk is None:
             flat.extend([None] * chunk_len)

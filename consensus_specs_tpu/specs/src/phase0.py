@@ -351,12 +351,12 @@ def is_slashable_attestation_data(data_1: AttestationData, data_2: AttestationDa
     """
     Check if ``data_1`` and ``data_2`` are slashable according to Casper FFG rules.
     """
-    double_vote = data_1 != data_2 and data_1.target.epoch == data_2.target.epoch
-    surround_vote = (
-        data_1.source.epoch < data_2.source.epoch
-        and data_2.target.epoch < data_1.target.epoch
+    return (
+        # Double vote
+        (data_1 != data_2 and data_1.target.epoch == data_2.target.epoch) or
+        # Surround vote
+        (data_1.source.epoch < data_2.source.epoch and data_2.target.epoch < data_1.target.epoch)
     )
-    return double_vote or surround_vote
 
 
 def is_valid_indexed_attestation(state: BeaconState, indexed_attestation: IndexedAttestation) -> bool:

@@ -109,3 +109,117 @@ def test_on_block_finalization_updates(spec, state):
     assert store.justified_checkpoint.epoch > store.finalized_checkpoint.epoch
 
     yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_wins_head(spec, state):
+    """A timely block gets the proposer-score boost and outweighs an
+    equal-weight sibling (reference scenario family:
+    fork_choice/test_get_head.py proposer-boost cases)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # two competing blocks at the same slot from the same parent
+    next_slot_state = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, next_slot_state)
+    block_a.body.graffiti = b"\x11" * 32
+    signed_a = state_transition_and_sign_block(spec, next_slot_state, block_a)
+
+    state_b = state.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x22" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    # arrive early in the slot: block A lands first and earns the boost
+    time = (store.genesis_time + int(block_a.slot) * spec.config.SECONDS_PER_SLOT
+            + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT - 1)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_a, test_steps)
+    assert store.proposer_boost_root == signed_a.message.hash_tree_root()
+    # B arrives after the attesting interval: no boost for it
+    late = (store.genesis_time + int(block_a.slot) * spec.config.SECONDS_PER_SLOT
+            + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT + 1)
+    on_tick_and_append_step(spec, store, late, test_steps)
+    yield from add_block(spec, store, signed_b, test_steps)
+    assert store.proposer_boost_root == signed_a.message.hash_tree_root()
+
+    # boost breaks the tie in favor of A regardless of root ordering
+    assert spec.get_head(store) == signed_a.message.hash_tree_root()
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_expires_next_slot(spec, state):
+    """The boost is transient: after the next on_tick the sibling with the
+    lexicographically-higher root wins the tie again."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    sa = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, sa)
+    block_a.body.graffiti = b"\x11" * 32
+    signed_a = state_transition_and_sign_block(spec, sa, block_a)
+    sb = state.copy()
+    block_b = build_empty_block_for_next_slot(spec, sb)
+    block_b.body.graffiti = b"\x22" * 32
+    signed_b = state_transition_and_sign_block(spec, sb, block_b)
+
+    time = (store.genesis_time + int(block_a.slot) * spec.config.SECONDS_PER_SLOT
+            + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT - 1)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_a, test_steps)
+    yield from add_block(spec, store, signed_b, test_steps)
+
+    # move into the next slot: boost resets
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + (int(block_a.slot) + 1) * spec.config.SECONDS_PER_SLOT,
+        test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    expected = max(
+        signed_a.message.hash_tree_root(), signed_b.message.hash_tree_root())
+    assert spec.get_head(store) == expected
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_attestation_flips_head(spec, state):
+    """Ex-ante reorg scenario: a sibling that arrives late but carries an
+    attestation outweighs the boosted-but-unattested first block once the
+    boost expires (reference family: test_ex_ante.py)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    sa = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, sa)
+    block_a.body.graffiti = b"\x11" * 32
+    signed_a = state_transition_and_sign_block(spec, sa, block_a)
+    sb = state.copy()
+    block_b = build_empty_block_for_next_slot(spec, sb)
+    block_b.body.graffiti = b"\x22" * 32
+    signed_b = state_transition_and_sign_block(spec, sb, block_b)
+
+    time = store.genesis_time + (int(block_a.slot) + 1) * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_a, test_steps)
+    yield from add_block(spec, store, signed_b, test_steps)
+
+    weaker = min(signed_a, signed_b, key=lambda s: s.message.hash_tree_root())
+    weaker_state = sa if weaker is signed_a else sb
+    # an attestation for the tie-losing block flips the head to it
+    attestation = get_valid_attestation(
+        spec, weaker_state, slot=weaker.message.slot, signed=True)
+    next_time = time + spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, next_time, test_steps)
+    yield from tick_and_run_on_attestation(spec, store, attestation, test_steps)
+    assert spec.get_head(store) == weaker.message.hash_tree_root()
+    yield "steps", test_steps
