@@ -1,0 +1,172 @@
+"""Vectorized altair+ epoch processing: participation-flag rewards,
+inactivity scores, and flag rotation as whole-registry column math
+(reference semantics: specs/altair/beacon-chain.md process_rewards_and_
+penalties / process_inactivity_updates / process_participation_flag_updates;
+the sequential forms loop per validator per flag).
+
+Same architecture as the phase0 pipeline (ops/epoch_jax.py): columns come
+off the Merkle backing in one walk (ssz/bulk.py), arithmetic is exact
+int64 (bounds: eff <= 32e9 * weight(<=14) * increments(<2^26) << 2^63),
+results are written back in one bottom-up rebuild.  The per-deltas-pair
+floor-at-zero application order of the spec is replicated exactly.
+Sequential originals stay on __wrapped__; differential tests:
+tests/spec/altair/test_epoch_vectorization.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from consensus_specs_tpu.ops.epoch_jax import (
+    active_mask,
+    registry_columns,
+)
+
+# fork -> inactivity penalty quotient constant name (altair raised by
+# bellatrix; later forks keep bellatrix's)
+INACTIVITY_QUOTIENT = {
+    "altair": "INACTIVITY_PENALTY_QUOTIENT_ALTAIR",
+}
+
+
+def _inactivity_quotient(spec) -> int:
+    name = INACTIVITY_QUOTIENT.get(
+        spec.fork, "INACTIVITY_PENALTY_QUOTIENT_BELLATRIX")
+    return int(getattr(spec, name))
+
+
+def _participation_columns(spec, state):
+    from consensus_specs_tpu.ssz import bulk
+
+    return (
+        bulk.packed_uint8_to_numpy(state.previous_epoch_participation),
+        bulk.packed_uint8_to_numpy(state.current_epoch_participation),
+    )
+
+
+def _eligible_mask(spec, state, cols):
+    prev_epoch = int(spec.get_previous_epoch(state))
+    return active_mask(cols, prev_epoch) | (
+        cols["slashed"] & (prev_epoch + 1 < cols["withdrawable_epoch"])
+    )
+
+
+def _unslashed_participating_mask(spec, state, cols, prev_flags, flag_index):
+    prev_epoch = int(spec.get_previous_epoch(state))
+    has_flag = (prev_flags >> flag_index) & 1
+    return active_mask(cols, prev_epoch) & has_flag.astype(bool) & ~cols["slashed"]
+
+
+def rewards_and_penalties(spec, state) -> None:
+    """altair+ process_rewards_and_penalties over columns."""
+    from consensus_specs_tpu.ssz import bulk
+
+    if int(spec.get_current_epoch(state)) == int(spec.GENESIS_EPOCH):
+        return
+
+    cols = registry_columns(state)
+    prev_flags, _ = _participation_columns(spec, state)
+    eff = cols["effective_balance"]
+    eligible = _eligible_mask(spec, state, cols)
+
+    ebi = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    total_active = int(spec.get_total_active_balance(state))
+    active_increments = total_active // ebi
+    base_reward_per_increment = (
+        ebi * int(spec.BASE_REWARD_FACTOR)
+        // int(spec.integer_squareroot(spec.uint64(total_active)))
+    )
+    base_reward = (eff // ebi) * base_reward_per_increment
+    weight_denominator = int(spec.WEIGHT_DENOMINATOR)
+    in_leak = bool(spec.is_in_inactivity_leak(state))
+    weights = [int(w) for w in spec.PARTICIPATION_FLAG_WEIGHTS]
+    timely_head_index = int(spec.TIMELY_HEAD_FLAG_INDEX)
+    timely_target_index = int(spec.TIMELY_TARGET_FLAG_INDEX)
+
+    deltas = []
+    for flag_index, weight in enumerate(weights):
+        participating = _unslashed_participating_mask(
+            spec, state, cols, prev_flags, flag_index)
+        participating_increments = (
+            int(np.sum(np.where(participating, eff, 0))) // ebi
+        )
+        rewards = np.zeros_like(eff)
+        penalties = np.zeros_like(eff)
+        if not in_leak:
+            reward_numerator = base_reward * weight * participating_increments
+            rewards = np.where(
+                eligible & participating,
+                reward_numerator // (active_increments * weight_denominator),
+                0,
+            )
+        if flag_index != timely_head_index:
+            penalties = np.where(
+                eligible & ~participating,
+                base_reward * weight // weight_denominator,
+                0,
+            )
+        deltas.append((rewards, penalties))
+
+    # inactivity penalties (altair/beacon-chain.md get_inactivity_penalty_deltas)
+    scores = bulk.packed_uint64_to_numpy(state.inactivity_scores)
+    target_participating = _unslashed_participating_mask(
+        spec, state, cols, prev_flags, timely_target_index)
+    quotient = int(spec.config.INACTIVITY_SCORE_BIAS) * _inactivity_quotient(spec)
+    affected = eligible & ~target_participating
+    if int(scores.max(initial=0)) < (1 << 27):
+        # eff <= 32e9 < 2^35, so eff*score < 2^62: exact in int64.  Scores
+        # grow by BIAS(4)/epoch, so this branch covers any realistic state.
+        inact_pen = np.where(affected, eff * scores // quotient, 0)
+    else:  # pathological scores: exact big-int per affected lane
+        inact_pen = np.zeros_like(eff)
+        for i in np.nonzero(affected)[0]:
+            inact_pen[i] = int(eff[i]) * int(scores[i]) // quotient
+    deltas.append((np.zeros_like(eff), inact_pen))
+
+    balances = bulk.packed_uint64_to_numpy(state.balances)
+    for rewards, penalties in deltas:
+        balances = balances + rewards
+        balances = np.where(penalties > balances, 0, balances - penalties)
+    bulk.set_packed_uint64_from_numpy(state.balances, balances)
+
+
+def inactivity_updates(spec, state) -> None:
+    """altair+ process_inactivity_updates over columns."""
+    from consensus_specs_tpu.ssz import bulk
+
+    if int(spec.get_current_epoch(state)) == int(spec.GENESIS_EPOCH):
+        return
+
+    cols = registry_columns(state)
+    prev_flags, _ = _participation_columns(spec, state)
+    eligible = _eligible_mask(spec, state, cols)
+    target_participating = _unslashed_participating_mask(
+        spec, state, cols, prev_flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
+
+    scores = bulk.packed_uint64_to_numpy(state.inactivity_scores)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+
+    # increase/decrease per participation
+    scores = np.where(
+        eligible & target_participating,
+        scores - np.minimum(1, scores),
+        np.where(eligible, scores + bias, scores),
+    )
+    if not spec.is_in_inactivity_leak(state):
+        scores = np.where(
+            eligible, scores - np.minimum(recovery, scores), scores)
+    bulk.set_packed_uint64_from_numpy(state.inactivity_scores, scores)
+
+
+def participation_flag_updates(spec, state) -> None:
+    """altair+ process_participation_flag_updates: rotate current into
+    previous and zero current — two bulk writes instead of an O(n) list
+    comprehension of fresh flag objects."""
+    from consensus_specs_tpu.ssz import bulk
+
+    _, current = _participation_columns(spec, state)
+    bulk.set_packed_uint8_from_numpy(state.previous_epoch_participation, current)
+    bulk.set_packed_uint8_from_numpy(
+        state.current_epoch_participation,
+        np.zeros(len(current), dtype=np.uint8),
+    )

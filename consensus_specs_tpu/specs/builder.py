@@ -327,6 +327,23 @@ def _install_optimizations(g: Dict[str, Any]) -> None:
     _install_registry_vectorization(g)
     if g["fork"] == "phase0":
         _install_phase0_epoch_kernel(g)
+    else:
+        _install_altair_epoch_kernel(g)
+
+
+def _install_altair_epoch_kernel(g: Dict[str, Any]) -> None:
+    """Post-altair epoch vectorization: flag-based rewards, inactivity
+    scores, participation rotation (ops/epoch_altair.py).  Differential
+    tests: tests/spec/altair/test_epoch_vectorization.py."""
+    from consensus_specs_tpu.ops import epoch_altair
+
+    proxy = _LiveSpecProxy(g)
+    _swap(g, "process_rewards_and_penalties",
+          lambda state: epoch_altair.rewards_and_penalties(proxy, state))
+    _swap(g, "process_inactivity_updates",
+          lambda state: epoch_altair.inactivity_updates(proxy, state))
+    _swap(g, "process_participation_flag_updates",
+          lambda state: epoch_altair.participation_flag_updates(proxy, state))
 
 
 def _swap(g: Dict[str, Any], name: str, fn) -> None:

@@ -28,23 +28,19 @@ from .node import (
 from .types import _collect_leaf_roots
 
 
-def packed_uint64_to_numpy(view) -> np.ndarray:
-    """List/Vector[uint64, N] -> int64 numpy array (values < 2^63 assumed,
-    which Gwei balances satisfy by orders of magnitude)."""
+def _packed_to_numpy(view, elem_bytes: int, np_dtype: str) -> np.ndarray:
     cls = type(view)
     node = view.get_backing()  # flush pending writes
     contents = node.left if cls.IS_LIST else node
     n = len(view)
-    n_chunks = (n + 3) // 4
+    per_chunk = 32 // elem_bytes
+    n_chunks = (n + per_chunk - 1) // per_chunk
     data = b"".join(_collect_leaf_roots(contents, cls.contents_depth(), n_chunks))
-    return np.frombuffer(data, dtype="<u8")[:n].astype(np.int64)
+    return np.frombuffer(data, dtype=np_dtype)[:n]
 
 
-def set_packed_uint64_from_numpy(view, arr: np.ndarray) -> None:
-    """Replace the full contents of a packed uint64 List/Vector in one
-    bottom-up rebuild, preserving view/parent dirty-tracking semantics."""
+def _set_packed_from_numpy(view, arr: np.ndarray) -> None:
     cls = type(view)
-    arr = np.ascontiguousarray(arr, dtype="<u8")
     if cls.IS_LIST:
         if len(arr) > cls.LENGTH:
             raise ValueError(f"{len(arr)} exceeds list limit {cls.LENGTH}")
@@ -62,6 +58,27 @@ def set_packed_uint64_from_numpy(view, arr: np.ndarray) -> None:
     view._backing = backing
     view._length = len(arr) if cls.IS_LIST else cls.LENGTH
     view._invalidate()  # parent (e.g. the BeaconState container) sees the change
+
+
+def packed_uint64_to_numpy(view) -> np.ndarray:
+    """List/Vector[uint64, N] -> int64 numpy array (values < 2^63 assumed,
+    which Gwei balances satisfy by orders of magnitude)."""
+    return _packed_to_numpy(view, 8, "<u8").astype(np.int64)
+
+
+def set_packed_uint64_from_numpy(view, arr: np.ndarray) -> None:
+    """Replace the full contents of a packed uint64 List/Vector in one
+    bottom-up rebuild, preserving view/parent dirty-tracking semantics."""
+    _set_packed_from_numpy(view, np.ascontiguousarray(arr, dtype="<u8"))
+
+
+def packed_uint8_to_numpy(view) -> np.ndarray:
+    """List/Vector[uint8, N] (e.g. altair participation flags) -> uint8."""
+    return _packed_to_numpy(view, 1, np.uint8).copy()
+
+
+def set_packed_uint8_from_numpy(view, arr: np.ndarray) -> None:
+    _set_packed_from_numpy(view, np.ascontiguousarray(arr, dtype=np.uint8))
 
 
 def composite_subtrees(view) -> list:

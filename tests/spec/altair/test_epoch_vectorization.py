@@ -1,0 +1,121 @@
+"""Differential tests: altair+ vectorized epoch substitutions vs their
+sequential ``__wrapped__`` originals — flag rewards (incl. leak and the
+per-pair floor-at-zero order), inactivity scores, participation rotation.
+Scenarios force mixed participation flags, slashed validators, and
+nonzero inactivity scores."""
+import random
+
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_altair_and_later as with_altair_family,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+def unwrap(fn):
+    while hasattr(fn, "__wrapped__"):
+        fn = fn.__wrapped__
+    return fn
+
+
+def _mixed_participation_state(spec, state, seed=4242):
+    """Scatter participation flags, slashes, scores over a mid-chain state."""
+    rng = random.Random(seed)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    n = len(state.validators)
+    for i in range(n):
+        flags = 0
+        for flag in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+            if rng.random() < 0.6:
+                flags |= 1 << flag
+        state.previous_epoch_participation[i] = flags
+        state.current_epoch_participation[i] = rng.randrange(
+            1 << len(spec.PARTICIPATION_FLAG_WEIGHTS))
+        state.inactivity_scores[i] = rng.randrange(0, 100)
+    for i in rng.sample(range(n), max(1, n // 16)):
+        state.validators[i].slashed = True
+    return state
+
+
+def _assert_same_mutation(spec, state, name):
+    vec_state = state.copy()
+    seq_state = state.copy()
+    getattr(spec, name)(vec_state)
+    unwrap(getattr(spec, name))(seq_state)
+    assert vec_state.hash_tree_root() == seq_state.hash_tree_root(), name
+
+
+@with_altair_family
+@spec_state_test
+def test_rewards_and_penalties_differential(spec, state):
+    _mixed_participation_state(spec, state)
+    _assert_same_mutation(spec, state, "process_rewards_and_penalties")
+    yield from ()
+
+
+@with_altair_family
+@spec_state_test
+def test_rewards_and_penalties_differential_in_leak(spec, state):
+    _mixed_participation_state(spec, state)
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    # deep leak: large inactivity scores exercise the big-int penalty path
+    for i in range(0, len(state.validators), 3):
+        state.inactivity_scores[i] = 10**7
+    _assert_same_mutation(spec, state, "process_rewards_and_penalties")
+    yield from ()
+
+
+@with_altair_family
+@spec_state_test
+def test_inactivity_updates_differential(spec, state):
+    _mixed_participation_state(spec, state)
+    _assert_same_mutation(spec, state, "process_inactivity_updates")
+    yield from ()
+
+
+@with_altair_family
+@spec_state_test
+def test_inactivity_updates_differential_in_leak(spec, state):
+    _mixed_participation_state(spec, state)
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    _assert_same_mutation(spec, state, "process_inactivity_updates")
+    yield from ()
+
+
+@with_altair_family
+@spec_state_test
+def test_participation_flag_rotation_differential(spec, state):
+    _mixed_participation_state(spec, state)
+    _assert_same_mutation(spec, state, "process_participation_flag_updates")
+    yield from ()
+
+
+@with_altair_family
+@spec_state_test
+def test_full_epoch_differential(spec, state):
+    """Whole process_epoch through both pipelines on a flag-scattered
+    state: every altair substitution at once."""
+    _mixed_participation_state(spec, state)
+    vec_state = state.copy()
+    seq_state = state.copy()
+    spec.process_epoch(vec_state)
+    g = spec.__dict__
+    names = (
+        "process_rewards_and_penalties", "process_inactivity_updates",
+        "process_participation_flag_updates", "process_registry_updates",
+        "process_slashings", "process_effective_balance_updates",
+    )
+    saved = {k: g[k] for k in names}
+    try:
+        for k in names:
+            g[k] = unwrap(saved[k])
+        spec.process_epoch(seq_state)
+    finally:
+        g.update(saved)
+    assert vec_state.hash_tree_root() == seq_state.hash_tree_root()
+    yield from ()
