@@ -50,10 +50,12 @@ def _eligible_mask(spec, state, cols):
     )
 
 
-def _unslashed_participating_mask(spec, state, cols, prev_flags, flag_index):
-    prev_epoch = int(spec.get_previous_epoch(state))
-    has_flag = (prev_flags >> flag_index) & 1
-    return active_mask(cols, prev_epoch) & has_flag.astype(bool) & ~cols["slashed"]
+def _unslashed_participating_mask(spec, state, cols, flags, flag_index,
+                                  epoch=None):
+    if epoch is None:
+        epoch = int(spec.get_previous_epoch(state))
+    has_flag = (flags >> flag_index) & 1
+    return active_mask(cols, epoch) & has_flag.astype(bool) & ~cols["slashed"]
 
 
 def rewards_and_penalties(spec, state) -> None:
@@ -107,19 +109,29 @@ def rewards_and_penalties(spec, state) -> None:
         deltas.append((rewards, penalties))
 
     # inactivity penalties (altair/beacon-chain.md get_inactivity_penalty_deltas)
-    scores = bulk.packed_uint64_to_numpy(state.inactivity_scores)
+    # raw uint64 view: scores can exceed int63, so guard on the unsigned max
+    scores_u64 = np.asarray(
+        bulk._packed_to_numpy(state.inactivity_scores, 8, "<u8"))
     target_participating = _unslashed_participating_mask(
         spec, state, cols, prev_flags, timely_target_index)
     quotient = int(spec.config.INACTIVITY_SCORE_BIAS) * _inactivity_quotient(spec)
     affected = eligible & ~target_participating
-    if int(scores.max(initial=0)) < (1 << 27):
+    if int(scores_u64.max(initial=0)) < (1 << 27):
         # eff <= 32e9 < 2^35, so eff*score < 2^62: exact in int64.  Scores
         # grow by BIAS(4)/epoch, so this branch covers any realistic state.
+        scores = scores_u64.astype(np.int64)
         inact_pen = np.where(affected, eff * scores // quotient, 0)
-    else:  # pathological scores: exact big-int per affected lane
+    else:  # huge scores: exact big-int per affected lane.  The sequential
+        # spec's uint64 numerator (eff * score) overflows at 2^64 and
+        # raises; mirror that exactly so both pipelines agree bit-for-bit
+        # on every representable state.
         inact_pen = np.zeros_like(eff)
         for i in np.nonzero(affected)[0]:
-            inact_pen[i] = int(eff[i]) * int(scores[i]) // quotient
+            numerator = int(eff[i]) * int(scores_u64[i])
+            if numerator >= 1 << 64:
+                raise ValueError(
+                    f"value {numerator} out of range for uint64")
+            inact_pen[i] = numerator // quotient
     deltas.append((np.zeros_like(eff), inact_pen))
 
     balances = bulk.packed_uint64_to_numpy(state.balances)
@@ -127,6 +139,29 @@ def rewards_and_penalties(spec, state) -> None:
         balances = balances + rewards
         balances = np.where(penalties > balances, 0, balances - penalties)
     bulk.set_packed_uint64_from_numpy(state.balances, balances)
+
+
+def justification_and_finalization(spec, state) -> None:
+    """altair+ process_justification_and_finalization: target balances as
+    column sums instead of python index sets."""
+    if int(spec.get_current_epoch(state)) <= int(spec.GENESIS_EPOCH) + 1:
+        return
+    cols = registry_columns(state)
+    prev_flags, cur_flags = _participation_columns(spec, state)
+    eff = cols["effective_balance"]
+    ebi = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    target = int(spec.TIMELY_TARGET_FLAG_INDEX)
+
+    prev_mask = _unslashed_participating_mask(spec, state, cols, prev_flags, target)
+    cur_mask = _unslashed_participating_mask(
+        spec, state, cols, cur_flags, target,
+        epoch=int(spec.get_current_epoch(state)))
+    # get_total_balance floors at one increment
+    prev_bal = max(ebi, int(np.sum(np.where(prev_mask, eff, 0))))
+    cur_bal = max(ebi, int(np.sum(np.where(cur_mask, eff, 0))))
+    spec.weigh_justification_and_finalization(
+        state, spec.get_total_active_balance(state),
+        spec.Gwei(prev_bal), spec.Gwei(cur_bal))
 
 
 def inactivity_updates(spec, state) -> None:

@@ -338,6 +338,8 @@ def _install_altair_epoch_kernel(g: Dict[str, Any]) -> None:
     from consensus_specs_tpu.ops import epoch_altair
 
     proxy = _LiveSpecProxy(g)
+    _swap(g, "process_justification_and_finalization",
+          lambda state: epoch_altair.justification_and_finalization(proxy, state))
     _swap(g, "process_rewards_and_penalties",
           lambda state: epoch_altair.rewards_and_penalties(proxy, state))
     _swap(g, "process_inactivity_updates",

@@ -61,10 +61,19 @@ def test_rewards_and_penalties_differential_in_leak(spec, state):
     for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
         next_epoch(spec, state)
     assert spec.is_in_inactivity_leak(state)
-    # deep leak: large inactivity scores exercise the big-int penalty path
+    # scores past the 2^27 int64-exactness guard force the big-int penalty
+    # fallback while staying inside the spec's uint64 numerator range
     for i in range(0, len(state.validators), 3):
-        state.inactivity_scores[i] = 10**7
+        state.inactivity_scores[i] = 2**28 + 12345
     _assert_same_mutation(spec, state, "process_rewards_and_penalties")
+    yield from ()
+
+
+@with_altair_family
+@spec_state_test
+def test_justification_differential(spec, state):
+    _mixed_participation_state(spec, state)
+    _assert_same_mutation(spec, state, "process_justification_and_finalization")
     yield from ()
 
 
@@ -106,6 +115,7 @@ def test_full_epoch_differential(spec, state):
     spec.process_epoch(vec_state)
     g = spec.__dict__
     names = (
+        "process_justification_and_finalization",
         "process_rewards_and_penalties", "process_inactivity_updates",
         "process_participation_flag_updates", "process_registry_updates",
         "process_slashings", "process_effective_balance_updates",
