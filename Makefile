@@ -16,7 +16,7 @@ test-mainnet:
 bench:
 	python bench.py
 
-GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing finality rewards genesis random
+GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing finality rewards genesis random transition ssz_generic
 
 gen-all: $(addprefix gen-,$(GENERATORS))
 

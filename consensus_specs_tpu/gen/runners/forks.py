@@ -11,15 +11,19 @@ from consensus_specs_tpu.gen.gen_from_tests import generate_from_tests
 from consensus_specs_tpu.gen.gen_typing import TestProvider
 
 
-def _create_provider(tests_src_mod_name: str, preset_name: str,
-                     pre_fork: str, post_fork: str) -> TestProvider:
+def make_cross_fork_provider(tests_src_mod_name: str, preset_name: str,
+                             pre_fork: str, post_fork: str,
+                             runner_name: str = "fork",
+                             handler_name: str = "fork") -> TestProvider:
+    """Provider over a module whose tests run pre-fork with the post fork
+    in phases (shared by the forks and transition runners)."""
     def cases_fn() -> Iterable:
         from importlib import import_module
 
         tests_src = import_module(tests_src_mod_name)
         yield from generate_from_tests(
-            runner_name="fork",
-            handler_name="fork",
+            runner_name=runner_name,
+            handler_name=handler_name,
             src=tests_src,
             fork_name=post_fork,
             preset_name=preset_name,
@@ -27,6 +31,9 @@ def _create_provider(tests_src_mod_name: str, preset_name: str,
         )
 
     return TestProvider(prepare=lambda: None, make_cases=cases_fn)
+
+
+_create_provider = make_cross_fork_provider
 
 
 def main(argv=None):

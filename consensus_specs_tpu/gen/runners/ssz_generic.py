@@ -1,0 +1,167 @@
+"""ssz_generic vector generator (reference capability:
+tests/generators/ssz_generic/main.py): type-system conformance vectors
+independent of any spec — valid roundtrip cases and invalid byte strings
+per type family (uints, booleans, bitvectors/bitlists, vectors,
+containers).
+
+NOTE: no ``from __future__ import annotations`` — the test containers
+need live type annotations for the SSZ field machinery.
+"""
+from random import Random
+from typing import Iterable
+
+from consensus_specs_tpu.debug.encode import encode
+from consensus_specs_tpu.gen import gen_runner, gen_typing
+from consensus_specs_tpu.ssz.impl import hash_tree_root, serialize
+from consensus_specs_tpu.ssz.types import (
+    Bitlist,
+    Bitvector,
+    Container,
+    List,
+    Vector,
+    boolean,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+)
+
+
+class SingleFieldTestStruct(Container):
+    A: uint8
+
+
+class SmallTestStruct(Container):
+    A: uint16
+    B: uint16
+
+
+class FixedTestStruct(Container):
+    A: uint8
+    B: uint64
+    C: uint32
+
+
+class VarTestStruct(Container):
+    A: uint16
+    B: List[uint16, 1024]
+    C: uint8
+
+
+class ComplexTestStruct(Container):
+    A: uint16
+    B: List[uint16, 128]
+    C: uint8
+    D: List[uint8, 256]
+    E: VarTestStruct
+    F: Vector[FixedTestStruct, 4]
+
+
+def _valid_case(typ, value):
+    def fn():
+        yield "serialized", "ssz", serialize(value)
+        yield "value", "data", encode(value)
+        yield "roots", "data", {"root": "0x" + hash_tree_root(value).hex()}
+
+    return fn
+
+
+def _invalid_case(typ, raw: bytes):
+    def fn():
+        try:
+            typ.decode_bytes(raw)
+        except Exception:
+            yield "serialized", "ssz", raw
+            return
+        raise AssertionError(f"{typ} accepted invalid bytes {raw.hex()}")
+
+    return fn
+
+
+def _uint_cases(rng) -> Iterable:
+    for typ, name in ((uint8, "uint8"), (uint16, "uint16"), (uint32, "uint32"),
+                      (uint64, "uint64"), (uint128, "uint128"), (uint256, "uint256")):
+        size = typ.type_byte_length()
+        for label, val in (
+            ("zero", 0),
+            ("max", 256**size - 1),
+            ("random", rng.randrange(256**size)),
+        ):
+            yield "uints", f"uint_{size * 8}_{label}", True, _valid_case(typ, typ(val))
+        yield "uints", f"uint_{size * 8}_one_byte_longer", False, _invalid_case(
+            typ, b"\x00" * (size + 1))
+        yield "uints", f"uint_{size * 8}_one_byte_shorter", False, _invalid_case(
+            typ, b"\x00" * (size - 1))
+
+
+def _boolean_cases(rng) -> Iterable:
+    yield "boolean", "true", True, _valid_case(boolean, boolean(True))
+    yield "boolean", "false", True, _valid_case(boolean, boolean(False))
+    yield "boolean", "byte_2", False, _invalid_case(boolean, b"\x02")
+    yield "boolean", "byte_rev_nibble", False, _invalid_case(boolean, b"\x10")
+
+
+def _bits_cases(rng) -> Iterable:
+    for n in (1, 8, 9, 512):
+        bv = Bitvector[n]([rng.choice((True, False)) for _ in range(n)])
+        yield "bitvector", f"bitvec_{n}_random", True, _valid_case(type(bv), bv)
+        yield "bitvector", f"bitvec_{n}_extra_byte", False, _invalid_case(
+            type(bv), serialize(bv) + b"\x00")
+    for limit in (1, 8, 9, 512):
+        length = rng.randint(0, limit)
+        bl = Bitlist[limit]([rng.choice((True, False)) for _ in range(length)])
+        yield "bitlist", f"bitlist_{limit}_random_{length}", True, _valid_case(
+            type(bl), bl)
+        yield "bitlist", f"bitlist_{limit}_no_delimiter", False, _invalid_case(
+            Bitlist[limit], b"\x00" * (limit // 8 + 1) if limit >= 8 else b"\x00")
+
+
+def _container_cases(rng) -> Iterable:
+    samples = [
+        ("SingleFieldTestStruct", SingleFieldTestStruct(A=0xAB)),
+        ("SmallTestStruct", SmallTestStruct(A=0x1122, B=0x3344)),
+        ("FixedTestStruct", FixedTestStruct(A=0xAB, B=0x0102030405060708, C=0x11223344)),
+        ("VarTestStruct", VarTestStruct(A=0xABCD, B=[1, 2, 3], C=0xFF)),
+        ("ComplexTestStruct", ComplexTestStruct(
+            A=0xAABB, B=[0x1122, 0x3344], C=0xFF, D=list(b"foobar"),
+            E=VarTestStruct(A=0xABCD, B=[1, 2, 3], C=0xFF),
+            F=[FixedTestStruct(A=i, B=i * 2, C=i * 3) for i in range(4)],
+        )),
+    ]
+    for name, value in samples:
+        yield "containers", f"{name}_valid", True, _valid_case(type(value), value)
+    # invalid: truncated variable-size container
+    var = VarTestStruct(A=1, B=[1, 2, 3], C=2)
+    raw = serialize(var)
+    yield "containers", "VarTestStruct_truncated", False, _invalid_case(
+        VarTestStruct, raw[:-1])
+    yield "containers", "VarTestStruct_bad_offset", False, _invalid_case(
+        VarTestStruct, b"\xff\xff\xff\xff" + raw[4:])
+
+
+def create_provider() -> gen_typing.TestProvider:
+    def cases_fn() -> Iterable[gen_typing.TestCase]:
+        rng = Random(55)
+        for maker in (_uint_cases, _boolean_cases, _bits_cases, _container_cases):
+            for handler, case_name, valid, case_fn in maker(rng):
+                yield gen_typing.TestCase(
+                    fork_name="phase0",
+                    preset_name="general",
+                    runner_name="ssz_generic",
+                    handler_name=handler,
+                    suite_name="valid" if valid else "invalid",
+                    case_name=case_name,
+                    case_fn=case_fn,
+                )
+
+    return gen_typing.TestProvider(prepare=lambda: None, make_cases=cases_fn)
+
+
+def main(argv=None):
+    gen_runner.run_generator("ssz_generic", [create_provider()], argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Cross-fork transition vector generator (reference capability:
+tests/generators/transition/main.py): scenarios straddling a fork
+boundary, tests run under the pre-fork spec with the post fork in
+phases."""
+from __future__ import annotations
+
+from consensus_specs_tpu.gen import gen_runner
+from consensus_specs_tpu.gen.runners.forks import make_cross_fork_provider
+
+
+def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
+    providers = [
+        make_cross_fork_provider(
+            "tests.spec.altair.test_transition", preset, "phase0", "altair",
+            runner_name="transition", handler_name="core")
+        for preset in ("minimal", "mainnet")
+    ]
+    gen_runner.run_generator("transition", providers, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -177,19 +177,25 @@ def inactivity_updates(spec, state) -> None:
     target_participating = _unslashed_participating_mask(
         spec, state, cols, prev_flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
 
-    scores = bulk.packed_uint64_to_numpy(state.inactivity_scores)
+    # raw uint64 view: int64 wrap would corrupt huge scores silently
+    scores = np.asarray(
+        bulk._packed_to_numpy(state.inactivity_scores, 8, "<u8"))
     bias = int(spec.config.INACTIVITY_SCORE_BIAS)
     recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+
+    # sequential parity: uint64 increment overflow raises in the spec
+    if int(scores.max(initial=0)) + bias >= 1 << 64:
+        raise ValueError("inactivity score increment out of range for uint64")
 
     # increase/decrease per participation
     scores = np.where(
         eligible & target_participating,
-        scores - np.minimum(1, scores),
-        np.where(eligible, scores + bias, scores),
+        scores - np.minimum(np.uint64(1), scores),
+        np.where(eligible, scores + np.uint64(bias), scores),
     )
     if not spec.is_in_inactivity_leak(state):
         scores = np.where(
-            eligible, scores - np.minimum(recovery, scores), scores)
+            eligible, scores - np.minimum(np.uint64(recovery), scores), scores)
     bulk.set_packed_uint64_from_numpy(state.inactivity_scores, scores)
 
 

@@ -91,3 +91,79 @@ def build_proof(node: Node, gindex: GeneralizedIndex) -> PyList[bytes]:
         proof.append(merkle_root(sibling))
         cur = cur.right if bit else cur.left
     return list(reversed(proof))
+
+
+# --- multiproofs (ssz/merkle-proofs.md:249-326) -----------------------------
+
+
+def generalized_index_sibling(index: GeneralizedIndex) -> GeneralizedIndex:
+    return index ^ 1
+
+
+def generalized_index_parent(index: GeneralizedIndex) -> GeneralizedIndex:
+    return index // 2
+
+
+def get_branch_indices(tree_index: GeneralizedIndex) -> PyList[GeneralizedIndex]:
+    """Sister-node chain a single-leaf proof consists of."""
+    o = [generalized_index_sibling(tree_index)]
+    while o[-1] > 1:
+        o.append(generalized_index_sibling(generalized_index_parent(o[-1])))
+    return o[:-1]
+
+
+def get_path_indices(tree_index: GeneralizedIndex) -> PyList[GeneralizedIndex]:
+    """The leaf's own chain of ancestors up to (excluding) the root."""
+    o = [tree_index]
+    while o[-1] > 1:
+        o.append(generalized_index_parent(o[-1]))
+    return o[:-1]
+
+
+def get_helper_indices(indices) -> PyList[GeneralizedIndex]:
+    """Indices of all extra nodes a combined multiproof needs, in the
+    canonical descending order."""
+    all_helper_indices = set()
+    all_path_indices = set()
+    for index in indices:
+        all_helper_indices.update(get_branch_indices(index))
+        all_path_indices.update(get_path_indices(index))
+    return sorted(all_helper_indices - all_path_indices, reverse=True)
+
+
+def calculate_multi_merkle_root(leaves, proof, indices) -> bytes:
+    """Root implied by ``leaves`` at ``indices`` plus the helper ``proof``
+    nodes (in get_helper_indices order)."""
+    from .hashing import sha256
+
+    assert len(leaves) == len(indices)
+    helper_indices = get_helper_indices(indices)
+    assert len(proof) == len(helper_indices)
+    objects = {
+        **{index: bytes(node) for index, node in zip(indices, leaves)},
+        **{index: bytes(node) for index, node in zip(helper_indices, proof)},
+    }
+    keys = sorted(objects.keys(), reverse=True)
+    pos = 0
+    while pos < len(keys):
+        k = keys[pos]
+        if k in objects and k ^ 1 in objects and k // 2 not in objects:
+            objects[k // 2] = sha256(
+                objects[(k | 1) ^ 1] + objects[k | 1]
+            )
+            keys.append(k // 2)
+        pos += 1
+    return objects[1]
+
+
+def verify_merkle_multiproof(leaves, proof, indices, root: bytes) -> bool:
+    return calculate_multi_merkle_root(leaves, proof, indices) == bytes(root)
+
+
+def build_multiproof(node: Node, gindices) -> PyList[bytes]:
+    """Helper-node roots for a combined proof of all ``gindices`` against
+    a backing tree, in get_helper_indices order."""
+    return [
+        merkle_root(get_subtree_at_gindex(node, helper))
+        for helper in get_helper_indices(gindices)
+    ]

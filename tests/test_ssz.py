@@ -384,3 +384,74 @@ def test_composite_list_pop_restores_zero_chunk():
     l.pop()
     assert hash_tree_root(l) == hash_tree_root(L(Rec(v=1)))
     assert len(l) == 1
+
+
+# --- multiproofs (ssz/merkle-proofs.md:249-326) -----------------------------
+
+
+def test_multiproof_of_beacon_state_fields():
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.ssz.gindex import (
+        build_multiproof,
+        get_generalized_index,
+        get_subtree_at_gindex,
+        verify_merkle_multiproof,
+    )
+    from consensus_specs_tpu.ssz.node import merkle_root
+
+    spec = get_spec("altair", "minimal")
+    state = spec.BeaconState()
+    state.slot = 77
+    state.genesis_time = 123456
+    state.finalized_checkpoint.epoch = 9
+
+    T = spec.BeaconState
+    gindices = [
+        get_generalized_index(T, "slot"),
+        get_generalized_index(T, "genesis_time"),
+        get_generalized_index(T, "finalized_checkpoint", "epoch"),
+    ]
+    backing = state.get_backing()
+    leaves = [merkle_root(get_subtree_at_gindex(backing, g)) for g in gindices]
+    proof = build_multiproof(backing, gindices)
+    root = state.hash_tree_root()
+    assert verify_merkle_multiproof(leaves, proof, gindices, root)
+    # tampered leaf fails
+    bad = [leaves[0][:-1] + b"\xff"] + leaves[1:]
+    assert not verify_merkle_multiproof(bad, proof, gindices, root)
+    # wrong order of indices fails (leaves no longer line up)
+    assert not verify_merkle_multiproof(
+        leaves, proof, list(reversed(gindices)), root)
+
+
+def test_multiproof_of_single_leaf_matches_branch_proof():
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.ssz.gindex import (
+        build_multiproof,
+        build_proof,
+        get_generalized_index,
+        get_helper_indices,
+        verify_merkle_multiproof,
+    )
+
+    spec = get_spec("altair", "minimal")
+    state = spec.BeaconState()
+    gindex = int(spec.NEXT_SYNC_COMMITTEE_INDEX)
+    backing = state.get_backing()
+    single = build_proof(backing, gindex)
+    multi = build_multiproof(backing, [gindex])
+    # a one-leaf multiproof is the branch proof in descending-helper order
+    assert sorted(single) == sorted(multi)
+    assert len(get_helper_indices([gindex])) == len(single)
+    leaf = state.next_sync_committee.hash_tree_root()
+    assert verify_merkle_multiproof([leaf], multi, [gindex], state.hash_tree_root())
+
+
+def test_multiproof_shares_helpers_between_nearby_leaves():
+    from consensus_specs_tpu.ssz.gindex import get_helper_indices
+
+    # two sibling leaves need NO helper between them at their own level
+    helpers_pair = get_helper_indices([8, 9])
+    helpers_single = get_helper_indices([8])
+    assert len(helpers_pair) < 2 * len(helpers_single)
+    assert 9 not in helpers_pair
