@@ -29,7 +29,10 @@ def main(argv=None):
         "sync_aggregate": "tests.spec.altair.test_sync_aggregate",
     }
     altair_mods = combine_mods(_new_altair_mods, phase_0_mods)
-    bellatrix_mods = altair_mods
+    _new_bellatrix_mods = {
+        "execution_payload": "tests.spec.bellatrix.test_process_execution_payload",
+    }
+    bellatrix_mods = combine_mods(_new_bellatrix_mods, altair_mods)
     _new_capella_mods = {
         "withdrawals": "tests.spec.capella.test_withdrawals",
         "bls_to_execution_change": "tests.spec.capella.test_bls_to_execution_change",

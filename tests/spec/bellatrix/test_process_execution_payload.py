@@ -1,0 +1,141 @@
+"""process_execution_payload operation suite (spec rules:
+bellatrix/beacon-chain.md process_execution_payload; reference suite:
+test/bellatrix/block_processing/test_process_execution_payload.py)."""
+from consensus_specs_tpu.testing.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_bellatrix_and_later,
+)
+from consensus_specs_tpu.testing.helpers.execution_payload import (
+    build_empty_execution_payload,
+    build_state_with_complete_transition,
+    build_state_with_incomplete_transition,
+    get_execution_payload_header,
+)
+from consensus_specs_tpu.testing.helpers.state import next_slot
+
+
+def run_execution_payload_processing(spec, state, payload, valid=True,
+                                     execution_valid=True):
+    """Yield operation parts; process under an engine stub returning
+    ``execution_valid``."""
+    yield "pre", state
+    yield "execution", {"execution_valid": execution_valid}
+    yield "execution_payload", payload
+
+    class TestEngine(spec.NoopExecutionEngine):
+        def notify_new_payload(self, new_payload) -> bool:
+            assert new_payload == payload
+            return execution_valid
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, payload, TestEngine())
+        )
+        yield "post", None
+        return
+    spec.process_execution_payload(state, payload, TestEngine())
+    yield "post", state
+    assert state.latest_execution_payload_header == get_execution_payload_header(
+        spec, payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_success_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_success_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_parent_hash_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_prev_randao_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = b"\x42" * 32
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_future_timestamp_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = int(payload.timestamp) + 1
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_execution_engine_rejects_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=False, execution_valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_timestamp_first_payload(spec, state):
+    # the timestamp rule holds even before the merge transition completes
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = int(payload.timestamp) + 1
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_success_first_payload_with_gap_slot(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_non_empty_extra_data(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.extra_data = b"\x45" * 12
+    yield from run_execution_payload_processing(spec, state, payload)
+    assert bytes(state.latest_execution_payload_header.extra_data) == b"\x45" * 12
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_bad_parent_hash_first_payload_is_valid(spec, state):
+    # before the merge transition completes, parent_hash is unconstrained
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    yield from run_execution_payload_processing(spec, state, payload)
