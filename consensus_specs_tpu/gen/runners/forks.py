@@ -40,8 +40,14 @@ def main(argv=None):
     from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
 
     ensure_vector_sources_importable()
+    specs = [
+        ("tests.spec.altair.test_fork", "phase0", "altair"),
+        ("tests.spec.bellatrix.test_fork", "altair", "bellatrix"),
+        ("tests.spec.capella.test_fork", "bellatrix", "capella"),
+    ]
     providers = [
-        _create_provider("tests.spec.altair.test_fork", preset, "phase0", "altair")
+        _create_provider(mod, preset, pre, post)
+        for (mod, pre, post) in specs
         for preset in ("minimal", "mainnet")
     ]
     gen_runner.run_generator("forks", providers, argv=argv)

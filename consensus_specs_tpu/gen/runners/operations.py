@@ -26,7 +26,10 @@ def main(argv=None):
         )
     }
     _new_altair_mods = {
-        "sync_aggregate": "tests.spec.altair.test_sync_aggregate",
+        "sync_aggregate": [
+            "tests.spec.altair.test_sync_aggregate",
+            "tests.spec.altair.test_sync_aggregate_random",
+        ],
     }
     altair_mods = combine_mods(_new_altair_mods, phase_0_mods)
     _new_bellatrix_mods = {

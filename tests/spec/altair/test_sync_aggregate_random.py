@@ -1,0 +1,48 @@
+"""Randomized sync-aggregate scenarios (reference capability:
+test/altair/block_processing/sync_aggregate/test_process_sync_aggregate_random.py):
+seeded participation patterns through the real process_sync_aggregate."""
+import random
+
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_altair_and_later,
+)
+from consensus_specs_tpu.testing.helpers.sync_committee import (
+    compute_committee_indices,
+    run_successful_sync_committee_test,
+)
+
+
+def _run_random_participation(spec, state, rng, fraction):
+    """Reuses the shared runner, which validates every participant's
+    reward and every absentee's penalty."""
+    committee_indices = compute_committee_indices(spec, state)
+    size = len(committee_indices)
+    participate = set(rng.sample(range(size), int(size * fraction)))
+    bits = [i in participate for i in range(size)]
+    yield from run_successful_sync_committee_test(
+        spec, state, committee_indices, bits)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_participation_three_quarters(spec, state):
+    yield from _run_random_participation(spec, state, random.Random(41), 0.75)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_participation_half(spec, state):
+    yield from _run_random_participation(spec, state, random.Random(42), 0.5)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_participation_low(spec, state):
+    yield from _run_random_participation(spec, state, random.Random(43), 0.25)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_empty_participation(spec, state):
+    yield from _run_random_participation(spec, state, random.Random(44), 0.0)

@@ -1,0 +1,59 @@
+"""Capella fork-upgrade tests (reference capability: the capella fork.md
+upgrade applied from bellatrix states)."""
+from consensus_specs_tpu.testing.context import (
+    low_balances,
+    misc_balances,
+    spec_test,
+    with_custom_state,
+    with_phases,
+    with_state,
+)
+from consensus_specs_tpu.testing.helpers.capella.fork import (
+    CAPELLA_FORK_TEST_META_TAGS,
+    run_fork_test,
+)
+from consensus_specs_tpu.testing.helpers.constants import BELLATRIX, CAPELLA
+from consensus_specs_tpu.testing.helpers.state import next_epoch, next_epoch_via_block
+from consensus_specs_tpu.testing.utils import with_meta_tags
+
+
+@with_phases(phases=[BELLATRIX], other_phases=[CAPELLA])
+@spec_test
+@with_state
+@with_meta_tags(CAPELLA_FORK_TEST_META_TAGS)
+def test_fork_base_state(spec, phases, state):
+    yield from run_fork_test(phases[CAPELLA], state)
+
+
+@with_phases(phases=[BELLATRIX], other_phases=[CAPELLA])
+@spec_test
+@with_state
+@with_meta_tags(CAPELLA_FORK_TEST_META_TAGS)
+def test_fork_next_epoch(spec, phases, state):
+    next_epoch(spec, state)
+    yield from run_fork_test(phases[CAPELLA], state)
+
+
+@with_phases(phases=[BELLATRIX], other_phases=[CAPELLA])
+@spec_test
+@with_state
+@with_meta_tags(CAPELLA_FORK_TEST_META_TAGS)
+def test_fork_next_epoch_with_block(spec, phases, state):
+    next_epoch_via_block(spec, state)
+    yield from run_fork_test(phases[CAPELLA], state)
+
+
+@with_phases(phases=[BELLATRIX], other_phases=[CAPELLA])
+@with_custom_state(balances_fn=low_balances, threshold_fn=lambda spec: spec.config.EJECTION_BALANCE)
+@spec_test
+@with_meta_tags(CAPELLA_FORK_TEST_META_TAGS)
+def test_fork_random_low_balances(spec, phases, state):
+    yield from run_fork_test(phases[CAPELLA], state)
+
+
+@with_phases(phases=[BELLATRIX], other_phases=[CAPELLA])
+@with_custom_state(balances_fn=misc_balances, threshold_fn=lambda spec: spec.config.EJECTION_BALANCE)
+@spec_test
+@with_meta_tags(CAPELLA_FORK_TEST_META_TAGS)
+def test_fork_random_misc_balances(spec, phases, state):
+    yield from run_fork_test(phases[CAPELLA], state)
