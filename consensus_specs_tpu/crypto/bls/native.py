@@ -85,7 +85,8 @@ def _load() -> ctypes.CDLL:
     sig("bls_aggregate", u8p, sz, u8p)
     sig("bls_aggregate_pks", u8p, sz, u8p)
     sig("bls_fast_aggregate_verify", u8p, sz, u8p, sz, u8p)
-    sig("bls_fast_aggregate_verify_prechecked", u8p, sz, u8p, sz, u8p)
+    sig("bls_decompress_pubkey", u8p, u8p)
+    sig("bls_fast_aggregate_verify_affine", u8p, sz, u8p, sz, u8p)
     sig("bls_aggregate_verify", u8p, sz, u8p, ctypes.POINTER(sz), u8p)
     sig("bls_hash_to_g2", u8p, sz, u8p, sz, u8p)
     sig("bls_pairing", u8p, u8p, u8p)
@@ -175,25 +176,27 @@ def AggregatePKs(pubkeys: Sequence[bytes]) -> bytes:
     return bytes(out)
 
 
-# Pubkeys that have passed a full validation (subgroup included) once; the
-# same validator keys recur in every attestation, so later aggregates skip
-# the per-key subgroup scalar mult (same idea as the oracle's lru_cache on
-# pubkey_to_point, curve.py:269-276).
-_VALIDATED_PKS: set = set()
-_VALIDATED_PKS_MAX = 1 << 20
+# Validated + decompressed pubkeys (canonical affine x||y): the same
+# validator keys recur in every attestation, so later aggregates skip both
+# the subgroup scalar mult and the decompression square root (same idea as
+# the oracle's lru_cache on pubkey_to_point, curve.py:269-276).
+_AFFINE_PKS: dict = {}
+_AFFINE_PKS_MAX = 1 << 20
 
 
-def _all_prechecked(pks) -> bool:
-    validated = _VALIDATED_PKS
-    unseen = [p for p in pks if p not in validated]
-    if not unseen:
-        return True
-    for p in set(unseen):
-        if not _lib.bls_key_validate(_buf(p)):
-            return False
-        if len(validated) < _VALIDATED_PKS_MAX:
-            validated.add(p)
-    return True
+def _affine_of(pk: bytes):
+    """96-byte affine coordinates for a validated pubkey, or None if the
+    key is malformed/out-of-subgroup/infinity."""
+    cached = _AFFINE_PKS.get(pk)
+    if cached is not None:
+        return cached
+    out = (ctypes.c_uint8 * 96)()
+    if not _lib.bls_decompress_pubkey(_buf(pk), out):
+        return None
+    xy = bytes(out)
+    if len(_AFFINE_PKS) < _AFFINE_PKS_MAX:
+        _AFFINE_PKS[pk] = xy
+    return xy
 
 
 def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes, signature: bytes) -> bool:
@@ -202,14 +205,18 @@ def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes, signature: byt
     if len(pks) == 0 or len(sig) != 96 or any(len(p) != 48 for p in pks):
         return False
     msg = bytes(message)
-    flat = b"".join(pks)
-    if _all_prechecked(pks):
-        return bool(
-            _lib.bls_fast_aggregate_verify_prechecked(
-                _buf(flat), len(pks), _buf(msg), len(msg), _buf(sig)
-            )
+    affines = []
+    for p in pks:
+        xy = _affine_of(p)
+        if xy is None:
+            return False  # invalid pubkey: the aggregate cannot verify
+        affines.append(xy)
+    flat = b"".join(affines)
+    return bool(
+        _lib.bls_fast_aggregate_verify_affine(
+            _buf(flat), len(pks), _buf(msg), len(msg), _buf(sig)
         )
-    return False  # some pubkey invalid: the aggregate cannot verify
+    )
 
 
 def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signature: bytes) -> bool:

@@ -1085,22 +1085,36 @@ int bls_fast_aggregate_verify(const uint8_t *pks, size_t n, const uint8_t *msg,
     return final_exponentiation(f) == Fp12::one() ? 1 : 0;
 }
 
-// Caller-attested-valid pubkeys (deserialized fine, on curve, in subgroup,
-// not infinity — e.g. cached from a previous bls_key_validate): skips the
-// per-key subgroup scalar multiplication, which dominates large aggregates.
-int bls_fast_aggregate_verify_prechecked(const uint8_t *pks, size_t n,
-                                         const uint8_t *msg, size_t msg_len,
-                                         const uint8_t sig[96]) {
+// Validated decompression: pk -> canonical affine x||y (48+48 bytes BE).
+// rc 1 on success; 0 for malformed/out-of-subgroup/infinity keys.
+int bls_decompress_pubkey(const uint8_t pk[48], uint8_t out_xy[96]) {
+    bls_init();
+    G1 pt;
+    if (load_pubkey(pt, pk)) return 0;
+    if (pt.is_inf()) return 0;
+    Fp x, y;
+    pt.to_affine(x, y);
+    fp_to_bytes48(out_xy, x);
+    fp_to_bytes48(out_xy + 48, y);
+    return 1;
+}
+
+// FastAggregateVerify over pre-decompressed affine pubkeys (from
+// bls_decompress_pubkey, cached by the caller): no square roots, no
+// subgroup checks — the decompression already established both.
+int bls_fast_aggregate_verify_affine(const uint8_t *xys, size_t n,
+                                     const uint8_t *msg, size_t msg_len,
+                                     const uint8_t sig[96]) {
     bls_init();
     if (n == 0) return 0;
     G2 sigpt;
     if (load_signature(sigpt, sig)) return 0;
     G1 agg = G1::infinity();
     for (size_t i = 0; i < n; i++) {
-        G1 p;
-        if (g1_deserialize(p, pks + 48 * i)) return 0;
-        if (p.is_inf()) return 0;
-        agg = agg.add(p);
+        Fp x, y;
+        if (!fp_from_bytes48(x, xys + 96 * i)) return 0;
+        if (!fp_from_bytes48(y, xys + 96 * i + 48)) return 0;
+        agg = agg.add(G1{x, y, Fp::one()});
     }
     G2 h = hash_to_g2(msg, msg_len, DST_POP, DST_POP_LEN);
     Fp12 f = miller_loop(agg, h) * miller_loop(G1_GEN.neg(), sigpt);
