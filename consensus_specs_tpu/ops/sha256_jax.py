@@ -123,11 +123,18 @@ def hash_blocks_u32(words: np.ndarray) -> np.ndarray:
     return out[:n]
 
 
-def hash_layer(blocks: List[bytes]) -> List[bytes]:
-    """Backend for ssz.hashing: list of 64-byte inputs -> 32-byte digests."""
+def hash_layer_via(hash_words, blocks: List[bytes]) -> List[bytes]:
+    """Shared byte<->uint32 packing for layer-hash backends: `hash_words`
+    maps [N,16] big-endian uint32 words to [N,8] digests (numpy in/out)."""
     n = len(blocks)
-    raw = b"".join(blocks)
-    words = np.frombuffer(raw, dtype=">u4").reshape(n, 16).astype(np.uint32)
-    out = hash_blocks_u32(words)
+    if n == 0:
+        return []
+    words = np.frombuffer(b"".join(blocks), dtype=">u4").reshape(n, 16).astype(np.uint32)
+    out = hash_words(words)
     flat = out.astype(">u4").tobytes()
     return [flat[i * 32:(i + 1) * 32] for i in range(n)]
+
+
+def hash_layer(blocks: List[bytes]) -> List[bytes]:
+    """Backend for ssz.hashing: list of 64-byte inputs -> 32-byte digests."""
+    return hash_layer_via(hash_blocks_u32, blocks)

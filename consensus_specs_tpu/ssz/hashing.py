@@ -48,13 +48,21 @@ def register_backend(name: str, fn: Callable[[List[bytes]], List[bytes]]) -> Non
     _BACKENDS[name] = fn
 
 
+# Device backends register lazily on first request (importing them pulls
+# in jax, which SSZ-only consumers must not pay for).
+_LAZY_BACKENDS = {
+    "jax": "consensus_specs_tpu.ops.sha256_jax",
+    "pallas": "consensus_specs_tpu.ops.sha256_pallas",
+}
+
+
 def set_backend(name: str) -> None:
     global _active, _active_name
-    if name == "jax" and "jax" not in _BACKENDS:
-        # Lazy-register the TPU kernel on first request.
-        from consensus_specs_tpu.ops import sha256_jax
+    if name not in _BACKENDS and name in _LAZY_BACKENDS:
+        import importlib
 
-        register_backend("jax", sha256_jax.hash_layer)
+        module = importlib.import_module(_LAZY_BACKENDS[name])
+        register_backend(name, module.hash_layer)
     _active = _BACKENDS[name]
     _active_name = name
 

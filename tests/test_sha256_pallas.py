@@ -1,0 +1,97 @@
+"""Differential tests for the Pallas SHA-256 merkle kernel.
+
+Two execution paths:
+
+* **Native (default)**: tests/conftest.py pins pytest's own process to the
+  virtual CPU mesh, so the native kernel is driven in a SUBPROCESS with the
+  platform pin stripped.  If that subprocess sees a real TPU it runs the
+  full differential check there; otherwise the test skips.  One subprocess
+  covers all native assertions (jax import over the tunnel costs seconds).
+* **Interpreter (opt-in)**: CSTPU_PALLAS_TESTS=1 runs the in-process tests
+  through pallas interpret mode — bit-identical but minutes-slow under this
+  image's jax build, hence opt-in.
+"""
+import hashlib
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+_NATIVE_SCRIPT = r"""
+import hashlib, sys
+import jax
+if jax.default_backend() != "tpu":
+    sys.exit(42)  # no TPU reachable: skip
+from consensus_specs_tpu.ops import sha256_pallas
+from consensus_specs_tpu.ssz import hashing
+from consensus_specs_tpu.ssz.types import List, uint64
+
+# single and multi lane-tile batches vs hashlib
+import random as _r
+rng = _r.Random(9)
+for n in (1, 127, 129):
+    msgs = [bytes(rng.getrandbits(8) for _ in range(64)) for _ in range(n)]
+    got = sha256_pallas.hash_layer(msgs)
+    assert len(got) == n
+    assert all(d == hashlib.sha256(m).digest() for m, d in zip(msgs, got))
+
+# merkle parent semantics + empty layer
+left = hashlib.sha256(b"left").digest()
+right = hashlib.sha256(b"right").digest()
+[parent] = sha256_pallas.hash_layer([left + right])
+assert parent == hashlib.sha256(left + right).digest()
+assert sha256_pallas.hash_layer([]) == []
+
+# registered as a hashing backend; tree root identical
+expected = List[uint64, 2**40](list(range(1500))).hash_tree_root()
+hashing.set_backend("pallas")
+try:
+    blocks = [bytes([i]) * 64 for i in range(256)]
+    assert hashing.hash_layer(blocks) == [hashlib.sha256(b).digest() for b in blocks]
+    assert List[uint64, 2**40](list(range(1500))).hash_tree_root() == expected
+finally:
+    hashing.set_backend("hashlib")
+print("native pallas differential OK")
+"""
+
+
+def test_native_kernel_on_tpu_subprocess():
+    """Drive the native (non-interpret) kernel on the real chip, outside
+    the conftest CPU pin."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["XLA_FLAGS"] = ""
+    proc = subprocess.run(
+        [sys.executable, "-c", _NATIVE_SCRIPT],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    if proc.returncode == 42:
+        pytest.skip("no real TPU reachable from this environment")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "native pallas differential OK" in proc.stdout
+
+
+# ---- interpreter-mode in-process tests (opt-in: minutes-slow) ------------
+
+interp = pytest.mark.skipif(
+    os.environ.get("CSTPU_PALLAS_TESTS") != "1",
+    reason="pallas interpret mode is minutes-slow off-TPU; set CSTPU_PALLAS_TESTS=1",
+)
+
+
+@interp
+def test_interpret_differential():
+    from consensus_specs_tpu.ops import sha256_pallas
+
+    rng = random.Random(9)
+    msgs = [bytes(rng.getrandbits(8) for _ in range(64)) for _ in range(3)]
+    got = sha256_pallas.hash_layer(msgs)
+    assert all(d == hashlib.sha256(m).digest() for m, d in zip(msgs, got))
+
+
+@interp
+def test_interpret_empty_layer():
+    from consensus_specs_tpu.ops import sha256_pallas
+
+    assert sha256_pallas.hash_layer([]) == []
