@@ -16,6 +16,9 @@ test-mainnet:
 bench:
 	python bench.py
 
+lint:
+	python tools/lint.py
+
 GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing finality rewards genesis random transition ssz_generic
 
 gen-all: $(addprefix gen-,$(GENERATORS))
@@ -24,4 +27,4 @@ gen-%:
 	mkdir -p $(OUT)
 	python -m consensus_specs_tpu.gen.runners.$* -o $(OUT) $(if $(PRESETS),-l $(PRESETS),)
 
-.PHONY: test test-fast test-mainnet bench gen-all $(addprefix gen-,$(GENERATORS))
+.PHONY: test test-fast test-mainnet bench lint gen-all $(addprefix gen-,$(GENERATORS))

@@ -14,7 +14,7 @@ from __future__ import annotations
 import hashlib
 from typing import Tuple
 
-from .curve import B_G2, Point, g2_infinity
+from .curve import B_G2, Point
 from .fields import FQ2_ONE, Fq2, H_EFF_G2, P
 
 # -- expand_message_xmd (RFC 9380 §5.3.1) -----------------------------------

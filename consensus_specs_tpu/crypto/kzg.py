@@ -14,7 +14,7 @@ from functools import lru_cache
 from typing import List, Sequence
 
 from .bls.curve import Point, g1_from_bytes, g1_generator, g1_infinity, g1_to_bytes
-from .fr import R, fft, ifft, root_of_unity
+from .fr import R, ifft, root_of_unity
 
 # the spec's insecure testing secret must only ever appear in presets
 INSECURE_SECRET = 1337

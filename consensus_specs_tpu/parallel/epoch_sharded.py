@@ -17,7 +17,6 @@ driver's ``dryrun_multichip``.
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -46,7 +46,6 @@ from consensus_specs_tpu.config import get_config, get_preset
 from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.ops.shuffle import compute_shuffle_permutation
 from consensus_specs_tpu.ssz import hashing
-from consensus_specs_tpu.ssz import types as ssz_types
 from consensus_specs_tpu.ssz.gindex import get_generalized_index
 from consensus_specs_tpu.ssz.impl import copy, hash_tree_root, serialize, uint_to_bytes
 from consensus_specs_tpu.ssz.types import (

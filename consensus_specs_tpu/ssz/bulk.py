@@ -17,14 +17,7 @@ from typing import Dict
 
 import numpy as np
 
-from .node import (
-    BranchNode,
-    LeafNode,
-    Node,
-    pack_chunks,
-    subtree_fill_to_contents,
-    uint_to_leaf,
-)
+from .node import BranchNode, Node, pack_chunks, subtree_fill_to_contents, uint_to_leaf
 from .types import _collect_leaf_roots
 
 

@@ -10,19 +10,7 @@ from __future__ import annotations
 from typing import List as PyList
 
 from .node import BranchNode, Node, get_subtree, merkle_root
-from .types import (
-    Bitlist,
-    Bitvector,
-    ByteList,
-    ByteVector,
-    Container,
-    List,
-    Union,
-    Vector,
-    _HomogeneousBase,
-    ceil_log2,
-    is_basic_type,
-)
+from .types import Bitlist, Bitvector, ByteList, ByteVector, Container, List, Vector, _HomogeneousBase, ceil_log2
 
 GeneralizedIndex = int
 

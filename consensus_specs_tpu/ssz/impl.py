@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from . import types as tp
 from .node import merkle_root
-from .types import Bytes32, SSZType, View, boolean, uint
+from .types import Bytes32, View, boolean, uint
 
 
 def serialize(obj) -> bytes:

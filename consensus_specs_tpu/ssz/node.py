@@ -19,9 +19,9 @@ large SHA-256 batches instead of ~10^5 single hashes.
 """
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
-from .hashing import ZERO_HASHES, hash_layer, sha256
+from .hashing import ZERO_HASHES, hash_layer
 
 
 class Node:

@@ -21,12 +21,10 @@ turns into one bulk subtree update + one layer-batched hash pass.
 from __future__ import annotations
 
 import io
-import struct
-from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Type
+from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .hashing import sha256
 from .node import (
     BranchNode,
     LeafNode,

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from random import Random
-from typing import Any, Callable, Dict, Sequence
+from typing import Any, Callable, Sequence
 
 from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.specs import available_forks, get_spec

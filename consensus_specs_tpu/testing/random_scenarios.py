@@ -11,7 +11,6 @@ from __future__ import annotations
 
 from random import Random
 
-from .helpers.attestations import get_valid_attestation
 from .helpers.block import build_empty_block_for_next_slot
 from .helpers.multi_operations import (
     get_random_attestations,

@@ -20,7 +20,7 @@ from __future__ import annotations
 import contextlib
 import time
 from collections import defaultdict
-from typing import Dict, Optional
+from typing import Dict
 
 _enabled = False
 _spans: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]

@@ -7,7 +7,6 @@ Runs only where the read-only reference checkout is present; skipped
 otherwise (e.g. on end-user installs).
 """
 import ast
-import os
 import re
 from pathlib import Path
 

@@ -13,15 +13,7 @@ import numpy as np
 import pytest
 
 from consensus_specs_tpu.crypto.bls import ciphersuite as py
-from consensus_specs_tpu.crypto.bls.fields import (
-    FQ12_ONE,
-    Fq2,
-    Fq6,
-    Fq12,
-    P,
-    R,
-    X_PARAM,
-)
+from consensus_specs_tpu.crypto.bls.fields import Fq2, Fq6, Fq12, P, R, X_PARAM
 from consensus_specs_tpu.ops import bls_jax
 from consensus_specs_tpu.ops.bls_jax import limbs, tower
 

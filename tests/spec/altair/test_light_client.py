@@ -12,10 +12,7 @@ from consensus_specs_tpu.testing.helpers.light_client import (
     initialize_light_client_store,
 )
 from consensus_specs_tpu.testing.helpers.merkle import build_proof
-from consensus_specs_tpu.testing.helpers.state import (
-    next_slots,
-    state_transition_and_sign_block,
-)
+from consensus_specs_tpu.testing.helpers.state import state_transition_and_sign_block
 
 
 @with_phases(["altair"])

@@ -9,7 +9,7 @@ from consensus_specs_tpu.testing.helpers.execution_payload import (
     build_empty_execution_payload,
     build_state_with_complete_transition,
 )
-from consensus_specs_tpu.testing.helpers.state import next_epoch, next_slot
+from consensus_specs_tpu.testing.helpers.state import next_epoch
 
 
 def _make_validator_withdrawable(spec, state, index):

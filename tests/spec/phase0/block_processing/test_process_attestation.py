@@ -13,7 +13,6 @@ from consensus_specs_tpu.testing.helpers.attestations import (
 )
 from consensus_specs_tpu.testing.helpers.state import (
     next_epoch,
-    next_slot,
     next_slots,
     transition_to,
 )

@@ -9,7 +9,6 @@ from consensus_specs_tpu.testing.context import (
     with_all_phases,
 )
 from consensus_specs_tpu.testing.helpers.deposits import prepare_state_and_deposit
-from consensus_specs_tpu.testing.helpers.keys import privkeys, pubkeys
 from consensus_specs_tpu.testing.helpers.state import get_balance
 
 

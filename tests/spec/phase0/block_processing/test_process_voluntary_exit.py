@@ -8,7 +8,6 @@ from consensus_specs_tpu.testing.context import (
     with_all_phases,
 )
 from consensus_specs_tpu.testing.helpers.keys import pubkey_to_privkey
-from consensus_specs_tpu.testing.helpers.state import next_epoch
 from consensus_specs_tpu.testing.helpers.voluntary_exits import sign_voluntary_exit
 
 

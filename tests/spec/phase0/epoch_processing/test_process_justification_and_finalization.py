@@ -7,13 +7,10 @@ from consensus_specs_tpu.testing.context import (
     with_all_phases,
     with_phases,
 )
-from consensus_specs_tpu.testing.helpers.attestations import (
-    prepare_state_with_attestations,
-)
 from consensus_specs_tpu.testing.helpers.epoch_processing import (
     run_epoch_processing_with,
 )
-from consensus_specs_tpu.testing.helpers.state import next_epoch, transition_to
+from consensus_specs_tpu.testing.helpers.state import transition_to
 
 
 def _skip_to_epoch(spec, state, epoch):

@@ -12,9 +12,7 @@ from consensus_specs_tpu.testing.helpers.block import (
     build_empty_block_for_next_slot,
     sign_block,
 )
-from consensus_specs_tpu.testing.helpers.keys import privkeys, pubkeys
 from consensus_specs_tpu.testing.helpers.state import (
-    get_balance,
     next_epoch,
     next_slot,
     state_transition_and_sign_block,

@@ -1,6 +1,6 @@
 """Slot-advance sanity tests (reference: test/phase0/sanity/test_slots.py)."""
 from consensus_specs_tpu.testing.context import spec_state_test, with_all_phases
-from consensus_specs_tpu.testing.helpers.state import get_state_root, next_epoch, next_slot
+from consensus_specs_tpu.testing.helpers.state import get_state_root
 
 
 @with_all_phases

@@ -1,5 +1,4 @@
 """Differential test: JAX attestation-deltas kernel vs the sequential spec."""
-import numpy as np
 
 from consensus_specs_tpu.ops.epoch_jax import attestation_deltas_for_state
 from consensus_specs_tpu.testing.context import spec_state_test, with_phases

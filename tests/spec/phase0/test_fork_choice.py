@@ -7,14 +7,12 @@ from consensus_specs_tpu.testing.context import (
 )
 from consensus_specs_tpu.testing.helpers.attestations import (
     get_valid_attestation,
-    next_epoch_with_attestations,
 )
 from consensus_specs_tpu.testing.helpers.block import (
     build_empty_block_for_next_slot,
 )
 from consensus_specs_tpu.testing.helpers.constants import MINIMAL
 from consensus_specs_tpu.testing.helpers.fork_choice import (
-    add_attestation,
     add_block,
     apply_next_epoch_with_attestations,
     get_anchor_root,

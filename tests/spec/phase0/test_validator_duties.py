@@ -8,7 +8,6 @@ from consensus_specs_tpu.testing.context import (
     with_phases,
 )
 from consensus_specs_tpu.testing.helpers.keys import privkeys
-from consensus_specs_tpu.testing.helpers.state import next_epoch
 
 
 @with_all_phases

@@ -1,6 +1,5 @@
 """Generator pipeline tests: runner lifecycle (INCOMPLETE/resume/error
 log), part writers, and the reflection bridge over a real test module."""
-import os
 
 import yaml
 

@@ -1,6 +1,5 @@
 """Differential tests: JAX SHA-256 kernel vs hashlib."""
 import hashlib
-import os
 import random
 
 from consensus_specs_tpu.ops import sha256_jax

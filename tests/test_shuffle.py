@@ -6,7 +6,6 @@ so it must equal the spec's scalar ``compute_shuffled_index``
 (reference: specs/phase0/beacon-chain.md:760-781) at every index — in
 particular near the 256-index source-hash block boundaries.
 """
-import numpy as np
 import pytest
 
 from consensus_specs_tpu.ops.shuffle import compute_shuffle_permutation

@@ -8,7 +8,6 @@ from consensus_specs_tpu.ssz import (
     Bitlist,
     Bitvector,
     ByteList,
-    ByteVector,
     Bytes32,
     Bytes48,
     Container,

@@ -16,19 +16,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from consensus_specs_tpu.crypto.bls.fields import (  # noqa: E402
-    _SQRT_ADJUSTMENTS,
-    FQ2_ONE,
-    Fq2,
-    Fq6,
-    Fq12,
-    FQ6_ZERO,
-    FQ2_ZERO,
-    H_EFF_G2,
-    P,
-    R,
-    X_PARAM,
-)
+from consensus_specs_tpu.crypto.bls.fields import _SQRT_ADJUSTMENTS, Fq2, Fq6, Fq12, H_EFF_G2, P, R, X_PARAM
 from consensus_specs_tpu.crypto.bls import hash_to_curve as h2c  # noqa: E402
 from consensus_specs_tpu.crypto.bls.curve import (  # noqa: E402
     G1_X,

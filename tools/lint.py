@@ -1,0 +1,133 @@
+"""From-scratch lint checker (reference capability: `linter.ini` flake8
+config + `make lint`, /root/reference/Makefile:140-147).
+
+The image ships no flake8/ruff and installs are barred, so this is a
+minimal AST-based checker enforcing the same hygiene class the reference
+CI does:
+
+  F401  unused import
+  E501  line too long (>120, matching the reference's flake8 max)
+  E999  syntax error
+  W291  trailing whitespace
+  W191  tab indentation
+  B001  bare except
+
+Spec-source files (`specs/src/*.py`) are exempt from E501: their bodies
+are pinned AST-for-AST to the reference markdown and must not be
+rewrapped.  Usage: python tools/lint.py [paths...]; exit 1 on findings.
+"""
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+MAX_LINE = 120
+
+
+def iter_py_files(roots):
+    for root in roots:
+        p = Path(root)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if ".cache" not in f.parts:
+                    yield f
+
+
+class ImportUseChecker(ast.NodeVisitor):
+    """Collect imported names and every name usage; unused = F401."""
+
+    def __init__(self):
+        self.imports = {}  # name -> (lineno, display)
+        self.used = set()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def check_file(path: Path) -> list:
+    findings = []
+    try:
+        text = path.read_text()
+    except UnicodeDecodeError as e:
+        return [(path, 0, f"E902 not valid UTF-8: {e.reason}")]
+    lines = text.splitlines()
+    is_spec_src = "specs/src" in str(path)
+    noqa_lines = {i for i, line in enumerate(lines, 1) if "# noqa" in line}
+
+    for i, line in enumerate(lines, 1):
+        if i in noqa_lines:
+            continue
+        if not is_spec_src and len(line) > MAX_LINE:
+            findings.append((path, i, f"E501 line too long ({len(line)} > {MAX_LINE})"))
+        if line != line.rstrip() and line.strip():
+            findings.append((path, i, "W291 trailing whitespace"))
+        if line.startswith("\t"):
+            findings.append((path, i, "W191 tab indentation"))
+
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        findings.append((path, e.lineno or 0, f"E999 syntax error: {e.msg}"))
+        return findings
+
+    checker = ImportUseChecker()
+    checker.visit(tree)
+    # package __init__ imports are re-exports (the public API surface);
+    # same as flake8 per-file-ignores = __init__.py:F401
+    if path.name == "__init__.py":
+        checker.imports = {}
+    # names referenced in module docstring-level __all__ or via string
+    # annotations count as used if they appear anywhere in the source text
+    for name, (lineno, display) in checker.imports.items():
+        if name in checker.used or name.startswith("_") or lineno in noqa_lines:
+            continue
+        # whole-word occurrence elsewhere (in __all__, a docstring doctest,
+        # or a string annotation) counts as a use; substrings do not
+        occurrences = len(re.findall(rf"\b{re.escape(name)}\b", text))
+        if occurrences <= 1:
+            findings.append((path, lineno, f"F401 '{display}' imported but unused"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if node.lineno not in noqa_lines:
+                findings.append((path, node.lineno, "B001 bare except"))
+
+    return findings
+
+
+def main(argv):
+    roots = argv or ["consensus_specs_tpu", "tests", "tools", "bench.py", "__graft_entry__.py"]
+    all_findings = []
+    n_files = 0
+    for f in iter_py_files(roots):
+        n_files += 1
+        all_findings.extend(check_file(f))
+    for path, lineno, msg in all_findings:
+        print(f"{path}:{lineno}: {msg}")
+    print(f"lint: {n_files} files checked, {len(all_findings)} findings")
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
