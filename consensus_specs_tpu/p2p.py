@@ -1,0 +1,142 @@
+"""Networking (p2p-interface) layer: constants, wire containers, and
+gossip message-id functions.
+
+Reference: specs/phase0/p2p-interface.md (config table at :170-184,
+containers at :650-700, 800-880, message-id at :255-264, ENRForkID at
+:940-970) and specs/altair/p2p-interface.md (:48-91 — syncnets MetaData
+and the topic-aware message-id).  The reference does NOT compile this
+document into its executable pyspec; here it is a standalone module so
+the wire-format containers and message-id rules are still testable.
+
+The req/resp payloads are plain SSZ containers from the repo's own type
+system; snappy framing uses the from-scratch codec in gen/snappy.py.
+(No `from __future__ import annotations` here: the Container metaclass
+resolves field annotations eagerly.)
+"""
+import hashlib
+
+from consensus_specs_tpu.gen.snappy import decompress as snappy_decompress
+from consensus_specs_tpu.ssz.types import (
+    Bitvector,
+    ByteVector,
+    Container,
+    List,
+    uint64,
+)
+
+Bytes4 = ByteVector[4]
+Bytes32 = ByteVector[32]
+
+# -- configuration (phase0 p2p-interface.md:170-184) ------------------------
+
+GOSSIP_MAX_SIZE = 2**20
+MAX_REQUEST_BLOCKS = 2**10
+MAX_CHUNK_SIZE = 2**20
+TTFB_TIMEOUT = 5  # seconds
+RESP_TIMEOUT = 10  # seconds
+ATTESTATION_PROPAGATION_SLOT_RANGE = 32
+MAXIMUM_GOSSIP_CLOCK_DISPARITY_MS = 500
+MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
+MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
+ATTESTATION_SUBNET_COUNT = 64
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+
+def min_epochs_for_block_requests(config) -> int:
+    """MIN_VALIDATOR_WITHDRAWABILITY_DELAY + CHURN_LIMIT_QUOTIENT // 2
+    (phase0 p2p-interface.md:174; rationale at :1437-1443); 33024 on
+    mainnet (~5 months)."""
+    return (
+        config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        + config.CHURN_LIMIT_QUOTIENT // 2
+    )
+
+# -- req/resp wire containers (phase0 p2p-interface.md) ---------------------
+
+
+class Status(Container):
+    fork_digest: Bytes4
+    finalized_root: Bytes32
+    finalized_epoch: uint64
+    head_root: Bytes32
+    head_slot: uint64
+
+
+class Goodbye(Container):
+    reason: uint64
+
+
+class Ping(Container):
+    seq_number: uint64
+
+
+class MetaData(Container):
+    """Phase0 MetaData (p2p-interface.md:186-199)."""
+    seq_number: uint64
+    attnets: Bitvector[ATTESTATION_SUBNET_COUNT]
+
+
+class MetaDataAltair(Container):
+    """Altair MetaData V2 with sync-subnet bits (altair/p2p-interface.md:50-63)."""
+    seq_number: uint64
+    attnets: Bitvector[ATTESTATION_SUBNET_COUNT]
+    syncnets: Bitvector[SYNC_COMMITTEE_SUBNET_COUNT]
+
+
+class BeaconBlocksByRangeRequest(Container):
+    start_slot: uint64
+    count: uint64
+    step: uint64
+
+
+BeaconBlocksByRootRequest = List[Bytes32, MAX_REQUEST_BLOCKS]
+
+
+class ENRForkID(Container):
+    """`eth2` ENR entry value (phase0 p2p-interface.md:940-952)."""
+    fork_digest: Bytes4
+    next_fork_version: Bytes4
+    next_fork_epoch: uint64
+
+
+# -- gossip message-id (phase0 p2p-interface.md:255-264) --------------------
+
+
+def compute_message_id(message_data: bytes) -> bytes:
+    """Phase0 gossip message-id: 20-byte SHA-256 prefix over the snappy
+    domain + (decompressed) payload."""
+    try:
+        payload = MESSAGE_DOMAIN_VALID_SNAPPY + snappy_decompress(message_data)
+    except ValueError:
+        payload = MESSAGE_DOMAIN_INVALID_SNAPPY + message_data
+    return hashlib.sha256(payload).digest()[:20]
+
+
+def compute_message_id_altair(message_topic, message_data: bytes) -> bytes:
+    """Altair gossip message-id: additionally binds the topic byte string
+    (altair/p2p-interface.md:77-86).  The topic may be given as `str`
+    (as produced by `gossip_topic`) or raw UTF-8 bytes."""
+    if isinstance(message_topic, str):
+        message_topic = message_topic.encode("utf-8")
+    topic_part = len(message_topic).to_bytes(8, "little") + message_topic
+    try:
+        body = snappy_decompress(message_data)
+        payload = MESSAGE_DOMAIN_VALID_SNAPPY + topic_part + body
+    except ValueError:
+        payload = MESSAGE_DOMAIN_INVALID_SNAPPY + topic_part + message_data
+    return hashlib.sha256(payload).digest()[:20]
+
+
+# -- gossip topic names (phase0 p2p-interface.md:268-300) -------------------
+
+
+def gossip_topic(fork_digest: bytes, name: str, encoding: str = "ssz_snappy") -> str:
+    return f"/eth2/{fork_digest.hex()}/{name}/{encoding}"
+
+
+def attestation_subnet_topic(fork_digest: bytes, subnet_id: int) -> str:
+    return gossip_topic(fork_digest, f"beacon_attestation_{subnet_id}")
+
+
+def sync_committee_subnet_topic(fork_digest: bytes, subnet_id: int) -> str:
+    return gossip_topic(fork_digest, f"sync_committee_{subnet_id}")

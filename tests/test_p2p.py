@@ -1,0 +1,123 @@
+"""Tests for the networking (p2p-interface) layer.
+
+Reference behaviors pinned: gossip message-id derivation for valid and
+invalid snappy payloads (phase0 p2p-interface.md:255-264, altair
+p2p-interface.md:77-86), wire-container SSZ shapes, ENRForkID encoding,
+and MIN_EPOCHS_FOR_BLOCK_REQUESTS = 33024 on mainnet."""
+import hashlib
+
+from consensus_specs_tpu import p2p
+from consensus_specs_tpu.config.configs import get_config
+from consensus_specs_tpu.gen.snappy import compress
+
+
+def test_min_epochs_for_block_requests_mainnet():
+    assert p2p.min_epochs_for_block_requests(get_config("mainnet")) == 33024
+
+
+def test_message_id_valid_snappy():
+    body = b"hello gossip" * 10
+    mid = p2p.compute_message_id(compress(body))
+    assert mid == hashlib.sha256(b"\x01\x00\x00\x00" + body).digest()[:20]
+    assert len(mid) == 20
+
+
+def test_message_id_invalid_snappy():
+    junk = b"\xff\xff\xff not snappy"
+    mid = p2p.compute_message_id(junk)
+    assert mid == hashlib.sha256(b"\x00\x00\x00\x00" + junk).digest()[:20]
+
+
+def test_message_id_altair_binds_topic():
+    body = b"altair payload"
+    topic = b"/eth2/aabbccdd/beacon_block/ssz_snappy"
+    data = compress(body)
+    expected = hashlib.sha256(
+        b"\x01\x00\x00\x00" + len(topic).to_bytes(8, "little") + topic + body
+    ).digest()[:20]
+    assert p2p.compute_message_id_altair(topic, data) == expected
+    # different topic -> different id (phase0 variant would collide)
+    assert p2p.compute_message_id_altair(b"other", data) != expected
+    # invalid snappy falls back to the raw-data domain
+    junk = b"\x00\xff junk"
+    expected_invalid = hashlib.sha256(
+        b"\x00\x00\x00\x00" + len(topic).to_bytes(8, "little") + topic + junk
+    ).digest()[:20]
+    assert p2p.compute_message_id_altair(topic, junk) == expected_invalid
+
+
+def test_status_roundtrip():
+    s = p2p.Status(
+        fork_digest=b"\x01\x02\x03\x04",
+        finalized_root=b"\xaa" * 32,
+        finalized_epoch=7,
+        head_root=b"\xbb" * 32,
+        head_slot=262,
+    )
+    data = s.encode_bytes()
+    assert len(data) == 4 + 32 + 8 + 32 + 8  # fixed-size container
+    back = p2p.Status.decode_bytes(data)
+    assert back == s and back.head_slot == 262
+
+
+def test_metadata_shapes():
+    md = p2p.MetaData(seq_number=3)
+    md.attnets[5] = True
+    back = p2p.MetaData.decode_bytes(md.encode_bytes())
+    assert back.seq_number == 3 and bool(back.attnets[5]) and not bool(back.attnets[4])
+
+    md2 = p2p.MetaDataAltair(seq_number=4)
+    md2.syncnets[2] = True
+    back2 = p2p.MetaDataAltair.decode_bytes(md2.encode_bytes())
+    assert bool(back2.syncnets[2]) and len(back2.syncnets) == 4
+
+
+def test_blocks_by_range_and_root_requests():
+    req = p2p.BeaconBlocksByRangeRequest(start_slot=100, count=64, step=1)
+    assert p2p.BeaconBlocksByRangeRequest.decode_bytes(req.encode_bytes()).count == 64
+
+    roots = p2p.BeaconBlocksByRootRequest([b"\x11" * 32, b"\x22" * 32])
+    back = p2p.BeaconBlocksByRootRequest.decode_bytes(roots.encode_bytes())
+    assert len(back) == 2 and bytes(back[1]) == b"\x22" * 32
+
+
+def test_enr_fork_id_matches_spec_fork_digest():
+    from consensus_specs_tpu.specs import get_spec
+
+    spec = get_spec("phase0", "minimal")
+    digest = spec.compute_fork_digest(
+        spec.config.GENESIS_FORK_VERSION, b"\x00" * 32
+    )
+    enr = p2p.ENRForkID(
+        fork_digest=bytes(digest),
+        next_fork_version=bytes(spec.config.GENESIS_FORK_VERSION),
+        next_fork_epoch=2**64 - 1,
+    )
+    back = p2p.ENRForkID.decode_bytes(enr.encode_bytes())
+    assert bytes(back.fork_digest) == bytes(digest)
+
+
+def test_subnet_counts_match_compiled_spec():
+    """Guard against drift between p2p's bitvector widths and the spec
+    modules' subnet constants."""
+    from consensus_specs_tpu.specs import get_spec
+
+    spec = get_spec("altair", "minimal")
+    assert p2p.ATTESTATION_SUBNET_COUNT == spec.ATTESTATION_SUBNET_COUNT
+    assert p2p.SYNC_COMMITTEE_SUBNET_COUNT == spec.SYNC_COMMITTEE_SUBNET_COUNT
+
+
+def test_message_id_altair_accepts_str_topic():
+    fd = b"\x01\x02\x03\x04"
+    topic = p2p.gossip_topic(fd, "beacon_block")
+    data = b"\xff not snappy"
+    assert p2p.compute_message_id_altair(topic, data) == p2p.compute_message_id_altair(
+        topic.encode("utf-8"), data
+    )
+
+
+def test_gossip_topic_names():
+    fd = b"\x01\x02\x03\x04"
+    assert p2p.gossip_topic(fd, "beacon_block") == "/eth2/01020304/beacon_block/ssz_snappy"
+    assert p2p.attestation_subnet_topic(fd, 9).endswith("/beacon_attestation_9/ssz_snappy")
+    assert p2p.sync_committee_subnet_topic(fd, 3).endswith("/sync_committee_3/ssz_snappy")
