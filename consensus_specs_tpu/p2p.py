@@ -18,14 +18,12 @@ import hashlib
 from consensus_specs_tpu.gen.snappy import decompress as snappy_decompress
 from consensus_specs_tpu.ssz.types import (
     Bitvector,
-    ByteVector,
+    Bytes4,
+    Bytes32,
     Container,
     List,
     uint64,
 )
-
-Bytes4 = ByteVector[4]
-Bytes32 = ByteVector[32]
 
 # -- configuration (phase0 p2p-interface.md:170-184) ------------------------
 

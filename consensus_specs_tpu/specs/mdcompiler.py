@@ -1,0 +1,492 @@
+"""Markdown spec compiler: L1 (spec documents) -> L2 (runnable module).
+
+The reference's source of truth is GFM markdown with ```python fences and
+constant tables; its compiler extracts and emits flat Python modules
+(reference: setup.py:168-264 extractor, :580-678 emitter, :867-905 per-fork
+document lists).  This module is the TPU framework's equivalent: it parses
+the *vendored reference markdown itself* and execs the extracted spec over
+this framework's runtime (SSZ types, ``bls`` selector, ``hash``,
+preset/config data) — producing a second, independently-derived executable
+of every mainline fork.
+
+Two purposes:
+
+* **compiler parity** — the L1/L2 markdown round-trip the reference has
+  (``emit_fork_source`` is the emitter; the CLI writes modules to disk);
+* **differential conformance** — the markdown-compiled executable is run
+  against the handwritten+optimized spec modules in
+  ``tests/conformance/test_markdown_spec.py`` and must produce
+  byte-identical state roots.  The handwritten path carries the vectorized
+  kernels; the markdown path is pure extracted spec text — agreement pins
+  the whole optimization stack to the normative source.
+
+Classification mirrors the reference compiler:
+
+* table rows whose name is in the preset -> preset vars (values come from
+  preset data, not the markdown's illustrative mainnet numbers;
+  reference: setup.py:241-247);
+* rows in the config -> config vars (materialized from config data);
+* rows whose value starts with ``get_generalized_index`` -> ssz-dependent
+  constants.  The reference hardcodes these and asserts equality at import
+  (setup.py:447-449); here they are evaluated live against our gindex
+  implementation, which *is* that assertion;
+* other rows -> plain constants, emitted verbatim;
+* custom-type rows (lowercase-containing name, type-shaped value) ->
+  ``Name = SSZEquivalent`` aliases;
+* ```python fences -> functions / containers / dataclasses / protocols,
+  emitted in document order (the documents are dependency-ordered, and
+  fork documents layered over one another give the later-fork-overrides
+  semantics of the reference's combine_spec_objects, setup.py:741-764).
+
+Only the reference's own per-fork document lists are compiled
+(setup.py:867-905) — experimental forks (eip4844/sharding/custody/das)
+were never compiled by the reference either.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from types import ModuleType
+from typing import Dict, Iterable, Optional, Tuple
+
+REFERENCE_ROOT = Path("/root/reference")
+SRC_DIR = Path(__file__).parent / "src"
+
+# Per-fork markdown document lists — the reference compiler's defaults
+# (setup.py:867-905).  Each fork compiles its ancestors' lists first.
+DOC_LISTS = {
+    "phase0": [
+        "specs/phase0/beacon-chain.md",
+        "specs/phase0/fork-choice.md",
+        "specs/phase0/validator.md",
+        "specs/phase0/weak-subjectivity.md",
+    ],
+    "altair": [
+        "specs/altair/beacon-chain.md",
+        "specs/altair/bls.md",
+        "specs/altair/fork.md",
+        "specs/altair/validator.md",
+        "specs/altair/p2p-interface.md",
+        "specs/altair/sync-protocol.md",
+    ],
+    "bellatrix": [
+        "specs/bellatrix/beacon-chain.md",
+        "specs/bellatrix/fork.md",
+        "specs/bellatrix/fork-choice.md",
+        "specs/bellatrix/validator.md",
+        "sync/optimistic.md",
+    ],
+    "capella": [
+        "specs/capella/beacon-chain.md",
+        "specs/capella/fork.md",
+        "specs/capella/fork-choice.md",
+        "specs/capella/validator.md",
+        "specs/capella/p2p-interface.md",
+    ],
+}
+
+MD_FORK_PARENTS = {"phase0": None, "altair": "phase0",
+                   "bellatrix": "altair", "capella": "bellatrix"}
+
+# Functions whose markdown bodies are demonstrative or environment-bound;
+# the reference compiler itself overrides them (setup.py:65-68 sanctioned
+# optimizations; :358-367, :514-546 per-fork sundry preparations).  The
+# replacement bodies are pulled from the handwritten sources, which the
+# fidelity suite pins.
+_SUNDRY_FROM_HANDWRITTEN = {
+    # fork: (src file, [def / class / assignment names])
+    "phase0": ("phase0.py", ["get_eth1_data"]),
+    # eth_aggregate_pubkeys: markdown body is demonstrative bytes-concat;
+    # reference substitutes bls.AggregatePKs (setup.py:488-492)
+    "altair": ("altair.py", ["eth_aggregate_pubkeys"]),
+    # EL/PoW stubs the reference injects so the spec runs clientless
+    # (setup.py:514-546), and the testing-variant genesis
+    "bellatrix": ("bellatrix.py", [
+        "get_pow_block", "NoopExecutionEngine", "EXECUTION_ENGINE",
+        "initialize_beacon_state_from_eth1",
+    ]),
+    "capella": ("capella.py", []),
+}
+
+_UPPER = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_TYPE_VALUE = re.compile(
+    r"^(uint\d+|boolean|bool|Bytes\d+|ByteVector|ByteList|Bitlist|Bitvector|"
+    r"List|Vector|Union)\b")
+# `Type('0x...')` -> `Type(bytes.fromhex('...'))` — our checked ByteVector
+# constructors take bytes, not hex strings.
+_HEX_CALL = re.compile(r"([A-Za-z_][A-Za-z_0-9]*)\('0x([0-9a-fA-F]*)'\)")
+
+
+def _rewrite_hex_calls(expr: str) -> str:
+    return _HEX_CALL.sub(lambda m: f"{m.group(1)}(bytes.fromhex('{m.group(2)}'))", expr)
+
+
+def _table_cells(line: str):
+    if not line.lstrip().startswith("|"):
+        return None
+    cells = [c.strip() for c in line.strip().strip("|").split("|")]
+    return cells if len(cells) >= 2 else None
+
+
+def _backticked(cell: str) -> Optional[str]:
+    m = re.match(r"^`([^`]+)`", cell)
+    return m.group(1) if m else None
+
+
+def extract_items(md_text: str):
+    """Ordered (kind, payload) stream from one markdown document.
+
+    kinds: ``code`` (python fence source), ``row`` ((name, value-expr)).
+    """
+    items = []
+    lines = md_text.split("\n")
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if line.strip().startswith("```python"):
+            j = i + 1
+            block = []
+            while j < len(lines) and not lines[j].strip().startswith("```"):
+                block.append(lines[j])
+                j += 1
+            items.append(("code", "\n".join(block)))
+            i = j + 1
+            continue
+        cells = _table_cells(line)
+        if cells:
+            name = _backticked(cells[0])
+            value = _backticked(cells[1]) if len(cells) > 1 else None
+            if name and value:
+                items.append(("row", (name, value)))
+        i += 1
+    return items
+
+
+def _classify_code(block: str):
+    """Top-level (kind, name, source) tuples of a python fence; [] if not
+    parseable (prose-example fences in p2p documents).  Kinds: container
+    (SSZ ``class X(Container)``-family), dataclass, code (functions,
+    protocols, plain classes)."""
+    try:
+        tree = ast.parse(block)
+    except SyntaxError:
+        return []
+    out = []
+    for node in tree.body:
+        seg = ast.get_source_segment(block, node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args.args
+            if (args and args[0].arg == "self"
+                    and isinstance(args[0].annotation, ast.Name)):
+                # protocol method (reference: setup.py classifies defs with a
+                # typed ``self`` arg as ProtocolDefinition members)
+                out.append(("protocol", args[0].annotation.id, seg))
+                continue
+            out.append(("code", node.name, seg))
+        elif isinstance(node, ast.ClassDef):
+            if any(isinstance(d, ast.Name) and d.id == "dataclass"
+                   or isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                   and d.func.id == "dataclass" for d in node.decorator_list):
+                out.append(("dataclass", node.name, seg))
+            elif any(isinstance(b, ast.Name) and b.id == "Protocol"
+                     for b in node.bases):
+                out.append(("code", node.name, seg))
+            else:
+                out.append(("container", node.name, seg))
+    return out
+
+
+def _parses(expr: str) -> bool:
+    try:
+        ast.parse(expr, mode="eval")
+        return True
+    except SyntaxError:
+        return False
+
+
+def _names_used(src: str):
+    return {n.id for n in ast.walk(ast.parse(src)) if isinstance(n, ast.Name)}
+
+
+def _dependency_order(containers):
+    """Kahn-style fixpoint over (name, src) pairs: emit a container once no
+    not-yet-emitted sibling is referenced (the reference's
+    dependency_order_class_objects, setup.py:709-729)."""
+    pending = list(containers)
+    emitted, out = set(), []
+    while pending:
+        progressed = False
+        remaining = []
+        for name, src in pending:
+            deps = _names_used(src) & {n for n, _ in pending} - {name}
+            if deps - emitted:
+                remaining.append((name, src))
+            else:
+                out.append(src)
+                emitted.add(name)
+                progressed = True
+        if not progressed:  # cycle (mutually recursive) — emit as-is
+            out.extend(src for _, src in remaining)
+            break
+        pending = remaining
+    return out
+
+
+class SpecObject:
+    """Merged bucket model (the reference's 9-bucket SpecObject,
+    setup.py:71-91, minus the buckets preset/config data replaces).
+    Dicts preserve first-definition order; later forks override values
+    in place — exactly the reference's combine_spec_objects semantics
+    (setup.py:741-764)."""
+
+    def __init__(self):
+        self.consts: Dict[str, str] = {}        # custom types + plain constants
+        self.ssz_dep: Dict[str, str] = {}       # get_generalized_index constants
+        self.containers: Dict[str, str] = {}
+        self.dataclasses: Dict[str, str] = {}
+        self.functions: Dict[str, str] = {}     # defs + plain/Protocol-impl classes
+        self.protocols: Dict[str, Dict[str, str]] = {}
+
+    def update(self, other: "SpecObject") -> None:
+        self.consts.update(other.consts)
+        self.ssz_dep.update(other.ssz_dep)
+        self.containers.update(other.containers)
+        self.dataclasses.update(other.dataclasses)
+        self.functions.update(other.functions)
+        for proto, methods in other.protocols.items():
+            self.protocols.setdefault(proto, {}).update(methods)
+
+
+def doc_spec_object(md_text: str, preset: Dict[str, int],
+                    config_keys: Iterable[str]) -> SpecObject:
+    """Classify one markdown document into a SpecObject."""
+    config_keys = set(config_keys)
+    out = SpecObject()
+    for kind, payload in extract_items(md_text):
+        if kind == "code":
+            for ckind, name, seg in _classify_code(payload):
+                if ckind == "protocol":
+                    method = ast.parse(seg).body[0].name
+                    out.protocols.setdefault(name, {})[method] = seg
+                elif ckind == "container":
+                    out.containers[name] = seg
+                elif ckind == "dataclass":
+                    out.dataclasses[name] = seg
+                else:
+                    out.functions[name] = seg
+            continue
+        name, value = payload
+        value = _rewrite_hex_calls(value)
+        if _UPPER.match(name):
+            if name in preset or name in config_keys:
+                continue  # pre-seeded from preset/config data
+            if not _parses(value):
+                continue  # prose table (duty schedules, topic names, ...)
+            if value.startswith("get_generalized_index"):
+                out.ssz_dep[name] = f"{name} = {value}"
+            else:
+                out.consts[name] = f"{name} = {value}"
+        elif _TYPE_VALUE.match(value) and _parses(value) and name.isidentifier():
+            out.consts[name] = f"{name} = {value}"
+    return out
+
+
+def _protocol_class(name: str, methods: Dict[str, str]) -> str:
+    """Synthesize ``class <T>(Protocol)`` from its self-typed method defs
+    (reference: objects_to_spec emits ProtocolDefinition members as class
+    methods, merged across documents).  The ``self`` annotation — a
+    forward reference to the class being defined — is stripped."""
+    rendered = []
+    for seg in methods.values():
+        fn = ast.parse(seg).body[0]
+        fn.args.args[0].annotation = None
+        rendered.append("\n".join(
+            "    " + line for line in ast.unparse(fn).split("\n")))
+    return f"class {name}(Protocol):\n" + "\n\n".join(rendered)
+
+
+def emit_spec_source(spec: SpecObject) -> str:
+    """Flat module source from a merged SpecObject (the emitter,
+    reference: setup.py:580-678).
+
+    Order: custom types + plain constants -> containers and
+    ssz-dependent constants interleaved by dependency (LightClientUpdate's
+    field lengths use gindex constants, which reference BeaconState) ->
+    dataclasses -> protocols + functions.
+
+    The flat re-emission is load-bearing: a later fork overriding
+    ``BeaconBlockBody`` must also re-evaluate ``BeaconBlock``'s field
+    annotations, which only re-execing every container achieves — the
+    reason the reference compiles flat per-fork modules rather than
+    layering class definitions."""
+    graph = list(spec.containers.items()) + [
+        (name, src) for name, src in spec.ssz_dep.items()]
+    parts = (list(spec.consts.values())
+             + _dependency_order(graph)
+             + list(spec.dataclasses.values())
+             + [_protocol_class(n, m) for n, m in spec.protocols.items()]
+             + list(spec.functions.values()))
+    return "\n\n\n".join(parts) + "\n"
+
+
+def _handwritten_defs(src_file: str, names) -> str:
+    """Source of named top-level defs/classes/assignments from the
+    handwritten (fidelity-pinned) spec sources, for the sanctioned
+    overrides the reference also applies outside the markdown."""
+    text = (SRC_DIR / src_file).read_text()
+    tree = ast.parse(text)
+    wanted = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.ClassDef)) and node.name in names:
+            wanted[node.name] = ast.get_source_segment(text, node)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id in names:
+                    wanted[tgt.id] = ast.get_source_segment(text, node)
+        elif isinstance(node, ast.AnnAssign):
+            tgt = node.target
+            if isinstance(tgt, ast.Name) and tgt.id in names:
+                wanted[tgt.id] = ast.get_source_segment(text, node)
+    missing = [n for n in names if n not in wanted]
+    assert not missing, f"sundry defs not found in {src_file}: {missing}"
+    return "\n\n\n".join(wanted[n] for n in names)
+
+
+def fork_spec_object(fork: str, preset: Dict[str, int],
+                     config_keys: Iterable[str],
+                     reference_root: Path = REFERENCE_ROOT) -> SpecObject:
+    """Merged SpecObject for ``fork``: every ancestor's documents folded
+    in chain order, each fork's sanctioned sundry overrides applied after
+    its documents (reference: per-fork builder preparations)."""
+    chain = []
+    cur: Optional[str] = fork
+    while cur is not None:
+        chain.append(cur)
+        cur = MD_FORK_PARENTS[cur]
+    chain.reverse()
+
+    merged = SpecObject()
+    for f in chain:
+        for doc in DOC_LISTS[f]:
+            path = reference_root / doc
+            assert path.exists(), f"spec document missing: {path}"
+            text = path.read_text()
+            if not text.strip():  # capella/p2p-interface.md is empty
+                continue
+            merged.update(doc_spec_object(text, preset, config_keys))
+        src_file, names = _SUNDRY_FROM_HANDWRITTEN[f]
+        if names:
+            sundry = SpecObject()
+            text = _handwritten_defs(src_file, names)
+            for node in ast.parse(text).body:
+                seg = ast.get_source_segment(text, node)
+                if isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+                    sundry.functions[node.name] = seg
+                elif isinstance(node, ast.Assign):
+                    sundry.functions[node.targets[0].id] = seg
+                elif isinstance(node, ast.AnnAssign):
+                    sundry.functions[node.target.id] = seg
+            merged.update(sundry)
+    return merged
+
+
+def emit_fork_source(fork: str, preset: Dict[str, int],
+                     config_keys: Iterable[str],
+                     reference_root: Path = REFERENCE_ROOT) -> str:
+    """Flat module source for ``fork`` × preset data (the CLI product —
+    the analogue of the reference's emitted eth2spec/<fork>/<preset>.py)."""
+    return emit_spec_source(
+        fork_spec_object(fork, preset, config_keys, reference_root))
+
+
+_md_cache: Dict[Tuple[str, str], ModuleType] = {}
+
+
+def get_md_spec(fork: str, preset_name: str = "minimal") -> ModuleType:
+    """Cached markdown-compiled spec (test-suite entry point)."""
+    key = (fork, preset_name)
+    if key not in _md_cache:
+        _md_cache[key] = build_spec_from_markdown(fork, preset_name)
+    return _md_cache[key]
+
+
+def build_spec_from_markdown(fork: str, preset_name: str = "minimal",
+                             reference_root: Path = REFERENCE_ROOT) -> ModuleType:
+    """Compile ``fork`` × ``preset`` from the reference markdown into a
+    runnable module over this framework's runtime."""
+    import sys
+
+    from consensus_specs_tpu.config import get_config, get_preset
+    from consensus_specs_tpu.specs import builder
+    from consensus_specs_tpu.ssz.types import ByteVector, View
+    from typing import TypeVar
+
+    assert fork in DOC_LISTS, f"not a markdown-compiled fork: {fork}"
+    preset = get_preset(preset_name)
+    raw_config = get_config(preset_name).to_dict()
+    config = builder._typed_config(raw_config)
+
+    mod_name = f"consensus_specs_tpu.specs.md.{fork}_{preset_name}"
+    mod = ModuleType(mod_name)
+    g = mod.__dict__
+    g.update(builder._base_env(preset, config))
+    # markdown references config vars bare (the reference's emitter rewrites
+    # them to config.X; materializing them as globals is the same binding
+    # for a fixed config)
+    for key in raw_config:
+        g[key] = getattr(config, key)
+    g["Bytes8"] = ByteVector[8]   # bellatrix PayloadId
+    g["SSZObject"] = TypeVar("SSZObject", bound=View)
+    g["fork"] = fork
+    g["preset_name"] = preset_name
+    sys.modules[mod_name] = mod
+
+    # upgrade_to_* functions annotate against ancestor modules by fork
+    # name (the reference's emitted modules import their predecessor the
+    # same way, setup.py:456-461)
+    ancestor = MD_FORK_PARENTS[fork]
+    while ancestor is not None:
+        g[ancestor] = (get_md_spec(ancestor, preset_name)
+                       if reference_root == REFERENCE_ROOT
+                       else build_spec_from_markdown(ancestor, preset_name,
+                                                     reference_root))
+        ancestor = MD_FORK_PARENTS[ancestor]
+
+    src = emit_fork_source(fork, preset, raw_config.keys(), reference_root)
+    code = compile(src, f"<markdown:{fork}>", "exec", dont_inherit=True)
+    exec(code, g)
+    g["fork"] = fork
+    mod.__md_source__ = src
+    return mod
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Compile reference markdown specs into Python modules")
+    p.add_argument("--fork", default="capella", choices=sorted(DOC_LISTS))
+    p.add_argument("--preset", default="minimal")
+    p.add_argument("--reference", default=str(REFERENCE_ROOT))
+    p.add_argument("-o", "--out", default=None,
+                   help="directory to write generated sources (default: stdout)")
+    args = p.parse_args(argv)
+
+    from consensus_specs_tpu.config import get_config, get_preset
+    preset = get_preset(args.preset)
+    config_keys = get_config(args.preset).to_dict().keys()
+
+    src = emit_fork_source(args.fork, preset, config_keys, Path(args.reference))
+    if args.out:
+        out = Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        path = out / f"{args.fork}_{args.preset}.py"
+        path.write_text(src)
+        print(f"wrote {path} ({len(src.splitlines())} lines)")
+    else:
+        print(src)
+
+
+if __name__ == "__main__":
+    main()
