@@ -214,13 +214,15 @@ def _dependency_order(containers):
     not-yet-emitted sibling is referenced (the reference's
     dependency_order_class_objects, setup.py:709-729)."""
     pending = list(containers)
+    all_names = {n for n, _ in pending}
+    deps_of = {name: (_names_used(src) & all_names) - {name}
+               for name, src in pending}
     emitted, out = set(), []
     while pending:
         progressed = False
         remaining = []
         for name, src in pending:
-            deps = _names_used(src) & {n for n, _ in pending} - {name}
-            if deps - emitted:
+            if deps_of[name] - emitted:
                 remaining.append((name, src))
             else:
                 out.append(src)
@@ -400,14 +402,18 @@ def emit_fork_source(fork: str, preset: Dict[str, int],
         fork_spec_object(fork, preset, config_keys, reference_root))
 
 
-_md_cache: Dict[Tuple[str, str], ModuleType] = {}
+_md_cache: Dict[Tuple[str, str, Path], ModuleType] = {}
 
 
-def get_md_spec(fork: str, preset_name: str = "minimal") -> ModuleType:
-    """Cached markdown-compiled spec (test-suite entry point)."""
-    key = (fork, preset_name)
+def get_md_spec(fork: str, preset_name: str = "minimal",
+                reference_root: Path = REFERENCE_ROOT) -> ModuleType:
+    """Cached markdown-compiled spec (test-suite entry point).  Keyed on
+    the reference root too, so ancestor modules are built exactly once
+    per checkout and shared down the fork chain."""
+    key = (fork, preset_name, reference_root)
     if key not in _md_cache:
-        _md_cache[key] = build_spec_from_markdown(fork, preset_name)
+        _md_cache[key] = build_spec_from_markdown(fork, preset_name,
+                                                  reference_root)
     return _md_cache[key]
 
 
@@ -428,6 +434,8 @@ def build_spec_from_markdown(fork: str, preset_name: str = "minimal",
     config = builder._typed_config(raw_config)
 
     mod_name = f"consensus_specs_tpu.specs.md.{fork}_{preset_name}"
+    if reference_root != REFERENCE_ROOT:  # avoid sys.modules collisions
+        mod_name += f"_{abs(hash(str(reference_root))) % 10**6}"
     mod = ModuleType(mod_name)
     g = mod.__dict__
     g.update(builder._base_env(preset, config))
@@ -447,10 +455,7 @@ def build_spec_from_markdown(fork: str, preset_name: str = "minimal",
     # same way, setup.py:456-461)
     ancestor = MD_FORK_PARENTS[fork]
     while ancestor is not None:
-        g[ancestor] = (get_md_spec(ancestor, preset_name)
-                       if reference_root == REFERENCE_ROOT
-                       else build_spec_from_markdown(ancestor, preset_name,
-                                                     reference_root))
+        g[ancestor] = get_md_spec(ancestor, preset_name, reference_root)
         ancestor = MD_FORK_PARENTS[ancestor]
 
     src = emit_fork_source(fork, preset, raw_config.keys(), reference_root)

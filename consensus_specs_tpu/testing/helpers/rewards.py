@@ -98,6 +98,73 @@ def run_deltas(spec, state):
         )
 
 
+def run_flag_deltas(spec, state):
+    """Altair+ flag-based rewards: yield per-flag component deltas plus
+    inactivity-penalty deltas, check each against the participating sets
+    the state actually contains, then pin the installed vectorized
+    ``process_rewards_and_penalties`` kernel to the sequential
+    apply-each-component result (including balance flooring order)."""
+    yield "pre", state
+
+    prev = spec.get_previous_epoch(state)
+    eligible = {int(i) for i in spec.get_eligible_validator_indices(state)}
+    in_leak = spec.is_in_inactivity_leak(state)
+    base_rewards = [
+        int(spec.get_base_reward(state, spec.ValidatorIndex(index)))
+        if index in eligible else 0
+        for index in range(len(state.validators))
+    ]
+    names = ["source", "target", "head"]
+    components = []
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        rewards, penalties = spec.get_flag_index_deltas(state, flag_index)
+        deltas = Deltas(rewards=rewards, penalties=penalties)
+        components.append(deltas)
+        yield f"{names[flag_index]}_deltas", deltas
+
+        unslashed = {int(i) for i in spec.get_unslashed_participating_indices(
+            state, flag_index, prev)}
+        weight = int(spec.PARTICIPATION_FLAG_WEIGHTS[flag_index])
+        for index in range(len(state.validators)):
+            base = base_rewards[index]
+            if index not in eligible:
+                assert int(deltas.rewards[index]) == 0
+                assert int(deltas.penalties[index]) == 0
+            elif index in unslashed:
+                assert int(deltas.penalties[index]) == 0
+                if in_leak:
+                    assert int(deltas.rewards[index]) == 0
+            else:
+                assert int(deltas.rewards[index]) == 0
+                if flag_index == int(spec.TIMELY_HEAD_FLAG_INDEX):
+                    assert int(deltas.penalties[index]) == 0
+                else:
+                    expected = base * weight // int(spec.WEIGHT_DENOMINATOR)
+                    assert int(deltas.penalties[index]) == expected
+
+    rewards, penalties = spec.get_inactivity_penalty_deltas(state)
+    inactivity = Deltas(rewards=rewards, penalties=penalties)
+    components.append(inactivity)
+    yield "inactivity_penalty_deltas", inactivity
+    target_participants = {int(i) for i in spec.get_unslashed_participating_indices(
+        state, int(spec.TIMELY_TARGET_FLAG_INDEX), prev)}
+    for index in range(len(state.validators)):
+        assert int(inactivity.rewards[index]) == 0
+        if index in target_participants or index not in eligible:
+            assert int(inactivity.penalties[index]) == 0
+
+    # the installed kernel must equal applying every component in spec
+    # order (increase, then floored decrease, per component)
+    kernel_state = state.copy()
+    spec.process_rewards_and_penalties(kernel_state)
+    for index in range(len(state.validators)):
+        bal = int(state.balances[index])
+        for d in components:
+            bal += int(d.rewards[index])
+            bal = max(bal - int(d.penalties[index]), 0)
+        assert int(kernel_state.balances[index]) == bal, index
+
+
 def leaking(epochs_extra: int = 0):
     """Advance a state into the inactivity leak before running deltas."""
     def deco(fn):

@@ -19,14 +19,13 @@ reference pyspec itself cannot run (its pip deps are absent), but its
 markdown — the layer the pyspec is generated from — executes here
 directly.
 """
-from pathlib import Path
 from random import Random
 
 import pytest
 
-REFERENCE = Path("/root/reference")
+from consensus_specs_tpu.specs.mdcompiler import REFERENCE_ROOT, get_md_spec
 
-if not REFERENCE.exists():  # pragma: no cover
+if not REFERENCE_ROOT.exists():  # pragma: no cover
     pytest.skip("reference checkout not available", allow_module_level=True)
 
 from consensus_specs_tpu.crypto import bls
@@ -36,7 +35,6 @@ from consensus_specs_tpu.debug.random_value import (
 )
 from consensus_specs_tpu.gen.runners.ssz_static import get_spec_ssz_types
 from consensus_specs_tpu.specs.builder import get_spec
-from consensus_specs_tpu.specs.mdcompiler import get_md_spec
 from consensus_specs_tpu.testing.helpers.attestations import (
     next_epoch_with_attestations,
 )

@@ -1,0 +1,74 @@
+"""process_participation_flag_updates + process_sync_committee_updates
+suites (spec: altair/beacon-chain.md:570-583; reference suites:
+test/altair/epoch_processing/test_process_participation_flag_updates.py,
+test_process_sync_committee_updates.py)."""
+from random import Random
+
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    transition_to,
+)
+
+ALTAIR_AND_LATER = ["altair", "bellatrix", "capella"]
+
+
+def _randomize_flags(spec, state, rng):
+    for index in range(len(state.validators)):
+        state.current_epoch_participation[index] = spec.ParticipationFlags(
+            rng.randrange(0, 8))
+        state.previous_epoch_participation[index] = spec.ParticipationFlags(
+            rng.randrange(0, 8))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_participation_flag_rotation(spec, state):
+    next_epoch(spec, state)
+    _randomize_flags(spec, state, Random(4040))
+    current = [int(x) for x in state.current_epoch_participation]
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates")
+    assert [int(x) for x in state.previous_epoch_participation] == current
+    assert all(int(x) == 0 for x in state.current_epoch_participation)
+    assert len(state.current_epoch_participation) == len(state.validators)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_sync_committee_rotation_at_period_boundary(spec, state):
+    # advance to the final epoch of a sync-committee period
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    transition_to(spec, state, (period_epochs - 1) * int(spec.SLOTS_PER_EPOCH))
+    next_ = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    # boundary crossed: current <- old next; next recomputed for the new
+    # period (state is unchanged since the handler, so recomputing now
+    # must reproduce exactly what it stored)
+    assert bytes(state.current_sync_committee.hash_tree_root()) == \
+        bytes(next_.hash_tree_root())
+    assert bytes(state.next_sync_committee.hash_tree_root()) == \
+        bytes(spec.get_next_sync_committee(state).hash_tree_root())
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_sync_committee_no_rotation_mid_period(spec, state):
+    next_epoch(spec, state)
+    assert (int(spec.get_current_epoch(state)) + 1) % \
+        int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) != 0
+    current = state.current_sync_committee.copy()
+    next_ = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    assert bytes(state.current_sync_committee.hash_tree_root()) == \
+        bytes(current.hash_tree_root())
+    assert bytes(state.next_sync_committee.hash_tree_root()) == \
+        bytes(next_.hash_tree_root())

@@ -1,0 +1,108 @@
+"""process_inactivity_updates suite (spec: altair/beacon-chain.md
+process_inactivity_updates; reference suite:
+test/altair/epoch_processing/test_process_inactivity_updates.py)."""
+from random import Random
+
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testing.helpers.rewards import leaking
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    set_empty_participation,
+    set_full_participation,
+)
+
+ALTAIR_AND_LATER = ["altair", "bellatrix", "capella"]
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_genesis_epoch_no_op(spec, state):
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    pre = [int(x) for x in state.inactivity_scores]
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+    assert [int(x) for x in state.inactivity_scores] == pre
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_all_zero_scores_full_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    set_full_participation(spec, state)
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+    assert all(int(x) == 0 for x in state.inactivity_scores)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_leak_increments_nonparticipants(spec, state):
+    set_empty_participation(spec, state)
+    pre = [int(x) for x in state.inactivity_scores]
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    for index in [int(i) for i in spec.get_eligible_validator_indices(state)]:
+        assert int(state.inactivity_scores[index]) == pre[index] + bias
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_leak_participants_decrement_by_one(spec, state):
+    """During a leak, target-participating validators shed exactly 1
+    (the unconditional ``-= min(1, score)``); the recovery-rate decrement
+    is leak-gated and must NOT apply."""
+    set_full_participation(spec, state)
+    for index in range(len(state.validators)):
+        state.inactivity_scores[index] = 10
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+    for index in [int(i) for i in spec.get_eligible_validator_indices(state)]:
+        assert int(state.inactivity_scores[index]) == 9
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_recovery_decrements_when_not_leaking(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    assert not spec.is_in_inactivity_leak(state)
+    set_full_participation(spec, state)
+    rng = Random(3030)
+    pre = []
+    for index in range(len(state.validators)):
+        score = rng.randrange(0, 30)
+        state.inactivity_scores[index] = score
+        pre.append(score)
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+    rate = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    for index in [int(i) for i in spec.get_eligible_validator_indices(state)]:
+        # participant: -= min(1, s), then leak-free recovery -= min(rate, s)
+        s = pre[index]
+        s -= min(1, s)
+        s -= min(rate, s)
+        assert int(state.inactivity_scores[index]) == s
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_nonparticipant_bias_then_floor(spec, state):
+    """Not leaking: non-participants gain bias then recover by the rate
+    in the same pass (net effect per the spec's two-step update)."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    assert not spec.is_in_inactivity_leak(state)
+    set_empty_participation(spec, state)
+    for index in range(len(state.validators)):
+        state.inactivity_scores[index] = 7
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    rate = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    expected = max(7 + bias - rate, 0)
+    for index in [int(i) for i in spec.get_eligible_validator_indices(state)]:
+        assert int(state.inactivity_scores[index]) == expected

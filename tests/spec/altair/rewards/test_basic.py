@@ -1,0 +1,123 @@
+"""Altair+ rewards suite — flag-based deltas across participation
+patterns (reference suite: test/altair/rewards/test_basic.py).  Every
+case also pins the installed vectorized flag-rewards kernel to the
+sequential spec components via ``run_flag_deltas``."""
+from random import Random
+
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.rewards import leaking, run_flag_deltas
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    set_empty_participation,
+    set_full_participation,
+)
+
+ALTAIR_AND_LATER = ["altair", "bellatrix", "capella"]
+
+
+def _advance(spec, state, epochs=2):
+    for _ in range(epochs):
+        next_epoch(spec, state)
+
+
+def _set_partial_participation(spec, state, rng, fraction=0.5):
+    """Randomly give ``fraction`` of validators full previous-epoch flags
+    and clear everyone else."""
+    full = spec.ParticipationFlags(0)
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        full = spec.add_flag(full, flag_index)
+    for index in range(len(state.validators)):
+        flags = full if rng.random() < fraction else spec.ParticipationFlags(0)
+        state.previous_epoch_participation[index] = flags
+        state.current_epoch_participation[index] = spec.ParticipationFlags(0)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_empty_participation(spec, state):
+    _advance(spec, state)
+    set_empty_participation(spec, state)
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_full_participation(spec, state):
+    _advance(spec, state)
+    set_full_participation(spec, state)
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_half_participation(spec, state):
+    _advance(spec, state)
+    _set_partial_participation(spec, state, Random(1010), 0.5)
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_one_participant(spec, state):
+    _advance(spec, state)
+    set_empty_participation(spec, state)
+    full = spec.ParticipationFlags(0)
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        full = spec.add_flag(full, flag_index)
+    state.previous_epoch_participation[0] = full
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_target_only_participation(spec, state):
+    _advance(spec, state)
+    set_empty_participation(spec, state)
+    for index in range(len(state.validators)):
+        state.previous_epoch_participation[index] = spec.add_flag(
+            spec.ParticipationFlags(0), int(spec.TIMELY_TARGET_FLAG_INDEX))
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_full_participation_with_slashed(spec, state):
+    _advance(spec, state)
+    set_full_participation(spec, state)
+    for index in (0, 3, 7):
+        state.validators[index].slashed = True
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_empty_participation_leak(spec, state):
+    set_empty_participation(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_full_participation_leak(spec, state):
+    set_full_participation(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_half_participation_leak_with_scores(spec, state):
+    """Leaking state with nonzero inactivity scores: the quadratic
+    inactivity penalty must hit exactly the non-target-participating."""
+    rng = Random(2020)
+    _set_partial_participation(spec, state, rng, 0.5)
+    for index in range(len(state.validators)):
+        state.inactivity_scores[index] = rng.randrange(0, 50)
+    yield from run_flag_deltas(spec, state)

@@ -1,0 +1,99 @@
+"""Altair+ sanity block scenarios (reference suite:
+test/altair/sanity/test_blocks.py): full blocks with sync aggregates,
+attestations setting participation flags, and epoch rollover."""
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+    next_epoch_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    next_slots,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testing.helpers.sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+
+ALTAIR_AND_LATER = ["altair", "bellatrix", "capella"]
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_block_with_full_sync_aggregate(spec, state):
+    next_epoch(spec, state)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    committee_indices = compute_committee_indices(spec, state)
+    assert len(committee_indices) == int(spec.SYNC_COMMITTEE_SIZE)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices),
+    )
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@always_bls
+def test_block_with_partial_sync_aggregate_bls(spec, state):
+    next_epoch(spec, state)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    committee_indices = compute_committee_indices(spec, state)
+    bits = [i % 2 == 0 for i in range(len(committee_indices))]
+    participants = [
+        v for i, v in enumerate(committee_indices) if bits[i]]
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participants),
+    )
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_attestation_sets_participation_flags(spec, state):
+    next_epoch(spec, state)
+    next_slots(spec, state, 1)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations.append(attestation)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+    flagged = [
+        i for i in range(len(state.validators))
+        if int(state.current_epoch_participation[i]) != 0
+        or int(state.previous_epoch_participation[i]) != 0
+    ]
+    assert len(flagged) > 0
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_two_epochs_full_attestations(spec, state):
+    next_epoch(spec, state)
+    yield "pre", state
+    _, blocks, state = next_epoch_with_attestations(spec, state, True, False)
+    _, blocks2, state = next_epoch_with_attestations(spec, state, True, True)
+    yield "blocks", blocks + blocks2
+    yield "post", state
+    # full participation must have justified the chain
+    assert int(state.current_justified_checkpoint.epoch) > 0

@@ -1,0 +1,85 @@
+"""Altair unittests: incentivization-weight and helper invariants
+(reference suite: test/altair/unittests/test_config_invariants.py,
+test_helpers.py)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+
+ALTAIR_AND_LATER = ["altair", "bellatrix", "capella"]
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_weight_denominator(spec, state):
+    yield "meta", {"bls_setting": 2}
+    assert (
+        int(spec.TIMELY_HEAD_WEIGHT)
+        + int(spec.TIMELY_SOURCE_WEIGHT)
+        + int(spec.TIMELY_TARGET_WEIGHT)
+        + int(spec.SYNC_REWARD_WEIGHT)
+        + int(spec.PROPOSER_WEIGHT)
+    ) == int(spec.WEIGHT_DENOMINATOR)
+    assert [int(w) for w in spec.PARTICIPATION_FLAG_WEIGHTS] == [
+        int(spec.TIMELY_SOURCE_WEIGHT),
+        int(spec.TIMELY_TARGET_WEIGHT),
+        int(spec.TIMELY_HEAD_WEIGHT),
+    ]
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_flag_indices_distinct(spec, state):
+    yield "meta", {"bls_setting": 2}
+    indices = [
+        int(spec.TIMELY_SOURCE_FLAG_INDEX),
+        int(spec.TIMELY_TARGET_FLAG_INDEX),
+        int(spec.TIMELY_HEAD_FLAG_INDEX),
+    ]
+    assert sorted(indices) == [0, 1, 2]
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_add_has_flag_roundtrip(spec, state):
+    yield "meta", {"bls_setting": 2}
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        flags = spec.add_flag(spec.ParticipationFlags(0), flag_index)
+        for other in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+            assert spec.has_flag(flags, other) == (other == flag_index)
+    # all flags set
+    flags = spec.ParticipationFlags(0)
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        flags = spec.add_flag(flags, flag_index)
+    assert all(
+        spec.has_flag(flags, i)
+        for i in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_next_sync_committee_structure(spec, state):
+    yield "meta", {"bls_setting": 2}
+    committee = spec.get_next_sync_committee(state)
+    assert len(committee.pubkeys) == int(spec.SYNC_COMMITTEE_SIZE)
+    # aggregate pubkey matches eth_aggregate_pubkeys over the members
+    # (pinned separately under BLS-on tests; structural check here)
+    indices = spec.get_next_sync_committee_indices(state)
+    assert len(indices) == int(spec.SYNC_COMMITTEE_SIZE)
+    active = set(int(i) for i in spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state)))
+    assert all(int(i) in active for i in indices)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_sync_subcommittee_pubkeys_partition(spec, state):
+    yield "meta", {"bls_setting": 2}
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    count = int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    seen = []
+    for subcommittee_index in range(count):
+        pubkeys = spec.get_sync_subcommittee_pubkeys(state, subcommittee_index)
+        assert len(pubkeys) == size // count
+        seen.extend(bytes(pk) for pk in pubkeys)
+    assert seen == [bytes(pk) for pk in state.current_sync_committee.pubkeys]

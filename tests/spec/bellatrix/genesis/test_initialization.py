@@ -1,0 +1,57 @@
+"""Bellatrix genesis suite (reference suite:
+test/bellatrix/genesis/test_initialization.py): the testing-variant
+``initialize_beacon_state_from_eth1`` seeds an execution payload header
+(reference: setup.py BellatrixSpecBuilder sundry preparations)."""
+from consensus_specs_tpu.testing.context import (
+    single_phase,
+    spec_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.deposits import (
+    prepare_full_genesis_deposits,
+)
+from consensus_specs_tpu.testing.helpers.genesis import (
+    get_sample_genesis_execution_payload_header,
+)
+
+GENESIS_TIME = 1578009600
+
+
+@with_phases(["bellatrix"])
+@spec_test
+@single_phase
+def test_initialize_pre_transition_empty_payload(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, deposit_root, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True,
+    )
+    eth1_block_hash = b"\x12" * 32
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, GENESIS_TIME, deposits
+    )
+    assert len(state.validators) == deposit_count
+    # default (empty) payload header: the merge is NOT complete
+    assert not spec.is_merge_transition_complete(state)
+    yield "eth1_block_hash", eth1_block_hash
+    yield "state", state
+
+
+@with_phases(["bellatrix"])
+@spec_test
+@single_phase
+def test_initialize_post_transition_with_payload_header(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True,
+    )
+    eth1_block_hash = b"\x12" * 32
+    header = get_sample_genesis_execution_payload_header(spec, eth1_block_hash)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, GENESIS_TIME, deposits,
+        execution_payload_header=header,
+    )
+    # seeded payload header: genesis is post-merge
+    assert spec.is_merge_transition_complete(state)
+    assert bytes(state.latest_execution_payload_header.hash_tree_root()) == \
+        bytes(header.hash_tree_root())
+    yield "state", state

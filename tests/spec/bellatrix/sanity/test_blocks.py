@@ -1,0 +1,84 @@
+"""Bellatrix sanity block scenarios (reference suite:
+test/bellatrix/sanity/test_blocks.py): blocks with execution payloads
+pre- and post-merge, and the merge-transition predicate surface."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testing.helpers.execution_payload import (
+    build_empty_execution_payload,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+
+BELLATRIX_AND_LATER = ["bellatrix", "capella"]
+
+
+def _payload_for_block(spec, state, block):
+    """Payload built against a copy advanced to the block's slot (the
+    builder assumes a same-slot pre-state)."""
+    advanced = state.copy()
+    spec.process_slots(advanced, block.slot)
+    return build_empty_execution_payload(spec, advanced)
+
+
+@with_phases(BELLATRIX_AND_LATER)
+@spec_state_test
+def test_empty_block_transition_post_merge(spec, state):
+    # mock genesis seeds a payload header: merge already complete
+    assert spec.is_merge_transition_complete(state)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+    assert bytes(state.latest_block_header.body_root) == \
+        bytes(block.body.hash_tree_root())
+
+
+@with_phases(BELLATRIX_AND_LATER)
+@spec_state_test
+def test_block_with_execution_payload(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    payload = _payload_for_block(spec, state, block)
+    block.body.execution_payload = payload
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+    assert bytes(state.latest_execution_payload_header.block_hash) == \
+        bytes(payload.block_hash)
+
+
+@with_phases(BELLATRIX_AND_LATER)
+@spec_state_test
+def test_payloads_across_epoch_boundary(spec, state):
+    yield "pre", state
+    blocks = []
+    next_epoch(spec, state)
+    for _ in range(3):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.execution_payload = _payload_for_block(spec, state, block)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    yield "blocks", blocks
+    yield "post", state
+    assert int(state.slot) > int(spec.SLOTS_PER_EPOCH)
+
+
+@with_phases(BELLATRIX_AND_LATER)
+@spec_state_test
+def test_invalid_payload_timestamp(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    payload = _payload_for_block(spec, state, block)
+    payload.timestamp = int(payload.timestamp) + 1
+    block.body.execution_payload = payload
+    signed = state_transition_and_sign_block(
+        spec, state, block, expect_fail=True)
+    yield "blocks", [signed]
+    yield "post", None

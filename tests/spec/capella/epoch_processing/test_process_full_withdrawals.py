@@ -1,0 +1,110 @@
+"""process_full_withdrawals suite (spec: capella/beacon-chain.md:311;
+reference suite: test/capella/epoch_processing/test_process_full_withdrawals.py).
+This snapshot is the early-capella draft: fully withdrawable validators'
+balances move to the in-state withdrawals queue."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.epoch_processing import (
+    run_epoch_processing_to,
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+def _make_fully_withdrawable(spec, state, index, epoch=None):
+    if epoch is None:
+        epoch = spec.get_current_epoch(state)
+    validator = state.validators[index]
+    validator.withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+        + bytes(validator.withdrawal_credentials)[1:]
+    )
+    validator.withdrawable_epoch = epoch
+    assert spec.is_fully_withdrawable_validator(
+        validator, spec.get_current_epoch(state))
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_no_withdrawable_validators(spec, state):
+    next_epoch(spec, state)
+    pre_queue_len = len(state.withdrawals_queue)
+    yield from run_epoch_processing_with(spec, state, "process_full_withdrawals")
+    assert len(state.withdrawals_queue) == pre_queue_len
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_single_full_withdrawal(spec, state):
+    next_epoch(spec, state)
+    _make_fully_withdrawable(spec, state, 0)
+    # advance through the prior sub-transitions, then capture the balance
+    # the sweep will actually withdraw
+    run_epoch_processing_to(spec, state, "process_full_withdrawals")
+    pre_balance = int(state.balances[0])
+    assert pre_balance > 0
+    pre_queue_len = len(state.withdrawals_queue)
+    yield "pre", state
+    spec.process_full_withdrawals(state)
+    yield "post", state
+    assert int(state.balances[0]) == 0
+    assert len(state.withdrawals_queue) == pre_queue_len + 1
+    withdrawal = state.withdrawals_queue[-1]
+    assert int(withdrawal.amount) == pre_balance
+    # withdrawal address = eth1 credential tail of validator 0
+    assert bytes(withdrawal.address) == \
+        bytes(state.validators[0].withdrawal_credentials)[12:]
+    # marked withdrawn at this epoch: not withdrawable again next pass
+    assert int(state.validators[0].fully_withdrawn_epoch) == \
+        int(spec.get_current_epoch(state))
+    assert not spec.is_fully_withdrawable_validator(
+        state.validators[0], spec.get_current_epoch(state))
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_multiple_full_withdrawals_ordered(spec, state):
+    next_epoch(spec, state)
+    targets = [2, 5, 9]
+    for index in targets:
+        _make_fully_withdrawable(spec, state, index)
+    run_epoch_processing_to(spec, state, "process_full_withdrawals")
+    balances = {index: int(state.balances[index]) for index in targets}
+    start_index = int(state.next_withdrawal_index) \
+        if hasattr(state, "next_withdrawal_index") else None
+    pre_queue_len = len(state.withdrawals_queue)
+    yield "pre", state
+    spec.process_full_withdrawals(state)
+    yield "post", state
+    queued = list(state.withdrawals_queue)[pre_queue_len:]
+    # swept in validator-index order, amounts as-of the sweep
+    assert [bytes(w.address) for w in queued] == [
+        bytes(state.validators[i].withdrawal_credentials)[12:] for i in targets]
+    assert [int(w.amount) for w in queued] == [balances[i] for i in targets]
+    if start_index is not None:
+        assert [int(w.index) for w in queued] == \
+            [start_index + i for i in range(len(targets))]
+    for index in targets:
+        assert int(state.balances[index]) == 0
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_bls_credentials_not_withdrawable(spec, state):
+    """Validators still on BLS withdrawal credentials must not be swept
+    even when their withdrawable epoch has passed."""
+    next_epoch(spec, state)
+    validator = state.validators[1]
+    assert bytes(validator.withdrawal_credentials)[:1] == \
+        bytes(spec.BLS_WITHDRAWAL_PREFIX)
+    validator.withdrawable_epoch = spec.get_current_epoch(state)
+    run_epoch_processing_to(spec, state, "process_full_withdrawals")
+    pre_balance = int(state.balances[1])
+    pre_queue_len = len(state.withdrawals_queue)
+    yield "pre", state
+    spec.process_full_withdrawals(state)
+    yield "post", state
+    assert int(state.balances[1]) == pre_balance
+    assert len(state.withdrawals_queue) == pre_queue_len

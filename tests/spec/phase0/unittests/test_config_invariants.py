@@ -1,0 +1,100 @@
+"""Config/preset invariants (reference suite:
+test/phase0/unittests/test_config_invariants.py): the cross-constant
+relations every fork×preset build must satisfy."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+)
+
+
+def _check_unique(values):
+    as_bytes = [bytes(v) for v in values]
+    assert len(set(as_bytes)) == len(as_bytes)
+
+
+@with_all_phases
+@spec_state_test
+def test_time(spec, state):
+    yield "meta", {"bls_setting": 2}
+    assert int(spec.SLOTS_PER_EPOCH) <= int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    assert int(spec.MIN_SEED_LOOKAHEAD) < int(spec.MAX_SEED_LOOKAHEAD)
+    assert int(spec.SLOTS_PER_HISTORICAL_ROOT) % int(spec.SLOTS_PER_EPOCH) == 0
+    assert int(spec.SLOTS_PER_HISTORICAL_ROOT) <= \
+        int(spec.HISTORICAL_ROOTS_LIMIT) * int(spec.SLOTS_PER_EPOCH)
+    assert int(spec.MIN_ATTESTATION_INCLUSION_DELAY) <= int(spec.SLOTS_PER_EPOCH)
+
+
+@with_all_phases
+@spec_state_test
+def test_balances(spec, state):
+    yield "meta", {"bls_setting": 2}
+    assert int(spec.MIN_DEPOSIT_AMOUNT) <= int(spec.MAX_EFFECTIVE_BALANCE)
+    assert int(spec.MAX_EFFECTIVE_BALANCE) % int(spec.EFFECTIVE_BALANCE_INCREMENT) == 0
+    assert int(spec.config.EJECTION_BALANCE) < int(spec.MAX_EFFECTIVE_BALANCE)
+    assert int(spec.HYSTERESIS_QUOTIENT) > 0
+    assert int(spec.HYSTERESIS_UPWARD_MULTIPLIER) > \
+        int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+
+
+@with_all_phases
+@spec_state_test
+def test_containers_and_committees(spec, state):
+    yield "meta", {"bls_setting": 2}
+    assert int(spec.TARGET_COMMITTEE_SIZE) <= int(spec.MAX_VALIDATORS_PER_COMMITTEE)
+    assert int(spec.MAX_COMMITTEES_PER_SLOT) >= 1
+    assert int(spec.SHUFFLE_ROUND_COUNT) > 0
+    # the justification bitvector must cover the FFG lookback
+    assert int(spec.JUSTIFICATION_BITS_LENGTH) == 4
+    # registry limit fits the effective-balance cache assumptions
+    assert int(spec.VALIDATOR_REGISTRY_LIMIT) >= \
+        int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+
+
+@with_all_phases
+@spec_state_test
+def test_domain_types_unique(spec, state):
+    yield "meta", {"bls_setting": 2}
+    domains = [
+        spec.DOMAIN_BEACON_PROPOSER,
+        spec.DOMAIN_BEACON_ATTESTER,
+        spec.DOMAIN_RANDAO,
+        spec.DOMAIN_DEPOSIT,
+        spec.DOMAIN_VOLUNTARY_EXIT,
+        spec.DOMAIN_SELECTION_PROOF,
+        spec.DOMAIN_AGGREGATE_AND_PROOF,
+    ]
+    if hasattr(spec, "DOMAIN_SYNC_COMMITTEE"):
+        domains += [
+            spec.DOMAIN_SYNC_COMMITTEE,
+            spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+            spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+        ]
+    _check_unique(domains)
+
+
+@with_all_phases
+@spec_state_test
+def test_fork_versions_unique(spec, state):
+    yield "meta", {"bls_setting": 2}
+    versions = [
+        spec.config.GENESIS_FORK_VERSION,
+        spec.config.ALTAIR_FORK_VERSION,
+        spec.config.BELLATRIX_FORK_VERSION,
+        spec.config.CAPELLA_FORK_VERSION,
+    ]
+    _check_unique(versions)
+
+
+@with_all_phases
+@spec_state_test
+def test_incentives_denominators(spec, state):
+    yield "meta", {"bls_setting": 2}
+    assert int(spec.WHISTLEBLOWER_REWARD_QUOTIENT) > 0
+    assert int(spec.MIN_SLASHING_PENALTY_QUOTIENT) > 0
+    assert int(spec.BASE_REWARD_FACTOR) > 0
+    if hasattr(spec, "INACTIVITY_PENALTY_QUOTIENT_ALTAIR"):
+        assert int(spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR) > 0
+    if hasattr(spec, "INACTIVITY_PENALTY_QUOTIENT_BELLATRIX"):
+        # the merge tightens the leak (full penalty, spec rationale)
+        assert int(spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX) <= \
+            int(spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR)
