@@ -113,6 +113,11 @@ def _next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length() if n > 1 else 1
 
 
+def _next_pow4(n: int) -> int:
+    p = _next_pow2(n)
+    return p if (p.bit_length() - 1) % 2 == 0 else p * 2
+
+
 def hash_blocks_u32(words: np.ndarray) -> np.ndarray:
     """Hash [N,16] big-endian uint32 words to [N,8] digests (numpy in/out)."""
     n = words.shape[0]
@@ -138,3 +143,120 @@ def hash_layer_via(hash_words, blocks: List[bytes]) -> List[bytes]:
 def hash_layer(blocks: List[bytes]) -> List[bytes]:
     """Backend for ssz.hashing: list of 64-byte inputs -> 32-byte digests."""
     return hash_layer_via(hash_blocks_u32, blocks)
+
+
+# -- whole-wave-schedule hashing (single device program) --------------------
+#
+# Per-layer dispatch pays one host<->device round trip per tree level —
+# ruinous when the link is a tunnel and latency/bandwidth dominate.  The
+# TPU-native shape for a full merkle (sub)tree is ONE program: upload the
+# known child digests once, run every wave as a gather + compress stage
+# inside a single jit (the level loop is unrolled at trace time — wave
+# sizes are static), download every produced digest once.
+
+
+def _run_waves(known, lefts, rights):
+    """known: [K,8] u32 digest pool seed.  lefts/rights: per-wave int32
+    index arrays into the pool (known rows, then each prior wave's rows).
+    One preallocated pool buffer; each wave writes its digests in place
+    (XLA turns the dynamic_update_slice chain into in-place updates).
+    Returns all wave outputs concatenated [sum(n_k), 8]."""
+    total = known.shape[0] + sum(left.shape[0] for left in lefts)
+    pool = jnp.zeros((total, 8), dtype=jnp.uint32)
+    pool = jax.lax.dynamic_update_slice(pool, known, (0, 0))
+    offset = known.shape[0]
+    outs = []
+    for left, right in zip(lefts, rights):
+        blocks = jnp.concatenate([pool[left], pool[right]], axis=1)  # [n,16]
+        digest = sha256_block64(blocks)
+        outs.append(digest)
+        pool = jax.lax.dynamic_update_slice(pool, digest, (offset, 0))
+        offset += left.shape[0]
+    return jnp.concatenate(outs, axis=0)
+
+
+_jit_run_waves = jax.jit(_run_waves)
+
+
+def hash_waves_u32(known: np.ndarray, waves) -> np.ndarray:
+    """Run a whole wave schedule on device in one dispatch.
+
+    ``known``: [K,8] big-endian-word digests (the already-rooted children).
+    ``waves``: list of (left_idx, right_idx) int32 numpy arrays indexing
+    the pool, where pool rows are ``known`` rows followed by every prior
+    wave's outputs in schedule order.  Returns all outputs concatenated.
+
+    jax.jit caches one executable per (K, wave-size...) signature; the
+    byte-level wrapper pads both to powers of two so differently-sized
+    dirty subtrees bucket into a bounded set of compiled shapes.
+    """
+    lefts = tuple(jnp.asarray(w[0]) for w in waves)
+    rights = tuple(jnp.asarray(w[1]) for w in waves)
+    out = _jit_run_waves(jnp.asarray(known), lefts, rights)
+    return np.asarray(out)
+
+
+def hash_waves(known: List[bytes], waves) -> List[bytes]:
+    """Byte-level wrapper: ``known`` is 32-byte digests; ``waves`` is
+    (left_idx, right_idx) pairs indexing [known | outputs-so-far].
+    Returns the concatenated 32-byte outputs of every wave.
+
+    The known pool and the first wave are padded to powers of FOUR, later
+    waves follow a monotone halving envelope, and the wave count is padded
+    to a multiple of four with dummy single-lane waves (padding lanes hash
+    row 0 and are discarded) — so the jit signature, and therefore the
+    compile count, is a small bounded set per tree magnitude rather than
+    one executable per exact dirty pattern."""
+    k = len(known)
+    k_pad = _next_pow4(max(k, 1))
+    words = np.zeros((k_pad, 8), dtype=np.uint32)
+    if k:
+        words[:k] = np.frombuffer(b"".join(known), dtype=">u4").reshape(k, 8)
+
+    sizes = [len(w[0]) for w in waves]
+    # Monotone halving envelope: wave k is padded to
+    # max(pow2(size_k), previous_pad // 2).  Merkle wave schedules are
+    # (near-)halving ladders, so the whole padded-size tuple — and hence
+    # the jit signature — is determined by (first-wave pow2, wave count):
+    # arbitrary dirty patterns of similar magnitude share one executable
+    # instead of recompiling per exact shape.
+    padded = []
+    for s in sizes:
+        if padded:
+            p = max(_next_pow2(max(s, 1)), padded[-1] // 2)
+        else:
+            p = _next_pow4(max(s, 1))
+        padded.append(p)
+    # padded pool row of each unpadded output position: known padding sits
+    # at rows k..k_pad-1, wave k's rows start where wave k-1's padded rows end
+    trans = np.empty(max(sum(sizes), 1), dtype=np.int64)
+    base, up = k_pad, 0
+    for size, psize in zip(sizes, padded):
+        trans[up:up + size] = base + np.arange(size)
+        up += size
+        base += psize
+
+    padded_waves = []
+    for (left, right), size, psize in zip(waves, sizes, padded):
+        lp = np.zeros(psize, dtype=np.int32)
+        rp = np.zeros(psize, dtype=np.int32)
+        for src, dst in ((left, lp), (right, rp)):
+            src = np.asarray(src, dtype=np.int64)
+            dst[:size] = np.where(src < k, src, trans[np.maximum(src - k, 0)])
+        padded_waves.append((lp, rp))
+    # dummy single-lane waves pad the count to a multiple of 4 (their
+    # outputs land after every real wave's rows and are never extracted)
+    dummy = (np.zeros(1, dtype=np.int32), np.zeros(1, dtype=np.int32))
+    while len(padded_waves) % 4:
+        padded_waves.append(dummy)
+        padded.append(1)
+
+    out = hash_waves_u32(words, padded_waves)
+    flat = out.astype(">u4").tobytes()
+    result = []
+    base = 0
+    for size, psize in zip(sizes, padded):
+        result.extend(flat[(base + i) * 32:(base + i + 1) * 32]
+                      for i in range(size))
+        base += psize
+    return result

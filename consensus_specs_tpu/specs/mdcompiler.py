@@ -435,7 +435,7 @@ def build_spec_from_markdown(fork: str, preset_name: str = "minimal",
 
     mod_name = f"consensus_specs_tpu.specs.md.{fork}_{preset_name}"
     if reference_root != REFERENCE_ROOT:  # avoid sys.modules collisions
-        mod_name += f"_{abs(hash(str(reference_root))) % 10**6}"
+        mod_name += "_" + re.sub(r"\W+", "_", str(reference_root)).strip("_")
     mod = ModuleType(mod_name)
     g = mod.__dict__
     g.update(builder._base_env(preset, config))

@@ -88,20 +88,74 @@ def merkle_root(node: Node) -> bytes:
             stack.append(n.left)  # type: ignore[union-attr]
         if n.right._root is None:  # type: ignore[union-attr]
             stack.append(n.right)  # type: ignore[union-attr]
-    # Ready-wave hashing: a node is ready once both children have roots.
-    while pending:
+    # Topological ready-waves: a node is ready once both children have
+    # roots or are scheduled in an earlier wave.
+    waves: List[List[BranchNode]] = []
+    scheduled = set()
+    rest = pending
+    while rest:
         ready: List[BranchNode] = []
         later: List[BranchNode] = []
-        for n in pending:
-            if n.left._root is not None and n.right._root is not None:
+        for n in rest:
+            if ((n.left._root is not None or id(n.left) in scheduled)
+                    and (n.right._root is not None or id(n.right) in scheduled)):
                 ready.append(n)
             else:
                 later.append(n)
-        digests = hash_layer([n.left._root + n.right._root for n in ready])
-        for n, d in zip(ready, digests):
-            n._root = d
-        pending = later
+        for n in ready:
+            scheduled.add(id(n))
+        waves.append(ready)
+        rest = later
+
+    from .hashing import MIN_DEVICE_TREE, get_wave_hasher
+
+    wave_hasher = get_wave_hasher() if len(seen) >= MIN_DEVICE_TREE else None
+    if wave_hasher is not None:
+        _hash_waves_on_device(waves, wave_hasher)
+    else:
+        for wave in waves:
+            digests = hash_layer([n.left._root + n.right._root for n in wave])
+            for n, d in zip(wave, digests):
+                n._root = d
     return node._root  # type: ignore[return-value]
+
+
+def _hash_waves_on_device(waves: "List[List[BranchNode]]", wave_hasher) -> None:
+    """Run the whole wave schedule as one device program: upload the
+    deduped known child digests once, gather+compress every level inside
+    a single dispatch, download all produced digests once (the per-level
+    round trip is what dominates layered hashing over slow links)."""
+    import numpy as np
+
+    known: List[bytes] = []
+    known_index = {}
+    for wave in waves:
+        for n in wave:
+            for c in (n.left, n.right):
+                if c._root is not None and id(c) not in known_index:
+                    known_index[id(c)] = len(known)
+                    known.append(c._root)
+    out_index = {}
+    pos = len(known)
+    for wave in waves:
+        for n in wave:
+            out_index[id(n)] = pos
+            pos += 1
+
+    def cidx(c):
+        return known_index[id(c)] if c._root is not None else out_index[id(c)]
+
+    index_waves = [
+        (np.array([cidx(n.left) for n in wave], dtype=np.int32),
+         np.array([cidx(n.right) for n in wave], dtype=np.int32))
+        for wave in waves
+    ]
+    digests = wave_hasher(known, index_waves)
+    k = 0
+    for wave in waves:
+        for n in wave:
+            n._root = digests[k]
+            k += 1
 
 
 def get_subtree(node: Node, depth: int, index: int) -> Node:

@@ -30,3 +30,59 @@ def test_hashing_backend_swap_preserves_roots():
         hashing.set_backend("hashlib")
 
     assert root_jax == root_hashlib
+
+
+def test_hash_waves_matches_hashlib():
+    """The single-dispatch wave-schedule hasher must agree with hashlib
+    on an arbitrary DAG schedule (deduped known children, cross-wave
+    references)."""
+    import numpy as np
+
+    rng = random.Random(99)
+    known = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(11)]
+    # wave 0: pairs of known digests
+    w0 = (np.array([0, 2, 4, 10], dtype=np.int32),
+          np.array([1, 3, 5, 10], dtype=np.int32))
+    # wave 1: mixes known and wave-0 outputs (pool rows 11..14)
+    w1 = (np.array([11, 13, 6], dtype=np.int32),
+          np.array([12, 14, 7], dtype=np.int32))
+    # wave 2: consumes wave-1 outputs (pool rows 15..17)
+    w2 = (np.array([15], dtype=np.int32), np.array([16], dtype=np.int32))
+    got = sha256_jax.hash_waves(known, [w0, w1, w2])
+
+    pool = list(known)
+    expected = []
+    for left, right in (w0, w1, w2):
+        outs = [hashlib.sha256(pool[le] + pool[ri]).digest()
+                for le, ri in zip(left.tolist(), right.tolist())]
+        expected.extend(outs)
+        pool.extend(outs)
+    assert got == expected
+
+
+def test_wave_path_used_for_large_trees_same_roots():
+    """Above MIN_DEVICE_TREE the merkle_root path switches to the
+    one-dispatch wave hasher; roots must be byte-identical to hashlib."""
+    from consensus_specs_tpu.ssz import hashing
+    from consensus_specs_tpu.ssz.types import List, uint64
+
+    values = list(range(40_000))  # ~10k chunks > MIN_DEVICE_TREE nodes
+    big = List[uint64, 1 << 30](values)
+    root_hashlib = bytes(big.hash_tree_root())
+
+    hashing.set_backend("jax")
+    try:
+        assert hashing.get_wave_hasher() is not None
+        big2 = List[uint64, 1 << 30](values)
+        root_jax = bytes(big2.hash_tree_root())
+        # dirty-subtree incremental path through the wave hasher too
+        for i in range(0, 40_000, 101):
+            big2[i] = uint64(i + 7)
+            big[i] = uint64(i + 7)
+        dirty_jax = bytes(big2.hash_tree_root())
+    finally:
+        hashing.set_backend("hashlib")
+    dirty_hashlib = bytes(big.hash_tree_root())
+
+    assert root_jax == root_hashlib
+    assert dirty_jax == dirty_hashlib
