@@ -139,8 +139,11 @@ def KeyValidate(pubkey):
     return bls.KeyValidate(pubkey)
 
 
-@only_with_bls(alt_return=STUB_PUBKEY)
 def AggregatePKs(pubkeys):
+    # NOT bls_active-gated: aggregation is deterministic state content
+    # (sync-committee aggregate pubkeys live in the state), so it must
+    # compute even when signature *verification* is stubbed off — the
+    # reference's AggregatePKs is likewise ungated (utils/bls.py).
     return bls.AggregatePKs(pubkeys)
 
 

@@ -1,0 +1,363 @@
+"""Test-vector consumer: replay generated vectors against a spec build.
+
+The reference publishes vectors (consensus-spec-tests) that *client*
+test runners consume per the format contract (reference:
+tests/formats/*/README.md).  This module is that client-side half for
+this framework: it walks an output tree produced by ``gen_runner``
+(``<preset>/<fork>/<runner>/<handler>/<suite>/<case>``), decodes each
+case's parts (``meta.yaml``, ``*.yaml``, ``*.ssz_snappy``) and replays
+them through a freshly built spec module, asserting byte-identical
+results.  Running generate→consume end-to-end pins both directions of
+the format contract.
+
+Conventions handled (mirroring the reference formats):
+
+* ``post`` absent => the operation/blocks must fail (assert/exception);
+* ``meta.yaml: bls_setting`` 1/2 => BLS forced on/off around the replay;
+* list parts appear as ``<name>_<i>.ssz_snappy`` plus ``<name>_count``;
+* INCOMPLETE-tagged case dirs are skipped (consumer contract).
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import yaml as _yaml
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.specs.builder import get_spec
+
+from .snappy import decompress
+
+
+class VectorFailure(AssertionError):
+    pass
+
+
+def _load_meta(case_dir: Path) -> Dict[str, Any]:
+    meta = case_dir / "meta.yaml"
+    if not meta.exists():
+        return {}
+    return _yaml.safe_load(meta.read_text()) or {}
+
+
+def _load_ssz(case_dir: Path, name: str, typ):
+    path = case_dir / f"{name}.ssz_snappy"
+    if not path.exists():
+        return None
+    return typ.decode_bytes(decompress(path.read_bytes()))
+
+
+def _load_ssz_list(case_dir: Path, name: str, count: int, typ):
+    return [_load_ssz(case_dir, f"{name}_{i}", typ) for i in range(count)]
+
+
+def _expect_failure(fn):
+    try:
+        fn()
+    except (AssertionError, IndexError, ValueError, KeyError, OverflowError):
+        return
+    raise VectorFailure("invalid case executed without error")
+
+
+def _check_post(spec, state, case_dir: Path, context: str):
+    post = _load_ssz(case_dir, "post", spec.BeaconState)
+    if post is None:
+        raise VectorFailure(f"{context}: post part missing")
+    if bytes(state.hash_tree_root()) != bytes(post.hash_tree_root()):
+        raise VectorFailure(f"{context}: post state root mismatch")
+
+
+# operations/<handler> -> (input part name, input type attr, apply)
+OPERATION_HANDLERS = {
+    "attestation": ("attestation", "Attestation",
+                    lambda spec, s, op, m: spec.process_attestation(s, op)),
+    "attester_slashing": ("attester_slashing", "AttesterSlashing",
+                          lambda spec, s, op, m: spec.process_attester_slashing(s, op)),
+    "block_header": ("block", "BeaconBlock",
+                     lambda spec, s, op, m: spec.process_block_header(s, op)),
+    "deposit": ("deposit", "Deposit",
+                lambda spec, s, op, m: spec.process_deposit(s, op)),
+    "proposer_slashing": ("proposer_slashing", "ProposerSlashing",
+                          lambda spec, s, op, m: spec.process_proposer_slashing(s, op)),
+    "voluntary_exit": ("voluntary_exit", "SignedVoluntaryExit",
+                       lambda spec, s, op, m: spec.process_voluntary_exit(s, op)),
+    "sync_aggregate": ("sync_aggregate", "SyncAggregate",
+                       lambda spec, s, op, m: spec.process_sync_aggregate(s, op)),
+    "execution_payload": ("execution_payload", "ExecutionPayload",
+                          lambda spec, s, op, m: spec.process_execution_payload(
+                              s, op, spec.EXECUTION_ENGINE)),
+    "withdrawals": ("execution_payload", "ExecutionPayload",
+                    lambda spec, s, op, m: spec.process_withdrawals(s, op)),
+    "bls_to_execution_change": ("address_change", "SignedBLSToExecutionChange",
+                                lambda spec, s, op, m:
+                                spec.process_bls_to_execution_change(s, op)),
+}
+
+
+def run_operations_case(spec, handler: str, case_dir: Path, meta) -> None:
+    part, type_name, apply = OPERATION_HANDLERS[handler]
+    pre = _load_ssz(case_dir, "pre", spec.BeaconState)
+    op = _load_ssz(case_dir, part, getattr(spec, type_name))
+    if pre is None or op is None:
+        raise VectorFailure(f"operations/{handler}: missing parts")
+    execution = case_dir / "execution.yaml"
+    if execution.exists():
+        valid = _yaml.safe_load(execution.read_text()).get("execution_valid", True)
+        if not valid:  # engine rejects: stub a refusing engine
+            engine = spec.NoopExecutionEngine()
+            engine.notify_new_payload = lambda payload: False
+            apply = (lambda spec_, s, o, m,
+                     _e=engine: spec_.process_execution_payload(s, o, _e))
+    if (case_dir / "post.ssz_snappy").exists():
+        apply(spec, pre, op, meta)
+        _check_post(spec, pre, case_dir, f"operations/{handler}")
+    else:
+        _expect_failure(lambda: apply(spec, pre, op, meta))
+
+
+def run_blocks_case(spec, case_dir: Path, meta) -> None:
+    pre = _load_ssz(case_dir, "pre", spec.BeaconState)
+    count = int(meta.get("blocks_count", 0))
+    blocks = _load_ssz_list(case_dir, "blocks", count, spec.SignedBeaconBlock)
+
+    def apply_all():
+        for signed in blocks:
+            block = signed.message
+            # client semantics: advance slots only when behind the block
+            # (the spec helper transition_unsigned_block does the same;
+            # bare state_transition rejects same-slot blocks)
+            if int(pre.slot) < int(block.slot):
+                spec.process_slots(pre, block.slot)
+            assert spec.verify_block_signature(pre, signed)
+            spec.process_block(pre, block)
+            assert bytes(block.state_root) == bytes(pre.hash_tree_root())
+
+    if (case_dir / "post.ssz_snappy").exists():
+        apply_all()
+        _check_post(spec, pre, case_dir, "sanity/blocks")
+    else:
+        _expect_failure(apply_all)
+
+
+def run_slots_case(spec, case_dir: Path, meta) -> None:
+    pre = _load_ssz(case_dir, "pre", spec.BeaconState)
+    slots = int(meta["slots"])
+    spec.process_slots(pre, pre.slot + slots)
+    _check_post(spec, pre, case_dir, "sanity/slots")
+
+
+def run_epoch_processing_case(spec, handler: str, case_dir: Path, meta) -> None:
+    pre = _load_ssz(case_dir, "pre", spec.BeaconState)
+    # meta names the exact sub-transition (grouped handlers); otherwise
+    # the handler dir uses the reference naming (sub-transition sans prefix)
+    name = meta.get("sub_transition", f"process_{handler}")
+    sub = getattr(spec, name, None) or getattr(spec, handler)
+    if (case_dir / "post.ssz_snappy").exists():
+        sub(pre)
+        _check_post(spec, pre, case_dir, f"epoch_processing/{handler}")
+    else:
+        _expect_failure(lambda: sub(pre))
+
+
+def run_rewards_case(spec, case_dir: Path, meta) -> None:
+    from consensus_specs_tpu.testing.helpers.rewards import Deltas
+
+    pre = _load_ssz(case_dir, "pre", spec.BeaconState)
+    if hasattr(spec, "get_source_deltas"):  # phase0 component layout
+        components = {
+            "source_deltas": spec.get_source_deltas,
+            "target_deltas": spec.get_target_deltas,
+            "head_deltas": spec.get_head_deltas,
+            "inclusion_delay_deltas": spec.get_inclusion_delay_deltas,
+            "inactivity_penalty_deltas": spec.get_inactivity_penalty_deltas,
+        }
+    else:  # altair+ flag layout
+        components = {
+            "source_deltas": lambda s: spec.get_flag_index_deltas(
+                s, int(spec.TIMELY_SOURCE_FLAG_INDEX)),
+            "target_deltas": lambda s: spec.get_flag_index_deltas(
+                s, int(spec.TIMELY_TARGET_FLAG_INDEX)),
+            "head_deltas": lambda s: spec.get_flag_index_deltas(
+                s, int(spec.TIMELY_HEAD_FLAG_INDEX)),
+            "inactivity_penalty_deltas": spec.get_inactivity_penalty_deltas,
+        }
+    for name, fn in components.items():
+        expected = _load_ssz(case_dir, name, Deltas)
+        if expected is None:
+            continue
+        rewards, penalties = fn(pre)
+        got = Deltas(rewards=rewards, penalties=penalties)
+        if bytes(got.hash_tree_root()) != bytes(expected.hash_tree_root()):
+            raise VectorFailure(f"rewards component {name} mismatch")
+
+
+def run_shuffling_case(spec, case_dir: Path, meta) -> None:
+    data = _yaml.safe_load((case_dir / "mapping.yaml").read_text())
+    seed = bytes.fromhex(data["seed"][2:] if str(data["seed"]).startswith("0x")
+                         else data["seed"])
+    count = int(data["count"])
+    mapping = [int(x) for x in data["mapping"]]
+    got = [int(spec.compute_shuffled_index(i, count, seed)) for i in range(count)]
+    if got != mapping:
+        raise VectorFailure("shuffling mapping mismatch")
+
+
+def run_ssz_static_case(spec, handler: str, case_dir: Path, meta) -> None:
+    typ = getattr(spec, handler, None)
+    if typ is None:
+        raise VectorFailure(f"unknown container {handler}")
+    serialized = decompress((case_dir / "serialized.ssz_snappy").read_bytes())
+    roots = _yaml.safe_load((case_dir / "roots.yaml").read_text())
+    value = typ.decode_bytes(serialized)
+    if bytes(value.encode_bytes()) != serialized:
+        raise VectorFailure(f"ssz_static/{handler}: reserialization mismatch")
+    root = roots["root"]
+    root = bytes.fromhex(root[2:] if root.startswith("0x") else root)
+    if bytes(value.hash_tree_root()) != root:
+        raise VectorFailure(f"ssz_static/{handler}: root mismatch")
+
+
+def run_genesis_case(spec, handler: str, case_dir: Path, meta) -> None:
+    if handler == "validity":
+        genesis = _load_ssz(case_dir, "genesis", spec.BeaconState)
+        expected = bool(meta["is_valid"])
+        if bool(spec.is_valid_genesis_state(genesis)) != expected:
+            raise VectorFailure("genesis validity mismatch")
+        return
+    # initialization
+    eth1_block_hash = decompress(
+        (case_dir / "eth1_block_hash.ssz_snappy").read_bytes())
+    count = int(meta.get("deposits_count", 0))
+    deposits = _load_ssz_list(case_dir, "deposits", count, spec.Deposit)
+    state = _load_ssz(case_dir, "state", spec.BeaconState)
+    kwargs = {}
+    if hasattr(spec, "ExecutionPayloadHeader"):
+        header = _load_ssz(case_dir, "execution_payload_header",
+                           spec.ExecutionPayloadHeader)
+        if header is not None:
+            kwargs["execution_payload_header"] = header
+    got = spec.initialize_beacon_state_from_eth1(
+        spec.Hash32(eth1_block_hash), spec.uint64(int(state.genesis_time)
+                                                  - int(spec.config.GENESIS_DELAY)),
+        deposits, **kwargs)
+    if bytes(got.hash_tree_root()) != bytes(state.hash_tree_root()):
+        raise VectorFailure("genesis initialization mismatch")
+
+
+def run_fork_case(fork: str, case_dir: Path, meta, preset: str) -> None:
+    parents = {"altair": "phase0", "bellatrix": "altair", "capella": "bellatrix"}
+    pre_spec = get_spec(parents[fork], preset)
+    post_spec = get_spec(fork, preset)
+    pre = _load_ssz(case_dir, "pre", pre_spec.BeaconState)
+    post = _load_ssz(case_dir, "post", post_spec.BeaconState)
+    got = getattr(post_spec, f"upgrade_to_{fork}")(pre)
+    if bytes(got.hash_tree_root()) != bytes(post.hash_tree_root()):
+        raise VectorFailure(f"fork upgrade to {fork} mismatch")
+
+
+def run_case(preset: str, fork: str, runner: str, handler: str,
+             case_dir: Path) -> str:
+    """Replay one case directory.  Returns 'pass' or 'skip'."""
+    if (case_dir / "INCOMPLETE").exists():
+        return "skip"
+    meta = _load_meta(case_dir)
+    bls_setting = meta.get("bls_setting", 0)
+
+    config_part = case_dir / "config.yaml"
+    if config_part.exists():
+        # the case ran under overridden config values; rebuild the spec
+        # with the recorded effective config (format: ints, 0x-hex, str)
+        from consensus_specs_tpu.specs.builder import _typed_config, build_spec
+
+        raw = {}
+        for key, value in _yaml.safe_load(config_part.read_text()).items():
+            if isinstance(value, str) and value.startswith("0x"):
+                raw[key] = bytes.fromhex(value[2:])
+            else:
+                raw[key] = value
+        spec = build_spec(fork, preset, config=_typed_config(raw))
+    else:
+        spec = get_spec(fork, preset)
+    old_bls = bls.bls_active
+    bls.bls_active = (bls_setting == 1)
+    try:
+        if runner == "operations":
+            run_operations_case(spec, handler, case_dir, meta)
+        elif runner in ("sanity", "random", "finality"):
+            if handler == "slots":
+                run_slots_case(spec, case_dir, meta)
+            else:
+                run_blocks_case(spec, case_dir, meta)
+        elif runner == "epoch_processing":
+            run_epoch_processing_case(spec, handler, case_dir, meta)
+        elif runner == "rewards":
+            run_rewards_case(spec, case_dir, meta)
+        elif runner == "shuffling":
+            run_shuffling_case(spec, case_dir, meta)
+        elif runner == "ssz_static":
+            run_ssz_static_case(spec, handler, case_dir, meta)
+        elif runner == "genesis":
+            run_genesis_case(spec, handler, case_dir, meta)
+        elif runner in ("fork", "forks"):
+            run_fork_case(fork, case_dir, meta, preset)
+        else:
+            return "skip"
+    finally:
+        bls.bls_active = old_bls
+    return "pass"
+
+
+def consume_tree(root: Path, preset: Optional[str] = None,
+                 fork: Optional[str] = None,
+                 runners: Optional[set] = None) -> Dict[str, int]:
+    """Walk a generated vector tree, replaying every consumable case.
+    Raises VectorFailure on the first divergence; returns counts."""
+    stats = {"pass": 0, "skip": 0}
+    root = Path(root)
+    for preset_dir in sorted(p for p in root.iterdir() if p.is_dir()):
+        if preset and preset_dir.name != preset:
+            continue
+        for fork_dir in sorted(p for p in preset_dir.iterdir() if p.is_dir()):
+            if fork and fork_dir.name != fork:
+                continue
+            for runner_dir in sorted(p for p in fork_dir.iterdir() if p.is_dir()):
+                if runners and runner_dir.name not in runners:
+                    continue
+                for handler_dir in sorted(p for p in runner_dir.iterdir()
+                                          if p.is_dir()):
+                    for suite_dir in sorted(p for p in handler_dir.iterdir()
+                                            if p.is_dir()):
+                        for case_dir in sorted(p for p in suite_dir.iterdir()
+                                               if p.is_dir()):
+                            try:
+                                result = run_case(
+                                    preset_dir.name, fork_dir.name,
+                                    runner_dir.name, handler_dir.name, case_dir)
+                            except VectorFailure:
+                                raise
+                            except Exception as exc:
+                                raise VectorFailure(
+                                    f"{case_dir}: consumer error: {exc!r}"
+                                ) from exc
+                            stats[result] += 1
+    return stats
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Replay generated test vectors against the spec")
+    parser.add_argument("tree", help="vector output root")
+    parser.add_argument("--preset", default=None)
+    parser.add_argument("--fork", default=None)
+    parser.add_argument("--runner", action="append", default=None)
+    args = parser.parse_args(argv)
+    stats = consume_tree(Path(args.tree), args.preset, args.fork,
+                         set(args.runner) if args.runner else None)
+    print(f"consumed: {stats['pass']} passed, {stats['skip']} skipped")
+
+
+if __name__ == "__main__":
+    main()

@@ -2,7 +2,10 @@
 tests/generators/epoch_processing/main.py)."""
 from __future__ import annotations
 
-from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+from consensus_specs_tpu.gen.gen_from_tests import (
+    combine_mods,
+    run_state_test_generators,
+)
 
 
 def main(argv=None):
@@ -21,11 +24,27 @@ def main(argv=None):
     phase_0_mods["resets_and_rotations"] = (
         "tests.spec.phase0.epoch_processing.test_resets_and_rotations"
     )
+    _new_altair_mods = {
+        "inactivity_updates": (
+            "tests.spec.altair.epoch_processing.test_process_inactivity_updates"
+        ),
+        "participation_flag_updates": (
+            "tests.spec.altair.epoch_processing."
+            "test_participation_and_sync_committee_updates"
+        ),
+    }
+    altair_mods = combine_mods(_new_altair_mods, phase_0_mods)
+    _new_capella_mods = {
+        "full_withdrawals": (
+            "tests.spec.capella.epoch_processing.test_process_full_withdrawals"
+        ),
+    }
+    capella_mods = combine_mods(_new_capella_mods, altair_mods)
     all_mods = {
         "phase0": phase_0_mods,
-        "altair": phase_0_mods,
-        "bellatrix": phase_0_mods,
-        "capella": phase_0_mods,
+        "altair": altair_mods,
+        "bellatrix": altair_mods,
+        "capella": capella_mods,
     }
     run_state_test_generators(
         runner_name="epoch_processing", all_mods=all_mods, argv=argv

@@ -13,7 +13,10 @@ def main(argv=None):
         "initialization": "tests.spec.phase0.genesis.test_initialization",
         "validity": "tests.spec.phase0.genesis.test_validity",
     }
-    all_mods = {"phase0": mods}
+    bellatrix_mods = {
+        "initialization": "tests.spec.bellatrix.genesis.test_initialization",
+    }
+    all_mods = {"phase0": mods, "bellatrix": bellatrix_mods}
     # mainnet genesis = MIN_GENESIS_ACTIVE_VALIDATOR_COUNT (16384) deposit
     # signature verifications per case; the reference likewise excludes
     # mainnet generation from CI (tests/generators/README.md)

@@ -10,7 +10,13 @@ def main(argv=None):
 
     ensure_vector_sources_importable()
     mods = {"basic": "tests.spec.phase0.rewards.test_basic"}
-    all_mods = {"phase0": mods}
+    altair_mods = {"basic": "tests.spec.altair.rewards.test_basic"}
+    all_mods = {
+        "phase0": mods,
+        "altair": altair_mods,
+        "bellatrix": altair_mods,
+        "capella": altair_mods,
+    }
     run_state_test_generators(runner_name="rewards", all_mods=all_mods, argv=argv)
 
 

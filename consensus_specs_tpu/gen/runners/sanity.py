@@ -4,7 +4,10 @@ forks, generated from the pytest-mode test modules via reflection.
 """
 from __future__ import annotations
 
-from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+from consensus_specs_tpu.gen.gen_from_tests import (
+    combine_mods,
+    run_state_test_generators,
+)
 
 
 def main(argv=None):
@@ -15,11 +18,15 @@ def main(argv=None):
         "blocks": "tests.spec.phase0.sanity.test_blocks",
         "slots": "tests.spec.phase0.sanity.test_slots",
     }
+    altair_mods = combine_mods(
+        {"blocks": "tests.spec.altair.sanity.test_blocks"}, phase_0_mods)
+    bellatrix_mods = combine_mods(
+        {"blocks": "tests.spec.bellatrix.sanity.test_blocks"}, altair_mods)
     all_mods = {
         "phase0": phase_0_mods,
-        "altair": phase_0_mods,
-        "bellatrix": phase_0_mods,
-        "capella": phase_0_mods,
+        "altair": altair_mods,
+        "bellatrix": bellatrix_mods,
+        "capella": bellatrix_mods,
     }
     run_state_test_generators(runner_name="sanity", all_mods=all_mods, argv=argv)
 

@@ -21,7 +21,12 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
-from .hashing import ZERO_HASHES, hash_layer
+from .hashing import (
+    MIN_DEVICE_TREE,
+    ZERO_HASHES,
+    get_wave_hasher,
+    hash_layer,
+)
 
 
 class Node:
@@ -106,8 +111,6 @@ def merkle_root(node: Node) -> bytes:
             scheduled.add(id(n))
         waves.append(ready)
         rest = later
-
-    from .hashing import MIN_DEVICE_TREE, get_wave_hasher
 
     wave_hasher = get_wave_hasher() if len(seen) >= MIN_DEVICE_TREE else None
     if wave_hasher is not None:

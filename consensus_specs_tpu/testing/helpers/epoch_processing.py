@@ -60,6 +60,9 @@ def run_epoch_processing_with(spec, state, process_name: str):
     ``process_name``, yielding 'pre' and 'post' states around it.
     """
     run_epoch_processing_to(spec, state, process_name)
+    # vectors record which sub-transition the case targets so consumers
+    # of grouped handlers replay the right one (meta.yaml: sub_transition)
+    yield "sub_transition", "meta", process_name
     yield "pre", state
     getattr(spec, process_name)(state)
     yield "post", state

@@ -5,6 +5,7 @@ test_process_sync_committee_updates.py)."""
 from random import Random
 
 from consensus_specs_tpu.testing.context import (
+    always_bls,
     spec_state_test,
     with_phases,
 )
@@ -42,6 +43,7 @@ def test_participation_flag_rotation(spec, state):
 
 @with_phases(ALTAIR_AND_LATER)
 @spec_state_test
+@always_bls
 def test_sync_committee_rotation_at_period_boundary(spec, state):
     # advance to the final epoch of a sync-committee period
     period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)

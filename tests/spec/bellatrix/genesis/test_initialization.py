@@ -33,6 +33,7 @@ def test_initialize_pre_transition_empty_payload(spec):
     # default (empty) payload header: the merge is NOT complete
     assert not spec.is_merge_transition_complete(state)
     yield "eth1_block_hash", eth1_block_hash
+    yield "deposits", deposits
     yield "state", state
 
 
@@ -50,8 +51,11 @@ def test_initialize_post_transition_with_payload_header(spec):
         eth1_block_hash, GENESIS_TIME, deposits,
         execution_payload_header=header,
     )
+    yield "eth1_block_hash", eth1_block_hash
+    yield "deposits", deposits
     # seeded payload header: genesis is post-merge
     assert spec.is_merge_transition_complete(state)
     assert bytes(state.latest_execution_payload_header.hash_tree_root()) == \
         bytes(header.hash_tree_root())
+    yield "execution_payload_header", header
     yield "state", state

@@ -47,6 +47,7 @@ def test_invalid_signature_rejected(spec, state):
     signed_change = _signed_address_change(spec, state, 0)
     signed_change.signature = spec.BLSSignature(b"\x01" + bytes(signed_change.signature[1:]))
     yield "pre", state
+    yield "address_change", signed_change
     expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed_change))
     yield "post", None
 
@@ -57,6 +58,7 @@ def test_wrong_pubkey_rejected(spec, state):
     signed_change = _signed_address_change(spec, state, 0)
     signed_change.message.from_bls_pubkey = pubkeys[5]  # wrong withdrawal key
     yield "pre", state
+    yield "address_change", signed_change
     expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed_change))
     yield "post", None
 
@@ -67,5 +69,6 @@ def test_out_of_range_validator_index(spec, state):
     signed_change = _signed_address_change(spec, state, 0)
     signed_change.message.validator_index = len(state.validators)
     yield "pre", state
+    yield "address_change", signed_change
     expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed_change))
     yield "post", None

@@ -24,25 +24,6 @@ def _make_validator_withdrawable(spec, state, index):
 
 @with_capella_and_later
 @spec_state_test
-def test_full_withdrawal_enqueued_at_epoch_boundary(spec, state):
-    index = 0
-    _make_validator_withdrawable(spec, state, index)
-    pre_balance = state.balances[index]
-    pre_queue_len = len(state.withdrawals_queue)
-
-    yield "pre", state
-    next_epoch(spec, state)
-    yield "post", state
-
-    assert state.balances[index] == 0
-    assert len(state.withdrawals_queue) == pre_queue_len + 1
-    wd = state.withdrawals_queue[len(state.withdrawals_queue) - 1]
-    assert wd.amount == pre_balance
-    assert state.validators[index].fully_withdrawn_epoch < spec.FAR_FUTURE_EPOCH
-
-
-@with_capella_and_later
-@spec_state_test
 def test_process_withdrawals_dequeues_queue(spec, state):
     state = build_state_with_complete_transition(spec, state)
     index = 0
@@ -54,6 +35,7 @@ def test_process_withdrawals_dequeues_queue(spec, state):
     assert len(payload.withdrawals) == 1
 
     yield "pre", state
+    yield "execution_payload", payload
     spec.process_withdrawals(state, payload)
     yield "post", state
 
@@ -73,5 +55,6 @@ def test_process_withdrawals_wrong_payload_fails(spec, state):
     payload.withdrawals[0].amount += 1  # mismatch vs queue
 
     yield "pre", state
+    yield "execution_payload", payload
     expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
     yield "post", None

@@ -82,6 +82,7 @@ def test_historical_roots_accumulator(spec, state):
         block_roots=state.block_roots,
         state_roots=state.state_roots,
     ))
+    yield "sub_transition", "meta", "process_historical_roots_update"
     yield "pre", state
     spec.process_historical_roots_update(state)
     yield "post", state

@@ -59,6 +59,8 @@ def test_initialize_beacon_state_some_small_balances(spec):
     assert len(state.validators) == main_count + 2
     # only the full-balance validators are active at genesis
     assert len(spec.get_active_validator_indices(state, 0)) == main_count
+    yield "eth1_block_hash", b"\x12" * 32
+    yield "deposits", deposits
     yield "state", state
 
 
@@ -84,4 +86,6 @@ def test_initialize_beacon_state_one_topup_activation(spec):
         b"\x12" * 32, GENESIS_TIME, deposits
     )
     assert len(spec.get_active_validator_indices(state, 0)) == count
+    yield "eth1_block_hash", b"\x12" * 32
+    yield "deposits", deposits
     yield "state", state

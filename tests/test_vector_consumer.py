@@ -1,0 +1,97 @@
+"""Generate→consume round trip: the vector generators write a tree per
+the format contract; the consumer replays every case against a fresh
+spec build and must reproduce byte-identical results.  This pins BOTH
+directions of the L5/L6 layer the way the reference's client ecosystem
+does (generator output on one side, client test runner on the other)."""
+from pathlib import Path
+
+import pytest
+
+from consensus_specs_tpu.gen import consumer
+from consensus_specs_tpu.gen.consumer import VectorFailure, consume_tree
+from consensus_specs_tpu.testing import context
+
+
+@pytest.fixture(autouse=True)
+def _restore_pytest_flag():
+    yield
+    context.is_pytest = True
+
+
+def _generate(tmp_path, runner_main, argv_extra=()):
+    runner_main(argv=["-o", str(tmp_path), "-l", "minimal", *argv_extra])
+
+
+def test_operations_roundtrip(tmp_path):
+    from consensus_specs_tpu.gen.runners.operations import main
+    _generate(tmp_path, main)
+    stats = consume_tree(tmp_path, preset="minimal", runners={"operations"})
+    assert stats["pass"] > 50
+    assert stats["skip"] == 0
+
+
+def test_sanity_and_epoch_processing_roundtrip(tmp_path):
+    from consensus_specs_tpu.gen.runners.epoch_processing import main as ep
+    from consensus_specs_tpu.gen.runners.sanity import main as sanity
+    _generate(tmp_path, sanity)
+    _generate(tmp_path, ep)
+    stats = consume_tree(tmp_path, preset="minimal",
+                         runners={"sanity", "epoch_processing"})
+    assert stats["pass"] > 40
+
+
+def test_shuffling_and_ssz_static_roundtrip(tmp_path):
+    from consensus_specs_tpu.gen.runners.shuffling import main as shuffling
+    from consensus_specs_tpu.gen.runners.ssz_static import main as ssz_static
+    _generate(tmp_path, shuffling)
+    _generate(tmp_path, ssz_static)
+    stats = consume_tree(tmp_path, preset="minimal",
+                         runners={"shuffling", "ssz_static"})
+    assert stats["pass"] > 50
+
+
+def test_forks_and_genesis_roundtrip(tmp_path):
+    from consensus_specs_tpu.gen.runners.forks import main as forks
+    from consensus_specs_tpu.gen.runners.genesis import main as genesis
+    _generate(tmp_path, forks)
+    _generate(tmp_path, genesis)
+    stats = consume_tree(tmp_path, preset="minimal",
+                         runners={"fork", "forks", "genesis"})
+    assert stats["pass"] > 3
+
+
+def test_consumer_detects_corruption(tmp_path):
+    """Flipping a byte in a post state must fail the replay — the
+    consumer is only meaningful if divergence is actually detected."""
+    from consensus_specs_tpu.gen.runners.shuffling import main as shuffling
+    import yaml
+
+    _generate(tmp_path, shuffling)
+    corrupted = None
+    for mapping in Path(tmp_path).rglob("mapping.yaml"):
+        data = yaml.safe_load(mapping.read_text())
+        if data["mapping"]:
+            data["mapping"][0] = int(data["mapping"][0]) + 1
+            mapping.write_text(yaml.safe_dump(data))
+            corrupted = mapping
+            break
+    assert corrupted is not None
+    with pytest.raises(VectorFailure):
+        consume_tree(tmp_path, preset="minimal", runners={"shuffling"})
+
+
+def test_incomplete_cases_skipped(tmp_path):
+    from consensus_specs_tpu.gen.runners.shuffling import main as shuffling
+    _generate(tmp_path, shuffling)
+    case = next(p for p in Path(tmp_path).rglob("mapping.yaml")).parent
+    (case / "INCOMPLETE").write_text("")
+    stats = consume_tree(tmp_path, preset="minimal", runners={"shuffling"})
+    assert stats["skip"] == 1
+
+
+def test_cli_entrypoint(tmp_path, capsys):
+    from consensus_specs_tpu.gen.runners.shuffling import main as shuffling
+    _generate(tmp_path, shuffling)
+    consumer.main([str(tmp_path), "--preset", "minimal", "--runner", "shuffling"])
+    out = capsys.readouterr().out
+    assert "passed" in out
