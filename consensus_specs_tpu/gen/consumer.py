@@ -164,7 +164,10 @@ def run_rewards_case(spec, case_dir: Path, meta) -> None:
     from consensus_specs_tpu.testing.helpers.rewards import Deltas
 
     pre = _load_ssz(case_dir, "pre", spec.BeaconState)
-    if hasattr(spec, "get_source_deltas"):  # phase0 component layout
+    # altair+ specs inherit phase0's component functions through the fork
+    # chain, so detect the flag layout FIRST (its state has participation
+    # flags, not pending attestations)
+    if not hasattr(spec, "get_flag_index_deltas"):  # phase0 component layout
         components = {
             "source_deltas": spec.get_source_deltas,
             "target_deltas": spec.get_target_deltas,
@@ -245,10 +248,113 @@ def run_genesis_case(spec, handler: str, case_dir: Path, meta) -> None:
         raise VectorFailure("genesis initialization mismatch")
 
 
-def run_fork_case(fork: str, case_dir: Path, meta, preset: str) -> None:
-    parents = {"altair": "phase0", "bellatrix": "altair", "capella": "bellatrix"}
-    pre_spec = get_spec(parents[fork], preset)
-    post_spec = get_spec(fork, preset)
+def _hex_bytes(value: str) -> bytes:
+    return bytes.fromhex(value[2:] if value.startswith("0x") else value)
+
+
+def run_bls_case(handler: str, case_dir: Path) -> None:
+    """BLS handler vectors: data.yaml {input, output}; output null means
+    the operation must fail (reference: tests/formats/bls/)."""
+    data = _yaml.safe_load((case_dir / "data.yaml").read_text())
+    inp, expected = data["input"], data["output"]
+
+    def run():
+        if handler == "sign":
+            return "0x" + bls.Sign(int(inp["privkey"], 16),
+                                   _hex_bytes(inp["message"])).hex()
+        if handler == "verify":
+            return bls.Verify(_hex_bytes(inp["pubkey"]),
+                              _hex_bytes(inp["message"]),
+                              _hex_bytes(inp["signature"]))
+        if handler == "aggregate":
+            return "0x" + bytes(bls.Aggregate(
+                [_hex_bytes(s) for s in inp])).hex()
+        if handler == "fast_aggregate_verify":
+            return bls.FastAggregateVerify(
+                [_hex_bytes(p) for p in inp["pubkeys"]],
+                _hex_bytes(inp["message"]), _hex_bytes(inp["signature"]))
+        if handler == "aggregate_verify":
+            return bls.AggregateVerify(
+                [_hex_bytes(p) for p in inp["pubkeys"]],
+                [_hex_bytes(m) for m in inp["messages"]],
+                _hex_bytes(inp["signature"]))
+        if handler == "eth_aggregate_pubkeys":
+            spec = get_spec("altair", "minimal")
+            return "0x" + bytes(spec.eth_aggregate_pubkeys(
+                [spec.BLSPubkey(_hex_bytes(p)) for p in inp])).hex()
+        if handler == "eth_fast_aggregate_verify":
+            spec = get_spec("altair", "minimal")
+            return spec.eth_fast_aggregate_verify(
+                [spec.BLSPubkey(_hex_bytes(p)) for p in inp["pubkeys"]],
+                _hex_bytes(inp["message"]), _hex_bytes(inp["signature"]))
+        raise VectorFailure(f"unknown bls handler {handler}")
+
+    if expected is None:
+        _expect_failure(run)
+        return
+    got = run()
+    if isinstance(expected, str):
+        ok = got.lower() == expected.lower()
+    else:
+        ok = bool(got) == bool(expected)
+    if not ok:
+        raise VectorFailure(f"bls/{handler}: {got!r} != {expected!r}")
+
+
+_FORK_PARENT = {"altair": "phase0", "bellatrix": "altair", "capella": "bellatrix"}
+
+
+def _build(fork: str, preset: str, config=None):
+    """Spec for fork x preset, honoring a recorded config override."""
+    if config is None:
+        return get_spec(fork, preset)
+    from consensus_specs_tpu.specs.builder import build_spec
+
+    return build_spec(fork, preset, config=config)
+
+
+def run_transition_case(case_dir: Path, meta, preset: str,
+                        config=None) -> None:
+    """Cross-fork transition: apply mixed pre/post-fork blocks, upgrading
+    at the fork epoch (reference: tests/formats/transition/)."""
+    post_fork = meta["fork"]
+    fork_epoch = int(meta["fork_epoch"])
+    pre_spec = _build(_FORK_PARENT[post_fork], preset, config)
+    post_spec = _build(post_fork, preset, config)
+    state = _load_ssz(case_dir, "pre", pre_spec.BeaconState)
+    count = int(meta.get("blocks_count", 0))
+    upgraded = False
+    for i in range(count):
+        raw = decompress((case_dir / f"blocks_{i}.ssz_snappy").read_bytes())
+        try:
+            signed = (post_spec if upgraded else pre_spec) \
+                .SignedBeaconBlock.decode_bytes(raw)
+        except Exception:
+            signed = post_spec.SignedBeaconBlock.decode_bytes(raw)
+        spec = post_spec if upgraded else pre_spec
+        block = signed.message
+        if not upgraded and int(spec.compute_epoch_at_slot(
+                int(block.slot))) >= fork_epoch:
+            boundary = fork_epoch * int(spec.SLOTS_PER_EPOCH)
+            if int(state.slot) < boundary:
+                spec.process_slots(state, spec.Slot(boundary))
+            state = getattr(post_spec, f"upgrade_to_{post_fork}")(state)
+            upgraded = True
+            spec = post_spec
+            signed = post_spec.SignedBeaconBlock.decode_bytes(raw)
+            block = signed.message
+        if int(state.slot) < int(block.slot):
+            spec.process_slots(state, block.slot)
+        assert spec.verify_block_signature(state, signed)
+        spec.process_block(state, block)
+        assert bytes(block.state_root) == bytes(state.hash_tree_root())
+    _check_post(post_spec, state, case_dir, "transition")
+
+
+def run_fork_case(fork: str, case_dir: Path, meta, preset: str,
+                  config=None) -> None:
+    pre_spec = _build(_FORK_PARENT[fork], preset, config)
+    post_spec = _build(fork, preset, config)
     pre = _load_ssz(case_dir, "pre", pre_spec.BeaconState)
     post = _load_ssz(case_dir, "post", post_spec.BeaconState)
     got = getattr(post_spec, f"upgrade_to_{fork}")(pre)
@@ -264,11 +370,21 @@ def run_case(preset: str, fork: str, runner: str, handler: str,
     meta = _load_meta(case_dir)
     bls_setting = meta.get("bls_setting", 0)
 
+    if runner == "bls":  # preset-independent ("general"); needs no spec
+        old_bls = bls.bls_active
+        bls.bls_active = True
+        try:
+            run_bls_case(handler, case_dir)
+        finally:
+            bls.bls_active = old_bls
+        return "pass"
+
     config_part = case_dir / "config.yaml"
+    override_config = None
     if config_part.exists():
         # the case ran under overridden config values; rebuild the spec
         # with the recorded effective config (format: ints, 0x-hex, str)
-        from consensus_specs_tpu.specs.builder import _typed_config, build_spec
+        from consensus_specs_tpu.specs.builder import _typed_config
 
         raw = {}
         for key, value in _yaml.safe_load(config_part.read_text()).items():
@@ -276,9 +392,8 @@ def run_case(preset: str, fork: str, runner: str, handler: str,
                 raw[key] = bytes.fromhex(value[2:])
             else:
                 raw[key] = value
-        spec = build_spec(fork, preset, config=_typed_config(raw))
-    else:
-        spec = get_spec(fork, preset)
+        override_config = _typed_config(raw)
+    spec = _build(fork, preset, override_config)
     old_bls = bls.bls_active
     bls.bls_active = (bls_setting == 1)
     try:
@@ -300,7 +415,9 @@ def run_case(preset: str, fork: str, runner: str, handler: str,
         elif runner == "genesis":
             run_genesis_case(spec, handler, case_dir, meta)
         elif runner in ("fork", "forks"):
-            run_fork_case(fork, case_dir, meta, preset)
+            run_fork_case(fork, case_dir, meta, preset, override_config)
+        elif runner == "transition":
+            run_transition_case(case_dir, meta, preset, override_config)
         else:
             return "skip"
     finally:

@@ -95,3 +95,23 @@ def test_cli_entrypoint(tmp_path, capsys):
     consumer.main([str(tmp_path), "--preset", "minimal", "--runner", "shuffling"])
     out = capsys.readouterr().out
     assert "passed" in out
+
+
+def test_bls_and_transition_roundtrip(tmp_path):
+    from consensus_specs_tpu.gen.runners.bls import main as bls_main
+    from consensus_specs_tpu.gen.runners.transition import main as transition
+
+    bls_main(argv=["-o", str(tmp_path)])
+    _generate(tmp_path, transition)
+    stats = consume_tree(tmp_path, runners={"bls", "transition"})
+    assert stats["pass"] > 30
+    assert stats["skip"] == 0
+
+
+def test_rewards_roundtrip(tmp_path):
+    from consensus_specs_tpu.gen.runners.rewards import main as rewards
+    _generate(tmp_path, rewards)
+    stats = consume_tree(tmp_path, preset="minimal", runners={"rewards"})
+    # phase0 + altair/bellatrix/capella flag layouts both replayed
+    assert stats["pass"] > 20
+    assert stats["skip"] == 0
