@@ -301,7 +301,8 @@ def run_bls_case(handler: str, case_dir: Path) -> None:
         raise VectorFailure(f"bls/{handler}: {got!r} != {expected!r}")
 
 
-_FORK_PARENT = {"altair": "phase0", "bellatrix": "altair", "capella": "bellatrix"}
+# the builder's fork topology covers experimental branches too
+from consensus_specs_tpu.specs.builder import FORK_PARENTS as _FORK_PARENT  # noqa: E402
 
 
 def _build(fork: str, preset: str, config=None):
@@ -393,7 +394,9 @@ def run_case(preset: str, fork: str, runner: str, handler: str,
             else:
                 raw[key] = value
         override_config = _typed_config(raw)
-    spec = _build(fork, preset, override_config)
+    # fork/transition replays build their own pre/post specs
+    spec = (None if runner in ("fork", "forks", "transition")
+            else _build(fork, preset, override_config))
     old_bls = bls.bls_active
     bls.bls_active = (bls_setting == 1)
     try:

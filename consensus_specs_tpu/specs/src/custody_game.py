@@ -18,12 +18,11 @@ CUSTODY_PROBABILITY_EXPONENT = uint64(10)
 
 DOMAIN_CUSTODY_BIT_SLASHING = Bytes4(bytes.fromhex("83000000"))
 
-# Preset (custody_game/beacon-chain.md:81-117)
-RANDAO_PENALTY_EPOCHS = uint64(2**1)
-EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS = uint64(2**15)
+# Preset vars (custody_game/beacon-chain.md:81-117) are supplied by the
+# environment from config/presets.py (per-preset: the reference's
+# minimal/custody_game.yaml customizes the epoch parameters for quick
+# testing).  Only BYTES_PER_CUSTODY_CHUNK stays constant across presets.
 BYTES_PER_CUSTODY_CHUNK = uint64(2**12)
-EARLY_DERIVED_SECRET_REVEAL_SLOT_REWARD_MULTIPLE = uint64(2**1)
-MINOR_REWARD_QUOTIENT = uint64(2**8)
 
 # [legacy-draft] older sharding draft's maximum shard block size
 MAX_SHARD_BLOCK_SIZE = uint64(2**20)
@@ -63,6 +62,19 @@ class Attestation(Container):
     aggregation_bits: Bitlist[MAX_VALIDATORS_PER_COMMITTEE]
     data: AttestationData
     signature: BLSSignature
+
+
+# rebound to the custody AttestationData (the reference's flat emitted
+# module re-evaluates every container against the final class set)
+class IndexedAttestation(Container):
+    attesting_indices: List[ValidatorIndex, MAX_VALIDATORS_PER_COMMITTEE]
+    data: AttestationData
+    signature: BLSSignature
+
+
+class AttesterSlashing(Container):
+    attestation_1: IndexedAttestation
+    attestation_2: IndexedAttestation
 
 
 # Extended types (custody_game/beacon-chain.md:121-158)
@@ -124,6 +136,9 @@ class EarlyDerivedSecretReveal(Container):
 
 
 class BeaconBlockBody(BeaconBlockBody):  # extends sharding body
+    # rebound to the custody Attestation / AttesterSlashing types
+    attestations: List[Attestation, MAX_ATTESTATIONS]
+    attester_slashings: List[AttesterSlashing, MAX_ATTESTER_SLASHINGS]
     chunk_challenges: List[CustodyChunkChallenge, MAX_CUSTODY_CHUNK_CHALLENGES]
     chunk_challenge_responses: List[CustodyChunkResponse, MAX_CUSTODY_CHUNK_CHALLENGE_RESPONSES]
     custody_key_reveals: List[CustodyKeyReveal, MAX_CUSTODY_KEY_REVEALS]
@@ -145,6 +160,11 @@ class SignedBeaconBlock(Container):
 
 
 class BeaconState(BeaconState):  # extends sharding state
+    # re-declared to rebind the element type to the custody-extended
+    # Validator (the reference's flat emitted module re-evaluates every
+    # container against the final class set; in-place field override is
+    # this framework's equivalent)
+    validators: List[Validator, VALIDATOR_REGISTRY_LIMIT]
     exposed_derived_secrets: Vector[
         List[ValidatorIndex, MAX_EARLY_DERIVED_SECRET_REVEALS * SLOTS_PER_EPOCH],
         EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS,

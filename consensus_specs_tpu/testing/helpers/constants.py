@@ -16,7 +16,10 @@ TESTGEN_FORKS = (PHASE0, ALTAIR, BELLATRIX)
 
 FORKS_BEFORE_ALTAIR = (PHASE0,)
 FORKS_BEFORE_BELLATRIX = (PHASE0, ALTAIR)
-FORKS_BEFORE_CAPELLA = (PHASE0, ALTAIR, BELLATRIX)
+# experimental branches hang off bellatrix: capella-era state fields
+# (withdrawals queue etc.) do not exist on them
+FORKS_BEFORE_CAPELLA = (PHASE0, ALTAIR, BELLATRIX,
+                        SHARDING, CUSTODY_GAME, DAS, EIP4844)
 
 ALL_FORK_UPGRADES = {
     PHASE0: ALTAIR,

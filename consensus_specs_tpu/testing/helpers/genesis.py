@@ -6,8 +6,7 @@ running deposit processing — orders of magnitude faster per test case.
 from __future__ import annotations
 
 from .constants import (
-    ALTAIR,
-    BELLATRIX,
+    CUSTODY_GAME,
     FORKS_BEFORE_ALTAIR,
     FORKS_BEFORE_BELLATRIX,
     FORKS_BEFORE_CAPELLA,
@@ -35,6 +34,11 @@ def build_mock_validator(spec, i: int, balance: int):
     if spec.fork not in FORKS_BEFORE_CAPELLA:
         validator.fully_withdrawn_epoch = spec.FAR_FUTURE_EPOCH
 
+    if spec.fork == CUSTODY_GAME:
+        # "FAR_FUTURE_EPOCH until done" (custody_game/beacon-chain.md
+        # Validator extension); the zero default would read as revealed
+        validator.all_custody_secrets_revealed_epoch = spec.FAR_FUTURE_EPOCH
+
     return validator
 
 
@@ -60,17 +64,18 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
     deposit_root = b"\x42" * 32
 
     eth1_block_hash = b"\xda" * 32
-    previous_version = spec.config.GENESIS_FORK_VERSION
-    current_version = spec.config.GENESIS_FORK_VERSION
+    # fork versions follow the builder's fork topology so every fork —
+    # including the experimental branches — stamps its own version with
+    # its parent's as previous (matching the upgrade_to_* path)
+    from consensus_specs_tpu.specs.builder import FORK_PARENTS
 
-    if spec.fork == ALTAIR:
-        current_version = spec.config.ALTAIR_FORK_VERSION
-    elif spec.fork == BELLATRIX:
-        previous_version = spec.config.ALTAIR_FORK_VERSION
-        current_version = spec.config.BELLATRIX_FORK_VERSION
-    elif spec.fork not in FORKS_BEFORE_CAPELLA:
-        previous_version = spec.config.BELLATRIX_FORK_VERSION
-        current_version = spec.config.CAPELLA_FORK_VERSION
+    def _version(fork_name):
+        if fork_name is None or fork_name == "phase0":
+            return spec.config.GENESIS_FORK_VERSION
+        return getattr(spec.config, f"{fork_name.upper()}_FORK_VERSION")
+
+    current_version = _version(spec.fork)
+    previous_version = _version(FORK_PARENTS.get(spec.fork, None))
 
     state = spec.BeaconState(
         genesis_time=0,

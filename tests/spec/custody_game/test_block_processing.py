@@ -1,0 +1,247 @@
+"""Custody-game operation suites (reference suites:
+test/custody_game/block_processing/): key reveals, early derived secret
+reveals, chunk challenges and responses, against a real custody-fork
+state built by the mock-genesis helper."""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.specs.builder import get_spec
+from consensus_specs_tpu.ssz.merkle_minimal import (
+    calc_merkle_tree_from_leaves,
+    get_merkle_proof,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testing.helpers.keys import privkeys
+from consensus_specs_tpu.testing.helpers.state import next_slots, transition_to
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("custody_game", "minimal")
+
+
+@pytest.fixture()
+def state(spec):
+    old = bls.bls_active
+    bls.bls_active = False
+    st = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 16, spec.MAX_EFFECTIVE_BALANCE)
+    bls.bls_active = old
+    return st
+
+
+@pytest.fixture(autouse=True)
+def _bls_on():
+    # custody operations verify real signatures (reveal signatures ARE the
+    # custody secrets); run with the fast native backend active
+    old = bls.bls_active
+    bls.bls_active = True
+    yield
+    bls.bls_active = old
+
+
+def _valid_key_reveal(spec, state, index):
+    revealer = state.validators[index]
+    epoch_to_sign = spec.get_randao_epoch_for_custody_period(
+        revealer.next_custody_secret_to_reveal, spec.ValidatorIndex(index))
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch_to_sign)
+    signing_root = spec.compute_signing_root(epoch_to_sign, domain)
+    return spec.CustodyKeyReveal(
+        revealer_index=index,
+        reveal=bls.Sign(privkeys[index], signing_root),
+    )
+
+
+def _advance_one_custody_period(spec, state):
+    transition_to(
+        spec, state,
+        int(spec.EPOCHS_PER_CUSTODY_PERIOD) * int(spec.SLOTS_PER_EPOCH) + 1)
+
+
+def test_custody_key_reveal_valid(spec, state):
+    _advance_one_custody_period(spec, state)
+    reveal = _valid_key_reveal(spec, state, 0)
+    pre_next = int(state.validators[0].next_custody_secret_to_reveal)
+    spec.process_custody_key_reveal(state, reveal)
+    assert int(state.validators[0].next_custody_secret_to_reveal) == pre_next + 1
+
+
+def test_custody_key_reveal_too_early(spec, state):
+    # genesis epoch: no custody period has elapsed yet
+    reveal = _valid_key_reveal(spec, state, 0)
+    with pytest.raises(AssertionError):
+        spec.process_custody_key_reveal(state, reveal)
+
+
+def test_custody_key_reveal_wrong_signature(spec, state):
+    _advance_one_custody_period(spec, state)
+    reveal = _valid_key_reveal(spec, state, 0)
+    reveal = spec.CustodyKeyReveal(
+        revealer_index=0,
+        reveal=bls.Sign(privkeys[1], b"\x33" * 32),
+    )
+    with pytest.raises(AssertionError):
+        spec.process_custody_key_reveal(state, reveal)
+
+
+def test_custody_key_reveal_double_reveal_rejected(spec, state):
+    _advance_one_custody_period(spec, state)
+    spec.process_custody_key_reveal(state, _valid_key_reveal(spec, state, 0))
+    # the next secret is not yet revealable within the same period
+    with pytest.raises(AssertionError):
+        spec.process_custody_key_reveal(state, _valid_key_reveal(spec, state, 0))
+
+
+def _early_reveal(spec, state, revealed_index, masker_index, epoch):
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    mask = b"\x11" * 32
+    sigs = [
+        bls.Sign(privkeys[revealed_index], spec.compute_signing_root(
+            spec.Epoch(epoch), domain)),
+        bls.Sign(privkeys[masker_index], spec.compute_signing_root(
+            spec.Bytes32(mask), domain)),
+    ]
+    return spec.EarlyDerivedSecretReveal(
+        revealed_index=revealed_index,
+        epoch=epoch,
+        reveal=bls.Aggregate(sigs),
+        masker_index=masker_index,
+        mask=mask,
+    )
+
+
+def test_early_derived_secret_reveal_minor_penalty(spec, state):
+    epoch = int(spec.get_current_epoch(state)) + int(spec.RANDAO_PENALTY_EPOCHS)
+    reveal = _early_reveal(spec, state, 1, 2, epoch)
+    pre_balance = int(state.balances[1])
+    spec.process_early_derived_secret_reveal(state, reveal)
+    assert int(state.balances[1]) < pre_balance          # penalized
+    assert not state.validators[1].slashed               # but not slashed
+    loc = epoch % int(spec.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS)
+    assert 1 in [int(i) for i in state.exposed_derived_secrets[loc]]
+
+
+def test_early_derived_secret_reveal_full_slash(spec, state):
+    epoch = int(spec.get_current_epoch(state)) + \
+        int(spec.CUSTODY_PERIOD_TO_RANDAO_PADDING)
+    reveal = _early_reveal(spec, state, 3, 2, epoch)
+    spec.process_early_derived_secret_reveal(state, reveal)
+    assert state.validators[3].slashed
+
+
+def test_early_derived_secret_reveal_double_rejected(spec, state):
+    epoch = int(spec.get_current_epoch(state)) + int(spec.RANDAO_PENALTY_EPOCHS)
+    spec.process_early_derived_secret_reveal(
+        state, _early_reveal(spec, state, 1, 2, epoch))
+    with pytest.raises(AssertionError):
+        spec.process_early_derived_secret_reveal(
+            state, _early_reveal(spec, state, 1, 2, epoch))
+
+
+# -- chunk challenges -------------------------------------------------------
+
+
+def _challengeable_attestation(spec, state):
+    """Attestation (unsigned; BLS switched off around validation) whose
+    shard_transition_root commits to a one-block shard transition."""
+    data_bytes = b"\x22" * 300
+    chunk_count = 2
+    shard_transition = spec.ShardTransition(
+        start_slot=1,
+        shard_block_lengths=[int(spec.BYTES_PER_CUSTODY_CHUNK) * chunk_count],
+        shard_data_roots=[b"\x00" * 32],
+    )
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.shard_transition_root = \
+        spec.hash_tree_root(shard_transition)
+    return attestation, shard_transition, data_bytes
+
+
+def test_chunk_challenge_records_and_response(spec, state):
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY) + 1)
+    old = bls.bls_active
+    bls.bls_active = False  # unsigned attestation; structure under test
+    try:
+        attestation, shard_transition, _ = _challengeable_attestation(spec, state)
+
+        # build the chunked data tree the response must open into
+        depth = int(spec.CUSTODY_RESPONSE_DEPTH)
+        chunk = spec.ByteVector[spec.BYTES_PER_CUSTODY_CHUNK](
+            b"\x07" * int(spec.BYTES_PER_CUSTODY_CHUNK))
+        leaves = [bytes(chunk.hash_tree_root()), bytes(chunk.hash_tree_root())]
+        tree = calc_merkle_tree_from_leaves(leaves, depth)
+        length_leaf = (2).to_bytes(32, "little")
+        data_root = spec.hash(tree[-1][0] + length_leaf)
+        shard_transition.shard_data_roots[0] = data_root
+        attestation.data.shard_transition_root = \
+            spec.hash_tree_root(shard_transition)
+
+        # min(): the attester set is LRU-cached inside the spec — never
+        # mutate it (pop() would eat the responder out of the cache)
+        responder = int(min(spec.get_attesting_indices(
+            state, attestation.data, attestation.aggregation_bits)))
+        challenge = spec.CustodyChunkChallenge(
+            responder_index=responder,
+            shard_transition=shard_transition,
+            attestation=attestation,
+            data_index=0,
+            chunk_index=1,
+        )
+        pre_index = int(state.custody_chunk_challenge_index)
+        spec.process_chunk_challenge(state, challenge)
+        assert int(state.custody_chunk_challenge_index) == pre_index + 1
+        record = state.custody_chunk_challenge_records[0]
+        assert int(record.responder_index) == responder
+        assert bytes(record.data_root) == bytes(data_root)
+
+        # duplicate challenge rejected
+        with pytest.raises(AssertionError):
+            spec.process_chunk_challenge(state, challenge)
+
+        # valid response clears the record and rewards the proposer
+        branch = get_merkle_proof(tree, 1, depth) + [length_leaf]
+        response = spec.CustodyChunkResponse(
+            challenge_index=record.challenge_index,
+            chunk_index=1,
+            chunk=chunk,
+            branch=branch,
+        )
+        proposer = int(spec.get_beacon_proposer_index(state))
+        pre_balance = int(state.balances[proposer])
+        spec.process_chunk_challenge_response(state, response)
+        assert int(state.balances[proposer]) > pre_balance
+        cleared = state.custody_chunk_challenge_records[0]
+        assert int(cleared.challenge_index) == 0
+        assert bytes(cleared.data_root) == b"\x00" * 32
+
+        # responding again must fail (no matching record)
+        with pytest.raises(AssertionError):
+            spec.process_chunk_challenge_response(state, response)
+    finally:
+        bls.bls_active = old
+
+
+def test_chunk_challenge_wrong_chunk_index_rejected(spec, state):
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY) + 1)
+    old = bls.bls_active
+    bls.bls_active = False
+    try:
+        attestation, shard_transition, _ = _challengeable_attestation(spec, state)
+        # min(): the attester set is LRU-cached inside the spec — never
+        # mutate it (pop() would eat the responder out of the cache)
+        responder = int(min(spec.get_attesting_indices(
+            state, attestation.data, attestation.aggregation_bits)))
+        challenge = spec.CustodyChunkChallenge(
+            responder_index=responder,
+            shard_transition=shard_transition,
+            attestation=attestation,
+            data_index=0,
+            chunk_index=99,  # beyond transition_chunks
+        )
+        with pytest.raises(AssertionError):
+            spec.process_chunk_challenge(state, challenge)
+    finally:
+        bls.bls_active = old

@@ -1,0 +1,94 @@
+"""Custody-game epoch-processing suites (reference suites:
+test/custody_game/epoch_processing/): reveal deadlines, challenge
+deadlines, final updates."""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.specs.builder import get_spec
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testing.helpers.state import transition_to
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("custody_game", "minimal")
+
+
+@pytest.fixture()
+def state(spec):
+    old = bls.bls_active
+    bls.bls_active = False
+    st = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 16, spec.MAX_EFFECTIVE_BALANCE)
+    bls.bls_active = old
+    return st
+
+
+def test_reveal_deadlines_slash_laggards(spec, state):
+    # set the clock directly: custody process_epoch runs the deadline
+    # sweep itself, so *transitioning* here would cascade-slash validators
+    # one custody-period-offset at a time mid-transition.  At epoch
+    # 2*PERIOD every validator's staggered period index is 2 > deadline 1.
+    state.slot = spec.Slot(
+        2 * int(spec.EPOCHS_PER_CUSTODY_PERIOD) * int(spec.SLOTS_PER_EPOCH))
+    assert not any(v.slashed for v in state.validators)
+    spec.process_reveal_deadlines(state)
+    assert all(v.slashed for v in state.validators)
+
+
+def test_reveal_deadlines_no_slash_within_grace(spec, state):
+    # epoch 8: every staggered period index is 0, deadline 1 not exceeded
+    state.slot = spec.Slot(8 * int(spec.SLOTS_PER_EPOCH))
+    spec.process_reveal_deadlines(state)
+    assert not any(v.slashed for v in state.validators)
+
+
+def test_challenge_deadlines_slash_unanswered(spec, state):
+    transition_to(spec, state, int(spec.SLOTS_PER_EPOCH))
+    record = spec.CustodyChunkChallengeRecord(
+        challenge_index=0,
+        challenger_index=1,
+        responder_index=2,
+        inclusion_epoch=spec.get_current_epoch(state),
+        data_root=b"\x42" * 32,
+        chunk_index=0,
+    )
+    spec.replace_empty_or_append(state.custody_chunk_challenge_records, record)
+    # deadline is EPOCHS_PER_CUSTODY_PERIOD after inclusion
+    slots = (int(spec.get_current_epoch(state))
+             + int(spec.EPOCHS_PER_CUSTODY_PERIOD) + 2) * int(spec.SLOTS_PER_EPOCH)
+    transition_to(spec, state, slots)
+    spec.process_challenge_deadlines(state)
+    assert state.validators[2].slashed
+    # record cleared
+    assert int(state.custody_chunk_challenge_records[0].challenge_index) == 0
+    assert bytes(state.custody_chunk_challenge_records[0].data_root) == b"\x00" * 32
+
+
+def test_final_updates_clears_secrets_and_delays_withdrawal(spec, state):
+    current = int(spec.get_current_epoch(state))
+    loc = current % int(spec.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS)
+    state.exposed_derived_secrets[loc].append(5)
+
+    # exited validator with unrevealed secrets gets its withdrawal delayed
+    validator = state.validators[4]
+    validator.exit_epoch = spec.Epoch(current)
+    validator.withdrawable_epoch = spec.Epoch(current + 1)
+    assert int(validator.all_custody_secrets_revealed_epoch) == \
+        int(spec.FAR_FUTURE_EPOCH)
+
+    spec.process_custody_final_updates(state)
+    assert len(state.exposed_derived_secrets[loc]) == 0
+    assert int(state.validators[4].withdrawable_epoch) == \
+        int(spec.FAR_FUTURE_EPOCH)
+
+
+def test_final_updates_releases_fully_revealed(spec, state):
+    current = int(spec.get_current_epoch(state))
+    validator = state.validators[6]
+    validator.exit_epoch = spec.Epoch(current)
+    validator.withdrawable_epoch = spec.Epoch(current + 7)
+    validator.all_custody_secrets_revealed_epoch = spec.Epoch(current)
+    spec.process_custody_final_updates(state)
+    # no challenge records, all secrets revealed: withdrawal stands
+    assert int(state.validators[6].withdrawable_epoch) == current + 7
