@@ -181,3 +181,24 @@ def test_md_compiler_emits_all_mainline_sources():
                         ("bellatrix", 2800), ("capella", 2900)]:
         src = emit_fork_source(fork, preset, config_keys)
         assert len(src.splitlines()) > floor, f"{fork} source suspiciously small"
+
+
+@pytest.mark.parametrize("fork", ["phase0", "capella"])
+def test_mainnet_containers_fuzz_identical(fork):
+    """Mainnet-preset markdown builds: container layouts (list limits,
+    vector lengths baked from preset data) must match the handwritten
+    build byte-for-byte too."""
+    spec = get_spec(fork, "mainnet")
+    md = get_md_spec(fork, "mainnet")
+    checked = 0
+    for name, typ in get_spec_ssz_types(spec):
+        md_typ = getattr(md, name, None)
+        assert md_typ is not None, f"{name} missing from mainnet markdown build"
+        value = get_random_ssz_object(Random(7), typ, 128, 4,
+                                      RandomizationMode.mode_random)
+        md_value = get_random_ssz_object(Random(7), md_typ, 128, 4,
+                                         RandomizationMode.mode_random)
+        assert bytes(value.encode_bytes()) == bytes(md_value.encode_bytes())
+        assert bytes(value.hash_tree_root()) == bytes(md_value.hash_tree_root())
+        checked += 1
+    assert checked > 20

@@ -3,6 +3,7 @@ test/bellatrix/genesis/test_initialization.py): the testing-variant
 ``initialize_beacon_state_from_eth1`` seeds an execution payload header
 (reference: setup.py BellatrixSpecBuilder sundry preparations)."""
 from consensus_specs_tpu.testing.context import (
+    with_presets,
     single_phase,
     spec_test,
     with_phases,
@@ -20,6 +21,7 @@ GENESIS_TIME = 1578009600
 @with_phases(["bellatrix"])
 @spec_test
 @single_phase
+@with_presets(["minimal"], reason="mainnet genesis means 16384 signed deposits per case")
 def test_initialize_pre_transition_empty_payload(spec):
     deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
     deposits, deposit_root, _ = prepare_full_genesis_deposits(
@@ -40,6 +42,7 @@ def test_initialize_pre_transition_empty_payload(spec):
 @with_phases(["bellatrix"])
 @spec_test
 @single_phase
+@with_presets(["minimal"], reason="mainnet genesis means 16384 signed deposits per case")
 def test_initialize_post_transition_with_payload_header(spec):
     deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
     deposits, _, _ = prepare_full_genesis_deposits(

@@ -1,0 +1,22 @@
+"""Shared custody-game fixtures: one minimal custody spec and a
+16-validator mock-genesis state per test."""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.specs.builder import get_spec
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+
+@pytest.fixture(scope="package")
+def spec():
+    return get_spec("custody_game", "minimal")
+
+
+@pytest.fixture()
+def state(spec):
+    old = bls.bls_active
+    bls.bls_active = False
+    st = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 16, spec.MAX_EFFECTIVE_BALANCE)
+    bls.bls_active = old
+    return st

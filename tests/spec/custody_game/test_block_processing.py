@@ -5,7 +5,6 @@ state built by the mock-genesis helper."""
 import pytest
 
 from consensus_specs_tpu.crypto import bls
-from consensus_specs_tpu.specs.builder import get_spec
 from consensus_specs_tpu.ssz.merkle_minimal import (
     calc_merkle_tree_from_leaves,
     get_merkle_proof,
@@ -13,24 +12,8 @@ from consensus_specs_tpu.ssz.merkle_minimal import (
 from consensus_specs_tpu.testing.helpers.attestations import (
     get_valid_attestation,
 )
-from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
 from consensus_specs_tpu.testing.helpers.keys import privkeys
 from consensus_specs_tpu.testing.helpers.state import next_slots, transition_to
-
-
-@pytest.fixture(scope="module")
-def spec():
-    return get_spec("custody_game", "minimal")
-
-
-@pytest.fixture()
-def state(spec):
-    old = bls.bls_active
-    bls.bls_active = False
-    st = create_genesis_state(
-        spec, [spec.MAX_EFFECTIVE_BALANCE] * 16, spec.MAX_EFFECTIVE_BALANCE)
-    bls.bls_active = old
-    return st
 
 
 @pytest.fixture(autouse=True)
@@ -217,7 +200,16 @@ def test_chunk_challenge_records_and_response(spec, state):
         assert int(cleared.challenge_index) == 0
         assert bytes(cleared.data_root) == b"\x00" * 32
 
-        # responding again must fail (no matching record)
+        # responding again must fail.  The cleared sentinel record has
+        # challenge_index=0 == the first real challenge's index, so pin
+        # the rejection to a record-lookup failure by using an index no
+        # record (real or sentinel) carries.
+        gone = spec.CustodyChunkResponse(
+            challenge_index=int(record.challenge_index) + 100,
+            chunk_index=1, chunk=chunk, branch=branch)
+        with pytest.raises(AssertionError):
+            spec.process_chunk_challenge_response(state, gone)
+        # and the sentinel-matching replay also fails (chunk mismatch)
         with pytest.raises(AssertionError):
             spec.process_chunk_challenge_response(state, response)
     finally:

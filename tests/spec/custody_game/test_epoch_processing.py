@@ -1,27 +1,8 @@
 """Custody-game epoch-processing suites (reference suites:
 test/custody_game/epoch_processing/): reveal deadlines, challenge
 deadlines, final updates."""
-import pytest
 
-from consensus_specs_tpu.crypto import bls
-from consensus_specs_tpu.specs.builder import get_spec
-from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
 from consensus_specs_tpu.testing.helpers.state import transition_to
-
-
-@pytest.fixture(scope="module")
-def spec():
-    return get_spec("custody_game", "minimal")
-
-
-@pytest.fixture()
-def state(spec):
-    old = bls.bls_active
-    bls.bls_active = False
-    st = create_genesis_state(
-        spec, [spec.MAX_EFFECTIVE_BALANCE] * 16, spec.MAX_EFFECTIVE_BALANCE)
-    bls.bls_active = old
-    return st
 
 
 def test_reveal_deadlines_slash_laggards(spec, state):

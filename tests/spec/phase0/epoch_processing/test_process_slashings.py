@@ -66,12 +66,23 @@ def test_max_penalties(spec, state):
 @with_all_phases
 @spec_state_test
 def test_low_penalty(spec, state):
-    # one slashed validator out of many: penalty is proportional, small
+    # one slashed validator out of many: penalty is proportional — and
+    # preset-dependent (on mainnet-sized registries the integer division
+    # legitimately floors to zero), so pin the exact spec formula
     _slash_validators(spec, state, [5], [_in_window(spec, state)])
     pre = get_balance(state, 5)
     yield from run_epoch_processing_with(spec, state, "process_slashings")
     post = get_balance(state, 5)
-    assert post < pre
+
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    total = int(spec.get_total_active_balance(state))
+    eff = int(state.validators[5].effective_balance)
+    from consensus_specs_tpu.specs.builder import _SLASHING_MULT
+
+    mult = int(getattr(spec, _SLASHING_MULT[spec.fork]))
+    adjusted = min(sum(int(x) for x in state.slashings) * mult, total)
+    expected = eff // increment * adjusted // total * increment
+    assert post == pre - expected
 
 
 @with_all_phases

@@ -2,6 +2,7 @@
 initialize_beacon_state_from_eth1; reference suite:
 test/phase0/genesis/test_initialization.py)."""
 from consensus_specs_tpu.testing.context import (
+    with_presets,
     single_phase,
     spec_test,
     with_phases,
@@ -16,6 +17,7 @@ GENESIS_TIME = 1578009600
 @with_phases(["phase0"])
 @spec_test
 @single_phase
+@with_presets(["minimal"], reason="mainnet genesis means 16384 signed deposits per case")
 def test_initialize_beacon_state_from_eth1(spec):
     deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
     deposits, deposit_root, _ = prepare_full_genesis_deposits(
@@ -41,6 +43,7 @@ def test_initialize_beacon_state_from_eth1(spec):
 @with_phases(["phase0"])
 @spec_test
 @single_phase
+@with_presets(["minimal"], reason="mainnet genesis means 16384 signed deposits per case")
 def test_initialize_beacon_state_some_small_balances(spec):
     main_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
     main_deposits, _, deposit_data_list = prepare_full_genesis_deposits(
@@ -67,6 +70,7 @@ def test_initialize_beacon_state_some_small_balances(spec):
 @with_phases(["phase0"])
 @spec_test
 @single_phase
+@with_presets(["minimal"], reason="mainnet genesis means 16384 signed deposits per case")
 def test_initialize_beacon_state_one_topup_activation(spec):
     count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
     # validator 0 deposits in two halves; the top-up must activate it

@@ -2,6 +2,7 @@
 is_valid_genesis_state; reference suite:
 test/phase0/genesis/test_validity.py)."""
 from consensus_specs_tpu.testing.context import (
+    with_presets,
     single_phase,
     spec_test,
     with_phases,
@@ -30,6 +31,7 @@ def run_is_valid_genesis_state(spec, state, valid=True):
 @with_phases(["phase0"])
 @spec_test
 @single_phase
+@with_presets(["minimal"], reason="mainnet genesis means 16384 signed deposits per case")
 def test_full_genesis_deposits_valid(spec):
     state = create_valid_beacon_state(spec)
     yield from run_is_valid_genesis_state(spec, state)
@@ -38,6 +40,7 @@ def test_full_genesis_deposits_valid(spec):
 @with_phases(["phase0"])
 @spec_test
 @single_phase
+@with_presets(["minimal"], reason="mainnet genesis means 16384 signed deposits per case")
 def test_invalid_before_genesis_time(spec):
     state = create_valid_beacon_state(spec)
     state.genesis_time = spec.config.MIN_GENESIS_TIME - 3
@@ -47,6 +50,7 @@ def test_invalid_before_genesis_time(spec):
 @with_phases(["phase0"])
 @spec_test
 @single_phase
+@with_presets(["minimal"], reason="mainnet genesis means 16384 signed deposits per case")
 def test_invalid_too_few_validators(spec):
     state = create_valid_beacon_state(spec)
     for index in range(2):
@@ -61,6 +65,7 @@ def test_invalid_too_few_validators(spec):
 @with_phases(["phase0"])
 @spec_test
 @single_phase
+@with_presets(["minimal"], reason="mainnet genesis means 16384 signed deposits per case")
 def test_exactly_min_validator_count(spec):
     state = create_valid_beacon_state(spec)
     assert len(spec.get_active_validator_indices(state, 0)) == (
