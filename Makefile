@@ -27,4 +27,14 @@ gen-%:
 	mkdir -p $(OUT)
 	python -m consensus_specs_tpu.gen.runners.$* -o $(OUT) $(if $(PRESETS),-l $(PRESETS),)
 
-.PHONY: test test-fast test-mainnet bench lint gen-all $(addprefix gen-,$(GENERATORS))
+# replay a generated vector tree against fresh spec builds (the
+# client-side half of the format contract)
+consume:
+	python -m consensus_specs_tpu.gen.consumer $(OUT)
+
+# compile the vendored reference markdown into flat spec modules
+mdspec:
+	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
+	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
+
+.PHONY: test test-fast test-mainnet bench lint consume mdspec gen-all $(addprefix gen-,$(GENERATORS))
