@@ -11,8 +11,12 @@ from consensus_specs_tpu.testing.helpers.attestations import (
 from consensus_specs_tpu.testing.helpers.block import (
     build_empty_block_for_next_slot,
 )
+from consensus_specs_tpu.testing.helpers.attester_slashings import (
+    get_valid_attester_slashing_by_indices,
+)
 from consensus_specs_tpu.testing.helpers.constants import MINIMAL
 from consensus_specs_tpu.testing.helpers.fork_choice import (
+    add_attester_slashing,
     add_block,
     apply_next_epoch_with_attestations,
     get_anchor_root,
@@ -220,4 +224,108 @@ def test_ex_ante_attestation_flips_head(spec, state):
     on_tick_and_append_step(spec, store, next_time, test_steps)
     yield from tick_and_run_on_attestation(spec, store, attestation, test_steps)
     assert spec.get_head(store) == weaker.message.hash_tree_root()
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attester_slashing_discounts_equivocators(spec, state):
+    """Fork-choice handler on_attester_slashing: equivocating indices are
+    recorded AND their latest messages stop counting toward head weight —
+    the attestation-flipped head reverts once its attesters equivocate
+    (reference family: test_on_attester_slashing.py)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # two sibling blocks; no boost (tick is past the slot)
+    forks = []
+    for graffiti in (b"\x41" * 32, b"\x42" * 32):
+        fork_state = state.copy()
+        block = build_empty_block_for_next_slot(spec, fork_state)
+        block.body.graffiti = graffiti
+        forks.append(
+            (state_transition_and_sign_block(spec, fork_state, block), fork_state))
+    time = store.genesis_time + \
+        (int(forks[0][0].message.slot) + 1) * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    for signed, _ in forks:
+        yield from add_block(spec, store, signed, test_steps)
+
+    strong = max(s.message.hash_tree_root() for s, _ in forks)
+    weaker, weaker_state = min(forks, key=lambda f: f[0].message.hash_tree_root())
+    assert spec.get_head(store) == strong
+
+    # one committee attests the tie-losing sibling: head flips to it
+    attestation = get_valid_attestation(
+        spec, weaker_state, slot=weaker.message.slot, signed=True)
+    yield from tick_and_run_on_attestation(spec, store, attestation, test_steps)
+    assert spec.get_head(store) == weaker.message.hash_tree_root()
+
+    # slash exactly those attesters: their latest messages stop counting
+    attesters = sorted(int(i) for i in spec.get_attesting_indices(
+        weaker_state, attestation.data, attestation.aggregation_bits))
+    slashing = get_valid_attester_slashing_by_indices(
+        spec, state, attesters, signed_1=True, signed_2=True)
+    yield from add_attester_slashing(spec, store, slashing, test_steps)
+    for index in attesters:
+        assert index in [int(i) for i in store.equivocating_indices]
+    assert spec.get_head(store) == strong
+    yield "steps", test_steps
+
+
+@with_all_phases
+@with_presets([MINIMAL], reason="epoch-long walks; too slow at mainnet size")
+@spec_state_test
+def test_justified_checkpoint_updates_via_blocks(spec, state):
+    """Four epochs of full attestations through on_block update the
+    store's justified and finalized checkpoints (reference family:
+    test_on_block.py justification cases)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    assert int(store.justified_checkpoint.epoch) == 0
+    for round_ in range(4):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, round_ > 0, test_steps=test_steps)
+    assert int(store.justified_checkpoint.epoch) > 0
+    assert int(store.finalized_checkpoint.epoch) > 0
+    # the head actually descends from the finalized checkpoint
+    head = spec.get_head(store)
+    finalized_slot = spec.compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch)
+    assert spec.get_ancestor(store, head, finalized_slot) == \
+        store.finalized_checkpoint.root
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_get_head_tie_break_is_lexicographic(spec, state):
+    """With equal weights and no boost, get_head picks the
+    lexicographically greatest root (the spec's max() tie-break)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    siblings = []
+    for graffiti in (b"\x31" * 32, b"\x32" * 32):
+        fork_state = state.copy()
+        block = build_empty_block_for_next_slot(spec, fork_state)
+        block.body.graffiti = graffiti
+        siblings.append(state_transition_and_sign_block(spec, fork_state, block))
+
+    # tick PAST the block slot so neither sibling gets the proposer boost
+    time = store.genesis_time + \
+        (int(siblings[0].message.slot) + 1) * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    for signed in siblings:
+        yield from add_block(spec, store, signed, test_steps)
+
+    expected = max(s.message.hash_tree_root() for s in siblings)
+    assert spec.get_head(store) == expected
     yield "steps", test_steps
