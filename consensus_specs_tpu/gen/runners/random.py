@@ -9,8 +9,12 @@ def main(argv=None):
     from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
 
     ensure_vector_sources_importable()
-    mods = {"random": "tests.spec.phase0.random.test_random"}
-    all_mods = {"phase0": mods}
+    all_mods = {
+        "phase0": {"random": "tests.spec.phase0.random.test_random"},
+        "altair": {"random": "tests.spec.altair.random.test_random"},
+        "bellatrix": {"random": "tests.spec.bellatrix.random.test_random"},
+        "capella": {"random": "tests.spec.capella.random.test_random"},
+    }
     run_state_test_generators(runner_name="random", all_mods=all_mods, argv=argv)
 
 

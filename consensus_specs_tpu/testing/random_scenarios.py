@@ -40,6 +40,30 @@ def leak_stage(spec, state, rng):
     assert spec.is_in_inactivity_leak(state)
 
 
+def _random_sync_aggregate(spec, state, rng, block):
+    """Random partial sync-committee participation, properly signed (the
+    vectors generate BLS-on).  Only within the pre-state's current epoch:
+    committees rotate at period-boundary epoch starts, where the
+    pre-state committee would no longer match the processing committee."""
+    from .helpers.sync_committee import (
+        compute_aggregate_sync_committee_signature,
+        compute_committee_indices,
+    )
+
+    if int(spec.compute_epoch_at_slot(block.slot)) != \
+            int(spec.get_current_epoch(state)):
+        return
+    committee = compute_committee_indices(spec, state)
+    bits = [rng.random() < 0.75 for _ in committee]
+    participants = [v for v, b in zip(committee, bits) if b]
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participants,
+            block_root=block.parent_root),
+    )
+
+
 def _random_block(spec, state, rng):
     block = build_empty_block_for_next_slot(spec, state)
     if int(state.slot) > int(spec.SLOTS_PER_EPOCH):
@@ -50,6 +74,8 @@ def _random_block(spec, state, rng):
     if rng.random() < 0.25:
         for ps in get_random_proposer_slashings(spec, state, rng):
             block.body.proposer_slashings.append(ps)
+    if hasattr(spec, "SyncAggregate") and rng.random() < 0.5:
+        _random_sync_aggregate(spec, state, rng, block)
     block.body.graffiti = rng.getrandbits(256).to_bytes(32, "little")
     return block
 

@@ -1,0 +1,23 @@
+"""Altair randomized block scenarios (reference capability:
+test/altair/random/): seeded walks with random attestations, proposer
+slashings, and partially-participating signed sync aggregates."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.random_scenarios import run_random_scenario
+
+
+def _make(seed, with_leak=False, stages=6):
+    @spec_state_test
+    def case(spec, state):
+        yield from run_random_scenario(
+            spec, state, seed=seed, stages=stages, with_leak=with_leak)
+
+    return with_phases(["altair"])(case)
+
+
+test_random_0 = _make(110)
+test_random_1 = _make(211)
+test_random_2 = _make(312)
+test_random_leak_0 = _make(514, with_leak=True, stages=4)

@@ -1,0 +1,22 @@
+"""Bellatrix randomized block scenarios (reference capability:
+test/bellatrix/random/): post-merge states through seeded random walks
+(sync aggregates and operations on top of payload-bearing states)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.random_scenarios import run_random_scenario
+
+
+def _make(seed, with_leak=False, stages=6):
+    @spec_state_test
+    def case(spec, state):
+        yield from run_random_scenario(
+            spec, state, seed=seed, stages=stages, with_leak=with_leak)
+
+    return with_phases(["bellatrix"])(case)
+
+
+test_random_0 = _make(120)
+test_random_1 = _make(221)
+test_random_leak_0 = _make(524, with_leak=True, stages=4)

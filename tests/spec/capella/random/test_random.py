@@ -1,0 +1,22 @@
+"""Capella randomized block scenarios (reference capability:
+test/capella random coverage via the transition suites): withdrawal-era
+states through seeded random walks."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.random_scenarios import run_random_scenario
+
+
+def _make(seed, with_leak=False, stages=6):
+    @spec_state_test
+    def case(spec, state):
+        yield from run_random_scenario(
+            spec, state, seed=seed, stages=stages, with_leak=with_leak)
+
+    return with_phases(["capella"])(case)
+
+
+test_random_0 = _make(130)
+test_random_1 = _make(231)
+test_random_leak_0 = _make(534, with_leak=True, stages=4)
