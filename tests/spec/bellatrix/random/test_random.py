@@ -1,20 +1,11 @@
 """Bellatrix randomized block scenarios (reference capability:
 test/bellatrix/random/): post-merge states through seeded random walks
 (sync aggregates and operations on top of payload-bearing states)."""
-from consensus_specs_tpu.testing.context import (
-    spec_state_test,
-    with_phases,
-)
-from consensus_specs_tpu.testing.random_scenarios import run_random_scenario
+from functools import partial
 
+from consensus_specs_tpu.testing.random_scenarios import make_random_case
 
-def _make(seed, with_leak=False, stages=6):
-    @spec_state_test
-    def case(spec, state):
-        yield from run_random_scenario(
-            spec, state, seed=seed, stages=stages, with_leak=with_leak)
-
-    return with_phases(["bellatrix"])(case)
+_make = partial(make_random_case, "bellatrix")
 
 
 test_random_0 = _make(120)

@@ -2,20 +2,18 @@
 test/phase0/random/test_random.py suite): seeded random walks through
 time skips, empty and operation-bearing blocks, with and without the
 inactivity leak."""
+from functools import partial
+
 from consensus_specs_tpu.testing.context import (
     spec_state_test,
     with_phases,
 )
-from consensus_specs_tpu.testing.random_scenarios import run_random_scenario
+from consensus_specs_tpu.testing.random_scenarios import (
+    make_random_case,
+    run_random_scenario,
+)
 
-
-def _make(seed, with_leak=False, stages=6):
-    @spec_state_test
-    def case(spec, state):
-        yield from run_random_scenario(
-            spec, state, seed=seed, stages=stages, with_leak=with_leak)
-
-    return with_phases(["phase0"])(case)
+_make = partial(make_random_case, "phase0")
 
 
 test_random_0 = _make(100)

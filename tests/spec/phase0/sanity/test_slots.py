@@ -1,5 +1,9 @@
 """Slot-advance sanity tests (reference: test/phase0/sanity/test_slots.py)."""
-from consensus_specs_tpu.testing.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.testing.context import (
+    spec_configured_state_test,
+    spec_state_test,
+    with_all_phases,
+)
 from consensus_specs_tpu.testing.helpers.state import get_state_root
 
 
@@ -79,3 +83,25 @@ def test_historical_accumulator(spec, state):
     yield "post", state
 
     assert len(state.historical_roots) == len(pre_historical_roots) + 1
+
+
+@with_all_phases
+@spec_configured_state_test({"EJECTION_BALANCE": 32_000_000_000})
+def test_epoch_ejections_under_raised_ejection_balance(spec, state):
+    """Config-override vector: with EJECTION_BALANCE raised to the max
+    effective balance, the epoch's registry sweep ejects every active
+    validator — a post state only reproducible by consumers that honor
+    the recorded config.yaml (reference capability: with_config_overrides
+    yielding the effective config into vectors)."""
+    assert int(spec.config.EJECTION_BALANCE) == 32_000_000_000
+    yield "pre", state
+
+    slots = int(spec.SLOTS_PER_EPOCH)
+    yield "slots", "meta", slots
+    spec.process_slots(state, state.slot + slots)
+
+    yield "post", state
+    assert all(
+        int(v.exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+        for v in state.validators
+    )

@@ -115,3 +115,24 @@ def test_rewards_roundtrip(tmp_path):
     # phase0 + altair/bellatrix/capella flag layouts both replayed
     assert stats["pass"] > 20
     assert stats["skip"] == 0
+
+
+def test_config_override_vectors_roundtrip(tmp_path):
+    """Cases generated under config overrides record config.yaml; the
+    consumer must rebuild the spec with it — and the recorded config must
+    be load-bearing (deleting it makes the replay diverge)."""
+    from consensus_specs_tpu.gen.runners.sanity import main as sanity
+    _generate(tmp_path, sanity)
+
+    override_cases = [
+        p.parent for p in Path(tmp_path).rglob("config.yaml")
+    ]
+    assert override_cases, "no config-override vectors generated"
+    stats = consume_tree(tmp_path, preset="minimal", runners={"sanity"})
+    assert stats["pass"] > 0
+
+    # strip the recorded config: the replay must now fail on those cases
+    for case in override_cases:
+        (case / "config.yaml").unlink()
+    with pytest.raises(VectorFailure):
+        consume_tree(tmp_path, preset="minimal", runners={"sanity"})
