@@ -202,3 +202,27 @@ def test_mainnet_containers_fuzz_identical(fork):
         assert bytes(value.hash_tree_root()) == bytes(md_value.hash_tree_root())
         checked += 1
     assert checked > 20
+
+
+@pytest.mark.parametrize("fork", MD_FORKS)
+def test_random_scenario_identical(fork):
+    """A seeded random walk (skips, empty and operation-bearing blocks,
+    random sync aggregates on altair+) replayed block-for-block through
+    the markdown-compiled executable — byte-identical roots throughout."""
+    from consensus_specs_tpu.testing.random_scenarios import (
+        run_random_scenario,
+    )
+
+    spec = get_spec(fork, "minimal")
+    md = get_md_spec(fork, "minimal")
+    state = _genesis(spec)
+    next_epoch(spec, state)
+    md_state = _bridge(state, md.BeaconState)
+
+    parts = list(run_random_scenario(spec, state, seed=424, stages=5))
+    blocks = next(p[1] for p in parts if p[0] == "blocks")
+    for signed in blocks:
+        # full state_transition: slots, signature verification, block,
+        # and the state-root assert — all inside the markdown build
+        md.state_transition(md_state, _bridge(signed, md.SignedBeaconBlock))
+    _assert_same_root(state, md_state, f"{fork}: random scenario")

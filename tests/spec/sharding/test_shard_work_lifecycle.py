@@ -1,0 +1,128 @@
+"""Sharding unittests (reference suite: test/sharding/unittests/): the
+shard-work status lifecycle across epoch processing, and the
+participation-flag batch application the shard attestation path uses."""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.specs.builder import get_spec
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("sharding", "minimal")
+
+
+@pytest.fixture()
+def state(spec):
+    old = bls.bls_active
+    bls.bls_active = False
+    st = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    bls.bls_active = old
+    return st
+
+
+def _pending_buffer_index(spec, state, epoch):
+    return int(spec.compute_start_slot_at_epoch(epoch)) % \
+        int(spec.SHARD_STATE_MEMORY_SLOTS)
+
+
+def _seed_pending_header(spec, state, slot, shard_index, weight,
+                         committed=True):
+    """Install a PENDING shard-work entry carrying one header vote."""
+    buffer_index = int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)
+    commitment = spec.AttestedDataCommitment(
+        commitment=spec.DataCommitment(point=b"\xc0" + b"\x00" * 47,
+                                       samples_count=4) if committed
+        else spec.DataCommitment(),
+        root=b"\x77" * 32,
+        includer_index=1,
+    ) if committed else spec.AttestedDataCommitment()
+    header = spec.PendingShardHeader(
+        attested=commitment,
+        votes=[False] * 4,
+        weight=weight,
+        update_slot=slot,
+    )
+    row = state.shard_buffer[buffer_index]
+    while len(row) <= shard_index:  # genesis rows are empty
+        row.append(spec.ShardWork())
+    work = state.shard_buffer[buffer_index][shard_index]
+    work.status.change(
+        selector=spec.SHARD_WORK_PENDING,
+        value=spec.List[
+            spec.PendingShardHeader,
+            spec.MAX_SHARD_HEADERS_PER_SHARD]([header]),
+    )
+    return buffer_index
+
+
+def test_pending_confirmation_picks_winning_header(spec, state):
+    next_epoch(spec, state)
+    prev = spec.get_previous_epoch(state)
+    slot = spec.compute_start_slot_at_epoch(prev)
+    buffer_index = _seed_pending_header(spec, state, slot, 0, weight=7)
+    spec.process_pending_shard_confirmations(state)
+    work = state.shard_buffer[buffer_index][0]
+    assert int(work.status.selector) == int(spec.SHARD_WORK_CONFIRMED)
+    assert bytes(work.status.value.root) == b"\x77" * 32
+
+
+def test_pending_confirmation_empty_commitment_unconfirmed(spec, state):
+    next_epoch(spec, state)
+    prev = spec.get_previous_epoch(state)
+    slot = spec.compute_start_slot_at_epoch(prev)
+    buffer_index = _seed_pending_header(
+        spec, state, slot, 0, weight=7, committed=False)
+    spec.process_pending_shard_confirmations(state)
+    work = state.shard_buffer[buffer_index][0]
+    assert int(work.status.selector) == int(spec.SHARD_WORK_UNCONFIRMED)
+
+
+def test_pending_confirmation_genesis_noop(spec, state):
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    before = bytes(state.shard_buffer.hash_tree_root())
+    spec.process_pending_shard_confirmations(state)
+    assert bytes(state.shard_buffer.hash_tree_root()) == before
+
+
+def test_reset_pending_shard_work_schedules_next_epoch(spec, state):
+    spec.reset_pending_shard_work(state)
+    next_epoch_num = spec.get_current_epoch(state) + 1
+    buffer_index = _pending_buffer_index(spec, state, next_epoch_num)
+    statuses = [int(w.status.selector)
+                for w in state.shard_buffer[buffer_index]]
+    assert int(spec.SHARD_WORK_PENDING) in statuses
+    # pending entries start with exactly the empty-commitment header
+    pending = [w for w in state.shard_buffer[buffer_index]
+               if int(w.status.selector) == int(spec.SHARD_WORK_PENDING)]
+    for work in pending:
+        headers = work.status.value
+        assert len(headers) == 1
+        assert bytes(headers[0].attested.hash_tree_root()) == \
+            bytes(spec.AttestedDataCommitment().hash_tree_root())
+
+
+def test_batch_apply_participation_flag(spec, state):
+    next_epoch(spec, state)
+    committee = [2, 5, 9, 11]
+    bits = [True, False, True, True]
+    flag = int(spec.TIMELY_SOURCE_FLAG_INDEX)
+    spec.batch_apply_participation_flag(
+        state, spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](bits),
+        spec.get_current_epoch(state), committee, flag)
+    for bit, index in zip(bits, committee):
+        assert bool(spec.has_flag(
+            state.current_epoch_participation[index], flag)) == bit
+    # previous-epoch routing
+    spec.batch_apply_participation_flag(
+        state, spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE]([True]),
+        spec.get_previous_epoch(state), [0], flag)
+    assert spec.has_flag(state.previous_epoch_participation[0], flag)
+    assert not spec.has_flag(state.current_epoch_participation[0], flag)
