@@ -28,19 +28,17 @@ def state(spec):
     return st
 
 
-def _pending_buffer_index(spec, state, epoch):
-    return int(spec.compute_start_slot_at_epoch(epoch)) % \
-        int(spec.SHARD_STATE_MEMORY_SLOTS)
+def _buffer_index(spec, slot):
+    return int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)
 
 
 def _seed_pending_header(spec, state, slot, shard_index, weight,
                          committed=True):
     """Install a PENDING shard-work entry carrying one header vote."""
-    buffer_index = int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)
+    buffer_index = _buffer_index(spec, slot)
     commitment = spec.AttestedDataCommitment(
         commitment=spec.DataCommitment(point=b"\xc0" + b"\x00" * 47,
-                                       samples_count=4) if committed
-        else spec.DataCommitment(),
+                                       samples_count=4),
         root=b"\x77" * 32,
         includer_index=1,
     ) if committed else spec.AttestedDataCommitment()
@@ -95,7 +93,8 @@ def test_pending_confirmation_genesis_noop(spec, state):
 def test_reset_pending_shard_work_schedules_next_epoch(spec, state):
     spec.reset_pending_shard_work(state)
     next_epoch_num = spec.get_current_epoch(state) + 1
-    buffer_index = _pending_buffer_index(spec, state, next_epoch_num)
+    buffer_index = _buffer_index(
+        spec, spec.compute_start_slot_at_epoch(next_epoch_num))
     statuses = [int(w.status.selector)
                 for w in state.shard_buffer[buffer_index]]
     assert int(spec.SHARD_WORK_PENDING) in statuses

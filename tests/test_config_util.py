@@ -1,0 +1,102 @@
+"""Runtime YAML loader tests — including a full parity sweep: loading the
+reference's own presets/configs YAML must reproduce this framework's
+baked-in preset/config data for every shared var."""
+from pathlib import Path
+
+import pytest
+
+from consensus_specs_tpu.config import get_config, get_preset
+from consensus_specs_tpu.config.config_util import (
+    load_config_file,
+    load_preset,
+    load_preset_dir,
+    parse_config_vars,
+)
+
+REFERENCE = Path("/root/reference")
+
+
+def test_parse_config_vars_types(tmp_path):
+    parsed = parse_config_vars({
+        "PRESET_BASE": "minimal",
+        "CONFIG_NAME": "testnet",
+        "SLOTS_PER_EPOCH": "8",
+        "GENESIS_FORK_VERSION": "0x00000001",
+        "SOME_LIST": ["1", "2", "x"],
+    })
+    assert parsed["PRESET_BASE"] == "minimal"
+    assert parsed["SLOTS_PER_EPOCH"] == 8
+    assert parsed["GENESIS_FORK_VERSION"] == b"\x00\x00\x00\x01"
+    assert parsed["SOME_LIST"] == [1, 2, "x"]
+
+
+def test_duplicate_preset_vars_fatal(tmp_path):
+    a = tmp_path / "a.yaml"
+    b = tmp_path / "b.yaml"
+    a.write_text("SLOTS_PER_EPOCH: 8\n")
+    b.write_text("SLOTS_PER_EPOCH: 16\n")
+    with pytest.raises(Exception, match="duplicate"):
+        load_preset([a, b])
+
+
+def test_empty_files_skipped(tmp_path):
+    a = tmp_path / "a.yaml"
+    b = tmp_path / "b.yaml"
+    a.write_text("")
+    b.write_text("MAX_FOO: 4\n")
+    assert load_preset([a, b]) == {"MAX_FOO": 4}
+
+
+@pytest.mark.skipif(not REFERENCE.exists(), reason="reference not vendored")
+@pytest.mark.parametrize("preset_name", ["minimal", "mainnet"])
+def test_reference_preset_yaml_matches_baked_data(preset_name):
+    """Every var in the reference's preset YAMLs must equal the baked-in
+    preset data (the YAMLs are the normative source the reference's
+    compiler consumes)."""
+    loaded = load_preset_dir(REFERENCE / "presets" / preset_name)
+    baked = get_preset(preset_name)
+    # Documented deltas between the reference YAMLs and the baked data:
+    # - MAX_CUSTODY_CHUNK_CHALLENGE_RESP: the YAML's abbreviation of the
+    #   markdown's ..._RESPONSES name (values must still match);
+    # - *_SAMPLES_PER_BLOCK: the YAML lags the markdown's *_PER_BLOB
+    #   rename; minimal values deliberately shrunk here for NTT-test
+    #   tractability (see config/presets.py) on this never-compiled fork
+    renamed = {
+        "MAX_CUSTODY_CHUNK_CHALLENGE_RESP":
+            "MAX_CUSTODY_CHUNK_CHALLENGE_RESPONSES",
+        "MAX_SAMPLES_PER_BLOCK": "MAX_SAMPLES_PER_BLOB",
+        "TARGET_SAMPLES_PER_BLOCK": "TARGET_SAMPLES_PER_BLOB",
+    }
+    value_deviations = {"MAX_SAMPLES_PER_BLOB", "TARGET_SAMPLES_PER_BLOB"} \
+        if preset_name == "minimal" else set()
+    mismatches = {}
+    for key, value in loaded.items():
+        our_key = renamed.get(key, key)
+        if our_key in value_deviations:
+            assert baked[our_key] <= value  # shrunk, never enlarged
+            continue
+        if baked.get(our_key) != value:
+            mismatches[key] = (value, baked.get(our_key))
+    assert mismatches == {}
+
+
+@pytest.mark.skipif(not REFERENCE.exists(), reason="reference not vendored")
+@pytest.mark.parametrize("config_name", ["minimal", "mainnet"])
+def test_reference_config_yaml_matches_baked_data(config_name):
+    loaded = load_config_file(REFERENCE / "configs" / f"{config_name}.yaml")
+    baked = get_config(config_name).to_dict()
+
+    def norm(x):
+        if isinstance(x, (bytes, bytearray)):
+            return bytes(x)
+        try:
+            return int(x)
+        except (TypeError, ValueError):
+            return str(x)
+
+    mismatches = {
+        key: (value, baked.get(key, "<missing>"))
+        for key, value in loaded.items()
+        if norm(value) != norm(baked.get(key, "<missing>"))
+    }
+    assert mismatches == {}
