@@ -7,6 +7,12 @@
 # leaves recover_data / multi-proof internals as "...": this framework
 # implements them (zero-poly erasure recovery; FK20-style multi-proofs
 # are represented by per-sample KZG commitments over the sample domain).
+#
+# das/fork-choice.md's dependency-calculation blocks are NOT transcribed:
+# they reference a pre-snapshot sharding state layout
+# (current_epoch_pending_headers) that no longer exists in
+# sharding/beacon-chain.md, and one comprehension is syntactically
+# invalid — the reference never compiled that document either.
 
 SampleIndex = uint64
 
