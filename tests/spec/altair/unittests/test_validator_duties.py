@@ -1,0 +1,115 @@
+"""Altair validator-duty unittests (reference suite:
+test/altair/unittests/validator/test_validator.py): sync-committee
+assignment, subnet computation, selection proofs, aggregator selection,
+and contribution-and-proof construction."""
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.keys import pubkey_to_privkey
+
+ALTAIR_AND_LATER = ["altair", "bellatrix", "capella"]
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_sync_committee_assignment_matches_membership(spec, state):
+    yield "meta", {"bls_setting": 2}
+    epoch = spec.get_current_epoch(state)
+    members = {bytes(pk) for pk in state.current_sync_committee.pubkeys}
+    for index in range(len(state.validators)):
+        assigned = spec.is_assigned_to_sync_committee(
+            state, epoch, spec.ValidatorIndex(index))
+        assert assigned == (bytes(state.validators[index].pubkey) in members)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_subnets_cover_all_member_positions(spec, state):
+    yield "meta", {"bls_setting": 2}
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    per_subnet = size // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    pubkeys = [bytes(pk) for pk in state.current_sync_committee.pubkeys]
+    for index in range(len(state.validators)):
+        subnets = spec.compute_subnets_for_sync_committee(
+            state, spec.ValidatorIndex(index))
+        expected = {
+            position // per_subnet
+            for position, pk in enumerate(pubkeys)
+            if pk == bytes(state.validators[index].pubkey)
+        }
+        assert {int(s) for s in subnets} == expected
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@always_bls
+def test_selection_proof_and_aggregator_determinism(spec, state):
+    slot = state.slot
+    subcommittee_index = 0
+    member_pubkey = bytes(state.current_sync_committee.pubkeys[0])
+    privkey = pubkey_to_privkey[member_pubkey]
+    proof = spec.get_sync_committee_selection_proof(
+        state, slot, subcommittee_index, privkey)
+    # deterministic: same inputs, same proof, same aggregator decision
+    proof2 = spec.get_sync_committee_selection_proof(
+        state, slot, subcommittee_index, privkey)
+    assert bytes(proof) == bytes(proof2)
+    # aggregator selection is a pure function of the proof bytes; exercise
+    # it and pin the expected minimal-preset behavior (modulo 1: every
+    # member aggregates).  Mainnet's 1-in-8 draw is probabilistic, so no
+    # existence sweep — that would flake (~(7/8)^n) on large presets.
+    decision = spec.is_sync_committee_aggregator(proof)
+    modulo = max(1, int(spec.SYNC_COMMITTEE_SIZE)
+                 // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+                 // int(spec.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE))
+    if modulo == 1:
+        assert decision
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@always_bls
+def test_contribution_and_proof_roundtrip(spec, state):
+    subcommittee_index = 0
+    member_pubkey = bytes(state.current_sync_committee.pubkeys[0])
+    privkey = pubkey_to_privkey[member_pubkey]
+    contribution = spec.SyncCommitteeContribution(
+        slot=state.slot,
+        beacon_block_root=(
+            spec.get_block_root_at_slot(state, state.slot - 1)
+            if int(state.slot) > 0 else spec.Root()),
+        subcommittee_index=subcommittee_index,
+        aggregation_bits=[True] + [False] * (
+            int(spec.SYNC_COMMITTEE_SIZE)
+            // int(spec.SYNC_COMMITTEE_SUBNET_COUNT) - 1),
+        signature=spec.BLSSignature(b"\xc0" + b"\x00" * 95),
+    )
+    # the aggregator is whichever validator owns the committee's first slot
+    member_index = next(
+        i for i, v in enumerate(state.validators)
+        if bytes(v.pubkey) == member_pubkey)
+    aggregator_index = spec.ValidatorIndex(member_index)
+    cap = spec.get_contribution_and_proof(
+        state, aggregator_index, contribution, privkey)
+    assert int(cap.aggregator_index) == member_index
+    assert bytes(cap.contribution.hash_tree_root()) == \
+        bytes(contribution.hash_tree_root())
+    # the embedded selection proof verifies under the aggregator's key
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        spec.compute_epoch_at_slot(contribution.slot))
+    signing_root = spec.compute_signing_root(
+        spec.SyncAggregatorSelectionData(
+            slot=contribution.slot,
+            subcommittee_index=subcommittee_index,
+        ), domain)
+    assert bls.Verify(member_pubkey, signing_root, cap.selection_proof)
+    # and the signature over the envelope verifies
+    sig = spec.get_contribution_and_proof_signature(state, cap, privkey)
+    domain = spec.get_domain(state, spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+                             spec.compute_epoch_at_slot(contribution.slot))
+    signing_root = spec.compute_signing_root(cap, domain)
+    assert bls.Verify(member_pubkey, signing_root, sig)
