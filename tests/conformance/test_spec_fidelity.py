@@ -130,6 +130,10 @@ CHECKS = [
     ("specs/capella/fork.md", "capella.py", [
         "upgrade_to_capella",
     ]),
+    ("specs/capella/validator.md", "capella.py", [
+        "get_expected_withdrawals",
+        "prepare_execution_payload",
+    ]),
     ("specs/altair/sync-protocol.md", "altair.py", [
         "is_finality_update",
         "get_subtree_index",

@@ -6,14 +6,12 @@ from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.testing.context import (
     always_bls,
     spec_state_test,
-    with_phases,
+    with_altair_and_later,
 )
 from consensus_specs_tpu.testing.helpers.keys import pubkey_to_privkey
 
-ALTAIR_AND_LATER = ["altair", "bellatrix", "capella"]
 
-
-@with_phases(ALTAIR_AND_LATER)
+@with_altair_and_later
 @spec_state_test
 def test_sync_committee_assignment_matches_membership(spec, state):
     yield "meta", {"bls_setting": 2}
@@ -25,7 +23,7 @@ def test_sync_committee_assignment_matches_membership(spec, state):
         assert assigned == (bytes(state.validators[index].pubkey) in members)
 
 
-@with_phases(ALTAIR_AND_LATER)
+@with_altair_and_later
 @spec_state_test
 def test_subnets_cover_all_member_positions(spec, state):
     yield "meta", {"bls_setting": 2}
@@ -43,7 +41,7 @@ def test_subnets_cover_all_member_positions(spec, state):
         assert {int(s) for s in subnets} == expected
 
 
-@with_phases(ALTAIR_AND_LATER)
+@with_altair_and_later
 @spec_state_test
 @always_bls
 def test_selection_proof_and_aggregator_determinism(spec, state):
@@ -69,7 +67,7 @@ def test_selection_proof_and_aggregator_determinism(spec, state):
         assert decision
 
 
-@with_phases(ALTAIR_AND_LATER)
+@with_altair_and_later
 @spec_state_test
 @always_bls
 def test_contribution_and_proof_roundtrip(spec, state):
