@@ -127,6 +127,12 @@ CHECKS = [
     ("specs/bellatrix/fork.md", "bellatrix.py", [
         "upgrade_to_bellatrix",
     ]),
+    ("specs/bellatrix/validator.md", "bellatrix.py", [
+        "get_pow_block_at_terminal_total_difficulty",
+        "get_terminal_pow_block",
+        "prepare_execution_payload",
+        "get_execution_payload",
+    ]),
     ("specs/capella/fork.md", "capella.py", [
         "upgrade_to_capella",
     ]),
