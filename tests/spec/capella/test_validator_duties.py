@@ -1,0 +1,58 @@
+"""Capella validator-duty unittests (capella/validator.md): expected
+withdrawals and payload preparation — pure helpers, no vector parts (kept
+out of the operations-reflected modules)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_capella_and_later,
+)
+from consensus_specs_tpu.testing.helpers.execution_payload import (
+    build_state_with_complete_transition,
+)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_get_expected_withdrawals_caps_at_payload_max(spec, state):
+    """capella/validator.md get_expected_withdrawals: the next payload
+    carries at most MAX_WITHDRAWALS_PER_PAYLOAD queue entries, in order."""
+    state = build_state_with_complete_transition(spec, state)
+    for index in range(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) + 2):
+        state.withdrawals_queue.append(spec.Withdrawal(
+            index=index, address=b"\x42" * 20, amount=1000 + index))
+    yield "meta", {"bls_setting": 2}
+    expected = spec.get_expected_withdrawals(state)
+    assert len(expected) == int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)
+    assert [int(w.index) for w in expected] == \
+        list(range(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)))
+
+
+@with_capella_and_later
+@spec_state_test
+def test_prepare_execution_payload_includes_withdrawals(spec, state):
+    """capella/validator.md prepare_execution_payload: post-merge, the
+    payload attributes handed to the engine carry the expected
+    withdrawals."""
+    state = build_state_with_complete_transition(spec, state)
+    state.withdrawals_queue.append(spec.Withdrawal(
+        index=0, address=b"\x42" * 20, amount=777))
+    yield "meta", {"bls_setting": 2}
+
+    captured = {}
+
+    class RecordingEngine(spec.NoopExecutionEngine):
+        def notify_forkchoice_updated(self, head_block_hash, safe_block_hash,
+                                      finalized_block_hash, payload_attributes):
+            captured["attrs"] = payload_attributes
+            captured["head"] = head_block_hash
+            return None
+
+    spec.prepare_execution_payload(
+        state, {}, spec.Hash32(), spec.Hash32(),
+        spec.ExecutionAddress(b"\x11" * 20), RecordingEngine())
+
+    attrs = captured["attrs"]
+    assert bytes(captured["head"]) == \
+        bytes(state.latest_execution_payload_header.block_hash)
+    assert [int(w.amount) for w in attrs.withdrawals] == [777]
+    assert int(attrs.timestamp) == \
+        int(spec.compute_timestamp_at_slot(state, state.slot))
