@@ -352,6 +352,92 @@ def run_transition_case(case_dir: Path, meta, preset: str,
     _check_post(post_spec, state, case_dir, "transition")
 
 
+def run_fork_choice_case(spec, case_dir: Path, meta) -> None:
+    """Step-scripted fork-choice replay (reference format:
+    tests/formats/fork_choice/): rebuild the store from the anchor, apply
+    each tick/block/attestation/attester_slashing step (a block step
+    implies its attestations and slashings, matching the generator), and
+    compare every ``checks`` snapshot."""
+    anchor_state = _load_ssz(case_dir, "anchor_state", spec.BeaconState)
+    anchor_block = _load_ssz(case_dir, "anchor_block", spec.BeaconBlock)
+    if anchor_state is None or anchor_block is None:
+        raise VectorFailure("fork_choice: missing anchor parts")
+    store = spec.get_forkchoice_store(anchor_state, anchor_block)
+    steps = _yaml.safe_load((case_dir / "steps.yaml").read_text()) or []
+
+    for step in steps:
+        if "tick" in step:
+            spec.on_tick(store, int(step["tick"]))
+        elif "block" in step:
+            signed = _load_ssz(case_dir, step["block"], spec.SignedBeaconBlock)
+
+            if step.get("valid", True):
+                spec.on_block(store, signed)
+                for attestation in signed.message.body.attestations:
+                    spec.on_attestation(store, attestation, is_from_block=True)
+                for slashing in signed.message.body.attester_slashings:
+                    spec.on_attester_slashing(store, slashing)
+            else:
+                # the generator records valid:false when on_block itself
+                # rejects; implied attestations never run in that case
+                _expect_failure(lambda: spec.on_block(store, signed))
+        elif "attestation" in step:
+            attestation = _load_ssz(case_dir, step["attestation"],
+                                    spec.Attestation)
+            if step.get("valid", True):
+                spec.on_attestation(store, attestation, is_from_block=False)
+            else:
+                _expect_failure(lambda: spec.on_attestation(
+                    store, attestation, is_from_block=False))
+        elif "attester_slashing" in step:
+            slashing = _load_ssz(case_dir, step["attester_slashing"],
+                                 spec.AttesterSlashing)
+            if step.get("valid", True):
+                spec.on_attester_slashing(store, slashing)
+            else:
+                _expect_failure(lambda: spec.on_attester_slashing(
+                    store, slashing))
+        elif "checks" in step:
+            _run_store_checks(spec, store, step["checks"])
+        else:
+            raise VectorFailure(f"fork_choice: unknown step {step!r}")
+
+
+def _run_store_checks(spec, store, checks) -> None:
+    def _hex(b):
+        return "0x" + bytes(b).hex()
+
+    def fail(name, got, want):
+        raise VectorFailure(f"fork_choice check {name}: {got!r} != {want!r}")
+
+    for name, want in checks.items():
+        if name == "time":
+            got = int(store.time)
+            if got != int(want):
+                fail(name, got, want)
+        elif name == "head":
+            head = spec.get_head(store)
+            got = {"slot": int(store.blocks[head].slot), "root": _hex(head)}
+            if got != want:
+                fail(name, got, want)
+        elif name == "proposer_boost_root":
+            got = _hex(store.proposer_boost_root)
+            if got != want:
+                fail(name, got, want)
+        elif name == "genesis_time":
+            got = int(store.genesis_time)
+            if got != int(want):
+                fail(name, got, want)
+        elif name.endswith("_checkpoint"):
+            cp = getattr(store, name)
+            got = {"epoch": int(cp.epoch), "root": _hex(cp.root)}
+            if got != want:
+                fail(name, got, want)
+        else:
+            # an unverified check must never pass vacuously
+            raise VectorFailure(f"fork_choice: unknown check {name!r}")
+
+
 def run_fork_case(fork: str, case_dir: Path, meta, preset: str,
                   config=None) -> None:
     pre_spec = _build(_FORK_PARENT[fork], preset, config)
@@ -421,6 +507,8 @@ def run_case(preset: str, fork: str, runner: str, handler: str,
             run_fork_case(fork, case_dir, meta, preset, override_config)
         elif runner == "transition":
             run_transition_case(case_dir, meta, preset, override_config)
+        elif runner == "fork_choice":
+            run_fork_choice_case(spec, case_dir, meta)
         else:
             return "skip"
     finally:

@@ -1,0 +1,26 @@
+"""Fork-choice vector generator (reference capability:
+tests/generators/fork_choice/main.py): step-scripted tick/block/
+attestation/attester_slashing scenarios with store checks, generated
+from the fork-choice test module across forks."""
+from __future__ import annotations
+
+from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+
+
+def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
+    mods = {"get_head": "tests.spec.phase0.test_fork_choice"}
+    all_mods = {
+        "phase0": mods,
+        "altair": mods,
+        "bellatrix": mods,
+        "capella": mods,
+    }
+    run_state_test_generators(
+        runner_name="fork_choice", all_mods=all_mods, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,6 @@ from consensus_specs_tpu.testing.helpers.fork_choice import (
     add_block,
     apply_next_epoch_with_attestations,
     get_anchor_root,
-    get_genesis_forkchoice_store,
     get_genesis_forkchoice_store_and_block,
     on_tick_and_append_step,
     tick_and_add_block,
@@ -36,9 +35,14 @@ from consensus_specs_tpu.testing.helpers.state import (
 @spec_state_test
 def test_genesis_head(spec, state):
     test_steps = []
-    store = get_genesis_forkchoice_store(spec, state)
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
     anchor_root = get_anchor_root(spec, state)
     assert spec.get_head(store) == anchor_root
+    test_steps.append({"checks": {
+        "head": {"slot": int(state.slot), "root": "0x" + bytes(anchor_root).hex()},
+    }})
     yield "steps", "data", test_steps
 
 
@@ -68,8 +72,10 @@ def test_on_block_checks(spec, state):
 @with_all_phases
 @spec_state_test
 def test_on_attestation_updates_latest_messages(spec, state):
-    store = get_genesis_forkchoice_store(spec, state)
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
     test_steps = []
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
 
     # advance a slot with a block, then attest to it
     block = build_empty_block_for_next_slot(spec, state)
@@ -83,6 +89,13 @@ def test_on_attestation_updates_latest_messages(spec, state):
     for i in attesting:
         assert i in store.latest_messages
         assert store.latest_messages[i].root == attestation.data.beacon_block_root
+    # trailing checks pin the attestation's head effect for vector replay
+    head = spec.get_head(store)
+    test_steps.append({"checks": {
+        "head": {"slot": int(store.blocks[head].slot),
+                 "root": "0x" + bytes(head).hex()},
+        "time": int(store.time),
+    }})
     yield "steps", "data", test_steps
 
 

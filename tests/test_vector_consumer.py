@@ -136,3 +136,31 @@ def test_config_override_vectors_roundtrip(tmp_path):
         (case / "config.yaml").unlink()
     with pytest.raises(VectorFailure):
         consume_tree(tmp_path, preset="minimal", runners={"sanity"})
+
+
+def test_fork_choice_roundtrip(tmp_path):
+    """Step-scripted fork-choice vectors: anchors, tick/block/attestation/
+    attester_slashing steps and store checks replayed by the consumer."""
+    from consensus_specs_tpu.gen.runners.fork_choice import main as fork_choice
+    _generate(tmp_path, fork_choice)
+    stats = consume_tree(tmp_path, preset="minimal", runners={"fork_choice"})
+    assert stats["pass"] >= 10
+    assert stats["skip"] == 0
+
+    # corrupt a recorded head check: the replay must diverge
+    import yaml
+    corrupted = False
+    for steps_file in Path(tmp_path).rglob("steps.yaml"):
+        steps = yaml.safe_load(steps_file.read_text())
+        for step in steps:
+            if "checks" in step and "head" in step["checks"]:
+                step["checks"]["head"]["slot"] = \
+                    int(step["checks"]["head"]["slot"]) + 1
+                steps_file.write_text(yaml.safe_dump(steps))
+                corrupted = True
+                break
+        if corrupted:
+            break
+    assert corrupted
+    with pytest.raises(VectorFailure):
+        consume_tree(tmp_path, preset="minimal", runners={"fork_choice"})
