@@ -1,45 +1,26 @@
-"""Altair fork-upgrade test runner (reference: test/helpers/altair/fork.py)."""
+"""Altair fork-upgrade runner (parity capability: reference
+``test/helpers/altair/fork.py``), parameterizing the shared driver."""
+from ..fork_upgrade import base_stable_fields, run_upgrade_test
 
 ALTAIR_FORK_TEST_META_TAGS = {
     "fork": "altair",
 }
 
 
+def _altair_extras(post_spec, pre_state, post_state):
+    # The upgrade replaces pending attestations with participation flags,
+    # so the fork struct is the only field asserted as *changed*.
+    assert pre_state.fork != post_state.fork
+
+
 def run_fork_test(post_spec, pre_state):
-    # Clean up state to be more realistic
+    # Drop pending current-epoch attestations first so the pre-state looks
+    # like a realistic mid-epoch snapshot.
     pre_state.current_epoch_attestations = []
-
-    yield "pre", pre_state
-
-    post_state = post_spec.upgrade_to_altair(pre_state)
-
-    # Stable fields
-    stable_fields = [
-        "genesis_time", "genesis_validators_root", "slot",
-        # History
-        "latest_block_header", "block_roots", "state_roots", "historical_roots",
-        # Eth1
-        "eth1_data", "eth1_data_votes", "eth1_deposit_index",
-        # Registry
-        "validators", "balances",
-        # Randomness
-        "randao_mixes",
-        # Slashings
-        "slashings",
-        # Finality
-        "justification_bits", "previous_justified_checkpoint",
-        "current_justified_checkpoint", "finalized_checkpoint",
-    ]
-    for field in stable_fields:
-        assert getattr(pre_state, field) == getattr(post_state, field)
-
-    # Modified fields
-    modified_fields = ["fork"]
-    for field in modified_fields:
-        assert getattr(pre_state, field) != getattr(post_state, field)
-
-    assert pre_state.fork.current_version == post_state.fork.previous_version
-    assert post_state.fork.current_version == post_spec.config.ALTAIR_FORK_VERSION
-    assert post_state.fork.epoch == post_spec.get_current_epoch(post_state)
-
-    yield "post", post_state
+    yield from run_upgrade_test(
+        post_spec, pre_state,
+        upgrade_fn=post_spec.upgrade_to_altair,
+        version_var="ALTAIR_FORK_VERSION",
+        stable_fields=base_stable_fields(with_altair=False),
+        extra_checks=_altair_extras,
+    )

@@ -1,37 +1,22 @@
-"""Bellatrix fork-upgrade test runner (reference capability:
-test/helpers/bellatrix/fork.py)."""
+"""Bellatrix fork-upgrade runner (parity capability: reference
+``test/helpers/bellatrix/fork.py``), parameterizing the shared driver."""
+from ..fork_upgrade import base_stable_fields, run_upgrade_test
 
 BELLATRIX_FORK_TEST_META_TAGS = {
     "fork": "bellatrix",
 }
 
 
-def run_fork_test(post_spec, pre_state):
-    yield "pre", pre_state
-
-    post_state = post_spec.upgrade_to_bellatrix(pre_state)
-
-    # Stable fields
-    stable_fields = [
-        "genesis_time", "genesis_validators_root", "slot",
-        "latest_block_header", "block_roots", "state_roots", "historical_roots",
-        "eth1_data", "eth1_data_votes", "eth1_deposit_index",
-        "validators", "balances",
-        "randao_mixes",
-        "slashings",
-        "previous_epoch_participation", "current_epoch_participation",
-        "justification_bits", "previous_justified_checkpoint",
-        "current_justified_checkpoint", "finalized_checkpoint",
-        "inactivity_scores",
-        "current_sync_committee", "next_sync_committee",
-    ]
-    for field in stable_fields:
-        assert getattr(pre_state, field) == getattr(post_state, field), field
-
-    assert pre_state.fork.current_version == post_state.fork.previous_version
-    assert post_state.fork.current_version == post_spec.config.BELLATRIX_FORK_VERSION
-    assert post_state.fork.epoch == post_spec.get_current_epoch(post_state)
-    # the payload header starts empty
+def _bellatrix_extras(post_spec, pre_state, post_state):
+    # Pre-merge: the payload header slot must start at its type's defaults.
     assert post_state.latest_execution_payload_header == post_spec.ExecutionPayloadHeader()
 
-    yield "post", post_state
+
+def run_fork_test(post_spec, pre_state):
+    yield from run_upgrade_test(
+        post_spec, pre_state,
+        upgrade_fn=post_spec.upgrade_to_bellatrix,
+        version_var="BELLATRIX_FORK_VERSION",
+        stable_fields=base_stable_fields(with_altair=True),
+        extra_checks=_bellatrix_extras,
+    )

@@ -1,12 +1,10 @@
-"""Block-header signing helper (reference: test/helpers/block_header.py)."""
+"""Header signing (parity surface: reference ``test/helpers/block_header.py``)."""
 from consensus_specs_tpu.crypto import bls
 
 
 def sign_block_header(spec, state, header, privkey):
-    domain = spec.get_domain(
-        state=state,
-        domain_type=spec.DOMAIN_BEACON_PROPOSER,
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER)
+    return spec.SignedBeaconBlockHeader(
+        message=header,
+        signature=bls.Sign(privkey, spec.compute_signing_root(header, domain)),
     )
-    signing_root = spec.compute_signing_root(header, domain)
-    signature = bls.Sign(privkey, signing_root)
-    return spec.SignedBeaconBlockHeader(message=header, signature=signature)

@@ -1,5 +1,36 @@
-"""Partial block-processing runner (reference: test/helpers/block_processing.py)."""
+"""Partial block-processing runner (parity capability: reference
+``test/helpers/block_processing.py``).
+
+The sub-transition table is data, not a dict of lambdas: each row names the
+spec function and how to feed it from a block, and ``run_block_processing_to``
+walks rows in canonical order until it reaches the requested one.
+"""
 from __future__ import annotations
+
+# (spec function name, block accessor, mode)
+#   mode "block":   fn(state, block)
+#   mode "single":  fn(state, accessor(block))
+#   mode "each":    fn(state, item) for item in accessor(block)
+#   mode "payload": fn(state, accessor(block), spec.EXECUTION_ENGINE)
+_SUB_TRANSITIONS = (
+    # phase0
+    ("process_block_header", None, "block"),
+    ("process_randao", lambda b: b.body, "single"),
+    ("process_eth1_data", lambda b: b.body, "single"),
+    ("process_proposer_slashing", lambda b: b.body.proposer_slashings, "each"),
+    ("process_attester_slashing", lambda b: b.body.attester_slashings, "each"),
+    ("process_shard_header", lambda b: b.body.shard_headers, "each"),
+    ("process_attestation", lambda b: b.body.attestations, "each"),
+    ("process_deposit", lambda b: b.body.deposits, "each"),
+    ("process_voluntary_exit", lambda b: b.body.voluntary_exits, "each"),
+    # altair
+    ("process_sync_aggregate", lambda b: b.body.sync_aggregate, "single"),
+    # bellatrix
+    ("process_execution_payload", lambda b: b.body.execution_payload, "payload"),
+    # capella
+    ("process_withdrawals", lambda b: b.body.execution_payload, "single"),
+    ("process_bls_to_execution_change", lambda b: b.body.bls_to_execution_changes, "each"),
+)
 
 
 def for_ops(state, operations, fn) -> None:
@@ -7,54 +38,32 @@ def for_ops(state, operations, fn) -> None:
         fn(state, operation)
 
 
+def _make_call(spec, name, accessor, mode):
+    fn = getattr(spec, name)
+    if mode == "block":
+        return fn
+    if mode == "single":
+        return lambda state, block: fn(state, accessor(block))
+    if mode == "payload":
+        return lambda state, block: fn(state, accessor(block), spec.EXECUTION_ENGINE)
+    return lambda state, block: for_ops(state, accessor(block), fn)
+
+
 def get_process_calls(spec):
     return {
-        # PHASE0
-        "process_block_header":
-            lambda state, block: spec.process_block_header(state, block),
-        "process_randao":
-            lambda state, block: spec.process_randao(state, block.body),
-        "process_eth1_data":
-            lambda state, block: spec.process_eth1_data(state, block.body),
-        "process_proposer_slashing":
-            lambda state, block: for_ops(state, block.body.proposer_slashings, spec.process_proposer_slashing),
-        "process_attester_slashing":
-            lambda state, block: for_ops(state, block.body.attester_slashings, spec.process_attester_slashing),
-        "process_shard_header":
-            lambda state, block: for_ops(state, block.body.shard_headers, spec.process_shard_header),
-        "process_attestation":
-            lambda state, block: for_ops(state, block.body.attestations, spec.process_attestation),
-        "process_deposit":
-            lambda state, block: for_ops(state, block.body.deposits, spec.process_deposit),
-        "process_voluntary_exit":
-            lambda state, block: for_ops(state, block.body.voluntary_exits, spec.process_voluntary_exit),
-        # Altair
-        "process_sync_aggregate":
-            lambda state, block: spec.process_sync_aggregate(state, block.body.sync_aggregate),
-        # Bellatrix
-        "process_execution_payload":
-            lambda state, block: spec.process_execution_payload(
-                state, block.body.execution_payload, spec.EXECUTION_ENGINE),
-        # Capella
-        "process_withdrawals":
-            lambda state, block: spec.process_withdrawals(state, block.body.execution_payload),
-        "process_bls_to_execution_change":
-            lambda state, block: for_ops(
-                state, block.body.bls_to_execution_changes, spec.process_bls_to_execution_change),
+        name: _make_call(spec, name, accessor, mode)
+        for name, accessor, mode in _SUB_TRANSITIONS
+        if hasattr(spec, name)
     }
 
 
 def run_block_processing_to(spec, state, block, process_name: str):
-    """
-    Processes up to, but not including, the sub-transition ``process_name``.
-    Returns a Callable[[state, block], None] for that remaining transition.
-    """
+    """Run every sub-transition before ``process_name`` (in canonical order)
+    and return the ``process_name`` step itself as a callable."""
     if state.slot < block.slot:
         spec.process_slots(state, block.slot)
-
-    for name, call in get_process_calls(spec).items():
+    for name, accessor, mode in _SUB_TRANSITIONS:
         if name == process_name:
-            return call
-        # only run when present; later forks add more block processing
-        if hasattr(spec, name):
-            call(state, block)
+            return _make_call(spec, name, accessor, mode)
+        if hasattr(spec, name):  # later forks add steps earlier forks lack
+            _make_call(spec, name, accessor, mode)(state, block)

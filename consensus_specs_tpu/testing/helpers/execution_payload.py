@@ -1,82 +1,73 @@
-"""Execution payload helpers, bellatrix+ (reference:
-test/helpers/execution_payload.py)."""
+"""Execution-payload construction for bellatrix+ test scenarios.
+
+Parity surface: reference ``eth2spec/test/helpers/execution_payload.py``.
+Rebuilt table-driven: the payload→header projection walks one mirrored-field
+tuple instead of restating every field as a literal kwarg, so capella's
+withdrawals only add a root entry rather than a second copy of the table.
+"""
 from __future__ import annotations
 
 from .constants import FORKS_BEFORE_CAPELLA
 
+# Fields an ExecutionPayloadHeader carries verbatim from the payload; the
+# list-typed fields (transactions, withdrawals) are summarized as SSZ roots.
+_MIRRORED = (
+    "parent_hash", "fee_recipient", "state_root", "receipts_root",
+    "logs_bloom", "prev_randao", "block_number", "gas_limit", "gas_used",
+    "timestamp", "extra_data", "base_fee_per_gas", "block_hash",
+)
+
+
+def has_withdrawals(spec) -> bool:
+    return spec.fork not in FORKS_BEFORE_CAPELLA
+
 
 def build_empty_execution_payload(spec, state, randao_mix=None):
-    """
-    Assuming a pre-state of the same slot, build a valid ExecutionPayload without any transactions.
-    """
-    latest = state.latest_execution_payload_header
-    timestamp = spec.compute_timestamp_at_slot(state, state.slot)
-    empty_txs = spec.List[spec.Transaction, spec.MAX_TRANSACTIONS_PER_PAYLOAD]()
-
+    """A zero-transaction payload consistent with ``state`` at its own slot."""
+    prev = state.latest_execution_payload_header
     if randao_mix is None:
         randao_mix = spec.get_randao_mix(state, spec.get_current_epoch(state))
-
     payload = spec.ExecutionPayload(
-        parent_hash=latest.block_hash,
-        fee_recipient=spec.ExecutionAddress(),
-        state_root=latest.state_root,  # no changes to the state
-        receipts_root=b"no receipts here" + b"\x00" * 16,
-        logs_bloom=spec.ByteVector[spec.BYTES_PER_LOGS_BLOOM](),
-        block_number=latest.block_number + 1,
+        parent_hash=prev.block_hash,
+        state_root=prev.state_root,
+        receipts_root=b"\xd9" * 32,
+        block_number=prev.block_number + 1,
         prev_randao=randao_mix,
-        gas_limit=latest.gas_limit,  # retain same limit
-        gas_used=0,  # empty block, 0 gas
-        timestamp=timestamp,
-        extra_data=spec.ByteList[spec.MAX_EXTRA_DATA_BYTES](),
-        base_fee_per_gas=latest.base_fee_per_gas,  # retain same base_fee
-        block_hash=spec.Hash32(),
-        transactions=empty_txs,
+        gas_limit=prev.gas_limit,
+        timestamp=spec.compute_timestamp_at_slot(state, state.slot),
+        base_fee_per_gas=prev.base_fee_per_gas,
     )
-    if spec.fork not in FORKS_BEFORE_CAPELLA:
-        num_withdrawals = min(spec.MAX_WITHDRAWALS_PER_PAYLOAD, len(state.withdrawals_queue))
-        payload.withdrawals = state.withdrawals_queue[:num_withdrawals]
-
-    # stand-in for the real RLP block hash (needs RLP + keccak256)
-    payload.block_hash = spec.Hash32(spec.hash(payload.hash_tree_root() + b"FAKE RLP HASH"))
-
+    # Every other field keeps its SSZ zero default: no fee recipient, zero
+    # gas used, empty logs bloom / extra data / transaction list.
+    if has_withdrawals(spec):
+        take = min(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD), len(state.withdrawals_queue))
+        payload.withdrawals = state.withdrawals_queue[:take]
+    # No EL is attached, so no RLP/keccak block hash exists; substitute a
+    # deterministic digest of the SSZ root so parent/child links still chain.
+    payload.block_hash = spec.Hash32(spec.hash(payload.hash_tree_root() + b"stub-el-block-hash"))
     return payload
 
 
 def get_execution_payload_header(spec, execution_payload):
-    payload_header = spec.ExecutionPayloadHeader(
-        parent_hash=execution_payload.parent_hash,
-        fee_recipient=execution_payload.fee_recipient,
-        state_root=execution_payload.state_root,
-        receipts_root=execution_payload.receipts_root,
-        logs_bloom=execution_payload.logs_bloom,
-        prev_randao=execution_payload.prev_randao,
-        block_number=execution_payload.block_number,
-        gas_limit=execution_payload.gas_limit,
-        gas_used=execution_payload.gas_used,
-        timestamp=execution_payload.timestamp,
-        extra_data=execution_payload.extra_data,
-        base_fee_per_gas=execution_payload.base_fee_per_gas,
-        block_hash=execution_payload.block_hash,
-        transactions_root=spec.hash_tree_root(execution_payload.transactions),
-    )
-    if spec.fork not in FORKS_BEFORE_CAPELLA:
-        payload_header.withdrawals_root = spec.hash_tree_root(execution_payload.withdrawals)
-    return payload_header
+    """Project ``execution_payload`` onto its header container."""
+    fields = {name: getattr(execution_payload, name) for name in _MIRRORED}
+    fields["transactions_root"] = spec.hash_tree_root(execution_payload.transactions)
+    if has_withdrawals(spec):
+        fields["withdrawals_root"] = spec.hash_tree_root(execution_payload.withdrawals)
+    return spec.ExecutionPayloadHeader(**fields)
+
+
+def build_state_with_execution_payload_header(spec, state, execution_payload_header):
+    post = state.copy()
+    post.latest_execution_payload_header = execution_payload_header
+    return post
 
 
 def build_state_with_incomplete_transition(spec, state):
+    # Pre-merge: the header slot of the state is still all zero defaults.
     return build_state_with_execution_payload_header(spec, state, spec.ExecutionPayloadHeader())
 
 
 def build_state_with_complete_transition(spec, state):
-    pre_state_payload = build_empty_execution_payload(spec, state)
-    payload_header = get_execution_payload_header(spec, pre_state_payload)
-
-    return build_state_with_execution_payload_header(spec, state, payload_header)
-
-
-def build_state_with_execution_payload_header(spec, state, execution_payload_header):
-    pre_state = state.copy()
-    pre_state.latest_execution_payload_header = execution_payload_header
-
-    return pre_state
+    header = get_execution_payload_header(spec, build_empty_execution_payload(spec, state))
+    return build_state_with_execution_payload_header(spec, state, header)

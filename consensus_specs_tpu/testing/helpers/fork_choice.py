@@ -1,280 +1,245 @@
-"""Fork-choice test drivers (reference: test/helpers/fork_choice.py).
+"""Step-scripted fork-choice drivers.
 
-Fork-choice vectors are *step-scripted*: every tick/block/attestation
-becomes a recorded step plus an ssz part, so clients can replay them
-(format: tests/formats/fork_choice/README.md).
+Parity surface: reference ``eth2spec/test/helpers/fork_choice.py``; vector
+format contract: ``docs/formats/fork_choice`` (tick/block/attestation steps
+plus ssz parts, replayable by clients).
+
+Shape differences from the reference: all "run handler, expect abort when
+invalid" plumbing funnels through ``_expecting_validity``; part naming goes
+through one ``_part_name`` table; the epoch/slots store-appliers share one
+implementation.
 """
 from __future__ import annotations
 
 from ..exceptions import BlockNotFoundException
-from .attestations import next_epoch_with_attestations, next_slots_with_attestations
+from .attestations import next_slots_with_attestations
 
 
 def _hex(b) -> str:
     return "0x" + bytes(b).hex()
 
 
+def _part_name(kind: str, obj, field=None) -> str:
+    tag = _hex(obj.block_hash if field == "block_hash" else obj.hash_tree_root())
+    return f"{kind}_{tag}"
+
+
+def get_block_file_name(block):
+    return _part_name("block", block)
+
+
+def get_attestation_file_name(attestation):
+    return _part_name("attestation", attestation)
+
+
+def get_attester_slashing_file_name(attester_slashing):
+    return _part_name("attester_slashing", attester_slashing)
+
+
+def get_pow_block_file_name(pow_block):
+    return _part_name("pow_block", pow_block, field="block_hash")
+
+
+def _expecting_validity(fn, valid, tolerated=(AssertionError,)):
+    """Run ``fn``; when ``valid`` is False it MUST abort with ``tolerated``.
+
+    Returns True if fn completed (only possible when valid)."""
+    if valid:
+        fn()
+        return True
+    try:
+        fn()
+    except tolerated:
+        return False
+    raise AssertionError("handler accepted an input the scenario declared invalid")
+
+
+def _slot_wall_time(spec, state, slot) -> int:
+    return int(state.genesis_time) + int(slot) * int(spec.config.SECONDS_PER_SLOT)
+
+
+# -- store construction ------------------------------------------------------
+
 def get_anchor_root(spec, state):
-    anchor_block_header = state.latest_block_header.copy()
-    if anchor_block_header.state_root == spec.Bytes32():
-        anchor_block_header.state_root = spec.hash_tree_root(state)
-    return spec.hash_tree_root(anchor_block_header)
-
-
-def add_block_to_store(spec, store, signed_block):
-    pre_state = store.block_states[signed_block.message.parent_root]
-    block_time = pre_state.genesis_time + signed_block.message.slot * spec.config.SECONDS_PER_SLOT
-
-    if store.time < block_time:
-        spec.on_tick(store, block_time)
-
-    spec.on_block(store, signed_block)
-
-
-def tick_and_add_block(spec, store, signed_block, test_steps, valid=True,
-                       merge_block=False, block_not_found=False):
-    pre_state = store.block_states[signed_block.message.parent_root]
-    block_time = pre_state.genesis_time + signed_block.message.slot * spec.config.SECONDS_PER_SLOT
-    if merge_block:
-        assert spec.is_merge_transition_block(pre_state, signed_block.message.body)
-
-    if store.time < block_time:
-        on_tick_and_append_step(spec, store, block_time, test_steps)
-
-    post_state = yield from add_block(
-        spec, store, signed_block, test_steps,
-        valid=valid,
-        block_not_found=block_not_found,
-    )
-
-    return post_state
-
-
-def add_attestation(spec, store, attestation, test_steps, is_from_block=False):
-    spec.on_attestation(store, attestation, is_from_block=is_from_block)
-    yield get_attestation_file_name(attestation), attestation
-    test_steps.append({"attestation": get_attestation_file_name(attestation)})
-
-
-def tick_and_run_on_attestation(spec, store, attestation, test_steps, is_from_block=False):
-    parent_block = store.blocks[attestation.data.beacon_block_root]
-    pre_state = store.block_states[spec.hash_tree_root(parent_block)]
-    block_time = pre_state.genesis_time + parent_block.slot * spec.config.SECONDS_PER_SLOT
-    next_epoch_time = block_time + spec.SLOTS_PER_EPOCH * spec.config.SECONDS_PER_SLOT
-
-    if store.time < next_epoch_time:
-        spec.on_tick(store, next_epoch_time)
-        test_steps.append({"tick": int(next_epoch_time)})
-
-    yield from add_attestation(spec, store, attestation, test_steps, is_from_block)
-
-
-def run_on_attestation(spec, store, attestation, is_from_block=False, valid=True):
-    if not valid:
-        try:
-            spec.on_attestation(store, attestation, is_from_block=is_from_block)
-        except AssertionError:
-            return
-        else:
-            assert False
-
-    spec.on_attestation(store, attestation, is_from_block=is_from_block)
-
-
-def get_genesis_forkchoice_store(spec, genesis_state):
-    store, _ = get_genesis_forkchoice_store_and_block(spec, genesis_state)
-    return store
+    header = state.latest_block_header.copy()
+    if header.state_root == spec.Bytes32():
+        header.state_root = spec.hash_tree_root(state)
+    return spec.hash_tree_root(header)
 
 
 def get_genesis_forkchoice_store_and_block(spec, genesis_state):
     assert genesis_state.slot == spec.GENESIS_SLOT
-    genesis_block = spec.BeaconBlock(state_root=genesis_state.hash_tree_root())
-    return spec.get_forkchoice_store(genesis_state, genesis_block), genesis_block
+    anchor = spec.BeaconBlock(state_root=genesis_state.hash_tree_root())
+    return spec.get_forkchoice_store(genesis_state, anchor), anchor
 
 
-def get_block_file_name(block):
-    return f"block_{_hex(block.hash_tree_root())}"
+def get_genesis_forkchoice_store(spec, genesis_state):
+    return get_genesis_forkchoice_store_and_block(spec, genesis_state)[0]
 
 
-def get_attestation_file_name(attestation):
-    return f"attestation_{_hex(attestation.hash_tree_root())}"
+# -- raw handlers (no step recording) ----------------------------------------
+
+def run_on_block(spec, store, signed_block, valid=True):
+    done = _expecting_validity(lambda: spec.on_block(store, signed_block), valid)
+    if done:
+        assert store.blocks[signed_block.message.hash_tree_root()] == signed_block.message
 
 
-def get_attester_slashing_file_name(attester_slashing):
-    return f"attester_slashing_{_hex(attester_slashing.hash_tree_root())}"
+def run_on_attestation(spec, store, attestation, is_from_block=False, valid=True):
+    _expecting_validity(
+        lambda: spec.on_attestation(store, attestation, is_from_block=is_from_block), valid)
 
+
+def run_on_attester_slashing(spec, store, attester_slashing, valid=True):
+    _expecting_validity(
+        lambda: spec.on_attester_slashing(store, attester_slashing), valid)
+
+
+def add_block_to_store(spec, store, signed_block):
+    parent_state = store.block_states[signed_block.message.parent_root]
+    arrival = _slot_wall_time(spec, parent_state, signed_block.message.slot)
+    if store.time < arrival:
+        spec.on_tick(store, arrival)
+    spec.on_block(store, signed_block)
+
+
+# -- step-recording drivers (yield ssz parts, append step dicts) -------------
 
 def on_tick_and_append_step(spec, store, time, test_steps):
     spec.on_tick(store, time)
     test_steps.append({"tick": int(time)})
 
 
-def run_on_block(spec, store, signed_block, valid=True):
-    if not valid:
-        try:
-            spec.on_block(store, signed_block)
-        except AssertionError:
-            return
-        else:
-            assert False
-
-    spec.on_block(store, signed_block)
-    assert store.blocks[signed_block.message.hash_tree_root()] == signed_block.message
-
-
-def add_block(spec,
-              store,
-              signed_block,
-              test_steps,
-              valid=True,
-              block_not_found=False):
-    """
-    Run on_block and on_attestation
-    """
-    yield get_block_file_name(signed_block), signed_block
+def add_block(spec, store, signed_block, test_steps, valid=True, block_not_found=False):
+    """on_block plus the implied on_attestation/on_attester_slashing calls."""
+    part = get_block_file_name(signed_block)
+    yield part, signed_block
 
     if not valid:
-        try:
-            run_on_block(spec, store, signed_block, valid=True)
-        except (AssertionError, BlockNotFoundException) as e:
-            if isinstance(e, BlockNotFoundException) and not block_not_found:
-                assert False
-            test_steps.append({
-                "block": get_block_file_name(signed_block),
-                "valid": False,
-            })
-            return
-        else:
-            assert False
+        tolerated = (AssertionError, BlockNotFoundException) if block_not_found \
+            else (AssertionError,)
+        completed = _expecting_validity(
+            lambda: run_on_block(spec, store, signed_block), False, tolerated)
+        assert not completed
+        test_steps.append({"block": part, "valid": False})
+        return
 
-    run_on_block(spec, store, signed_block, valid=True)
-    test_steps.append({"block": get_block_file_name(signed_block)})
+    run_on_block(spec, store, signed_block)
+    test_steps.append({"block": part})
 
-    # An on_block step implies receiving block's attestations
-    for attestation in signed_block.message.body.attestations:
-        run_on_attestation(spec, store, attestation, is_from_block=True, valid=True)
+    # A delivered block implies delivery of its payload of attestations and
+    # attester slashings to the store as well.
+    body = signed_block.message.body
+    for attestation in body.attestations:
+        run_on_attestation(spec, store, attestation, is_from_block=True)
+    for slashing in body.attester_slashings:
+        run_on_attester_slashing(spec, store, slashing)
 
-    # An on_block step implies receiving block's attester slashings
-    for attester_slashing in signed_block.message.body.attester_slashings:
-        run_on_attester_slashing(spec, store, attester_slashing, valid=True)
+    root = signed_block.message.hash_tree_root()
+    assert store.blocks[root] == signed_block.message
+    assert store.block_states[root].hash_tree_root() == signed_block.message.state_root
 
-    block_root = signed_block.message.hash_tree_root()
-    assert store.blocks[block_root] == signed_block.message
-    assert store.block_states[block_root].hash_tree_root() == signed_block.message.state_root
-    test_steps.append({
-        "checks": {
-            "time": int(store.time),
-            "head": get_formatted_head_output(spec, store),
-            "justified_checkpoint": {
-                "epoch": int(store.justified_checkpoint.epoch),
-                "root": _hex(store.justified_checkpoint.root),
-            },
-            "finalized_checkpoint": {
-                "epoch": int(store.finalized_checkpoint.epoch),
-                "root": _hex(store.finalized_checkpoint.root),
-            },
-            "best_justified_checkpoint": {
-                "epoch": int(store.best_justified_checkpoint.epoch),
-                "root": _hex(store.best_justified_checkpoint.root),
-            },
-            "proposer_boost_root": _hex(store.proposer_boost_root),
-        }
-    })
+    def _cp(checkpoint):
+        return {"epoch": int(checkpoint.epoch), "root": _hex(checkpoint.root)}
 
-    return store.block_states[signed_block.message.hash_tree_root()]
+    test_steps.append({"checks": {
+        "time": int(store.time),
+        "head": get_formatted_head_output(spec, store),
+        "justified_checkpoint": _cp(store.justified_checkpoint),
+        "finalized_checkpoint": _cp(store.finalized_checkpoint),
+        "best_justified_checkpoint": _cp(store.best_justified_checkpoint),
+        "proposer_boost_root": _hex(store.proposer_boost_root),
+    }})
+
+    return store.block_states[root]
 
 
-def run_on_attester_slashing(spec, store, attester_slashing, valid=True):
-    if not valid:
-        try:
-            spec.on_attester_slashing(store, attester_slashing)
-        except AssertionError:
-            return
-        else:
-            assert False
+def tick_and_add_block(spec, store, signed_block, test_steps, valid=True,
+                       merge_block=False, block_not_found=False):
+    parent_state = store.block_states[signed_block.message.parent_root]
+    if merge_block:
+        assert spec.is_merge_transition_block(parent_state, signed_block.message.body)
+    arrival = _slot_wall_time(spec, parent_state, signed_block.message.slot)
+    if store.time < arrival:
+        on_tick_and_append_step(spec, store, arrival, test_steps)
+    post_state = yield from add_block(
+        spec, store, signed_block, test_steps,
+        valid=valid, block_not_found=block_not_found)
+    return post_state
 
-    spec.on_attester_slashing(store, attester_slashing)
+
+def add_attestation(spec, store, attestation, test_steps, is_from_block=False):
+    spec.on_attestation(store, attestation, is_from_block=is_from_block)
+    part = get_attestation_file_name(attestation)
+    yield part, attestation
+    test_steps.append({"attestation": part})
+
+
+def tick_and_run_on_attestation(spec, store, attestation, test_steps, is_from_block=False):
+    # Advance the clock one epoch past the attested block so the attestation
+    # is no longer "from the future" for the store.
+    target_block = store.blocks[attestation.data.beacon_block_root]
+    state_at_block = store.block_states[spec.hash_tree_root(target_block)]
+    mature_time = (_slot_wall_time(spec, state_at_block, target_block.slot)
+                   + int(spec.SLOTS_PER_EPOCH) * int(spec.config.SECONDS_PER_SLOT))
+    if store.time < mature_time:
+        on_tick_and_append_step(spec, store, mature_time, test_steps)
+    yield from add_attestation(spec, store, attestation, test_steps, is_from_block)
 
 
 def add_attester_slashing(spec, store, attester_slashing, test_steps, valid=True):
-    slashing_file_name = get_attester_slashing_file_name(attester_slashing)
-    yield get_attester_slashing_file_name(attester_slashing), attester_slashing
+    part = get_attester_slashing_file_name(attester_slashing)
+    yield part, attester_slashing
+    completed = _expecting_validity(
+        lambda: spec.on_attester_slashing(store, attester_slashing), valid)
+    step = {"attester_slashing": part}
+    if not completed:
+        step["valid"] = False
+    test_steps.append(step)
 
-    if not valid:
-        try:
-            run_on_attester_slashing(spec, store, attester_slashing)
-        except AssertionError:
-            test_steps.append({
-                "attester_slashing": slashing_file_name,
-                "valid": False,
-            })
-            return
-        else:
-            assert False
 
-    run_on_attester_slashing(spec, store, attester_slashing)
-    test_steps.append({"attester_slashing": slashing_file_name})
+def add_pow_block(spec, store, pow_block, test_steps):
+    part = get_pow_block_file_name(pow_block)
+    yield part, pow_block
+    test_steps.append({"pow_block": part})
 
 
 def get_formatted_head_output(spec, store):
     head = spec.get_head(store)
-    slot = store.blocks[head].slot
-    return {
-        "slot": int(slot),
-        "root": _hex(head),
-    }
+    return {"slot": int(store.blocks[head].slot), "root": _hex(head)}
 
 
-def apply_next_epoch_with_attestations(spec,
-                                       state,
-                                       store,
-                                       fill_cur_epoch,
-                                       fill_prev_epoch,
-                                       participation_fn=None,
+# -- multi-slot store appliers -----------------------------------------------
+
+def _apply_blocks_with_attestations(spec, state, store, slots, fill_cur_epoch,
+                                    fill_prev_epoch, test_steps, participation_fn):
+    _, signed_blocks, post_state = next_slots_with_attestations(
+        spec, state, slots, fill_cur_epoch, fill_prev_epoch,
+        participation_fn=participation_fn)
+    last = None
+    for signed_block in signed_blocks:
+        yield from tick_and_add_block(spec, store, signed_block, test_steps)
+        last = signed_block
+    last_root = last.message.hash_tree_root()
+    assert store.blocks[last_root] == last.message
+    assert store.block_states[last_root].hash_tree_root() == post_state.hash_tree_root()
+    return post_state, store, last
+
+
+def apply_next_epoch_with_attestations(spec, state, store, fill_cur_epoch,
+                                       fill_prev_epoch, participation_fn=None,
                                        test_steps=None):
-    if test_steps is None:
-        test_steps = []
-
-    _, new_signed_blocks, post_state = next_epoch_with_attestations(
-        spec, state, fill_cur_epoch, fill_prev_epoch, participation_fn=participation_fn)
-    for signed_block in new_signed_blocks:
-        block = signed_block.message
-        yield from tick_and_add_block(spec, store, signed_block, test_steps)
-        block_root = block.hash_tree_root()
-        assert store.blocks[block_root] == block
-        last_signed_block = signed_block
-
-    assert store.block_states[block_root].hash_tree_root() == post_state.hash_tree_root()
-
-    return post_state, store, last_signed_block
+    assert state.slot % spec.SLOTS_PER_EPOCH == 0  # whole-epoch window only
+    result = yield from _apply_blocks_with_attestations(
+        spec, state, store, spec.SLOTS_PER_EPOCH, fill_cur_epoch, fill_prev_epoch,
+        test_steps if test_steps is not None else [], participation_fn)
+    return result
 
 
-def apply_next_slots_with_attestations(spec,
-                                       state,
-                                       store,
-                                       slots,
-                                       fill_cur_epoch,
-                                       fill_prev_epoch,
-                                       test_steps,
+def apply_next_slots_with_attestations(spec, state, store, slots, fill_cur_epoch,
+                                       fill_prev_epoch, test_steps,
                                        participation_fn=None):
-    _, new_signed_blocks, post_state = next_slots_with_attestations(
-        spec, state, slots, fill_cur_epoch, fill_prev_epoch, participation_fn=participation_fn)
-    for signed_block in new_signed_blocks:
-        block = signed_block.message
-        yield from tick_and_add_block(spec, store, signed_block, test_steps)
-        block_root = block.hash_tree_root()
-        assert store.blocks[block_root] == block
-        last_signed_block = signed_block
-
-    assert store.block_states[block_root].hash_tree_root() == post_state.hash_tree_root()
-
-    return post_state, store, last_signed_block
-
-
-def get_pow_block_file_name(pow_block):
-    return f"pow_block_{_hex(pow_block.block_hash)}"
-
-
-def add_pow_block(spec, store, pow_block, test_steps):
-    yield get_pow_block_file_name(pow_block), pow_block
-    test_steps.append({"pow_block": get_pow_block_file_name(pow_block)})
+    result = yield from _apply_blocks_with_attestations(
+        spec, state, store, slots, fill_cur_epoch, fill_prev_epoch,
+        test_steps, participation_fn)
+    return result

@@ -1,7 +1,10 @@
-"""Mock genesis state construction (reference: test/helpers/genesis.py).
+"""Mock genesis state construction.
 
-Validators are injected directly into the state ("hacked in") instead of
-running deposit processing — orders of magnitude faster per test case.
+Parity surface: reference ``eth2spec/test/helpers/genesis.py``. Validators
+are written straight into the registry instead of replaying deposits — the
+standard pyspec shortcut — but here the per-fork extension fields and the
+altair participation columns are installed in bulk after the loop rather
+than interleaved per validator.
 """
 from __future__ import annotations
 
@@ -15,30 +18,28 @@ from .keys import pubkeys
 
 
 def build_mock_validator(spec, i: int, balance: int):
-    active_pubkey = pubkeys[i]
-    withdrawal_pubkey = pubkeys[-1 - i]
-    # insecurely use pubkey as withdrawal key as well
-    withdrawal_credentials = bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(withdrawal_pubkey)[1:]
+    # Withdrawal credentials are derived from a second (equally insecure)
+    # test pubkey taken from the far end of the key table.
+    creds = bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pubkeys[-1 - i])[1:]
+    effective = min(
+        int(balance) - int(balance) % int(spec.EFFECTIVE_BALANCE_INCREMENT),
+        int(spec.MAX_EFFECTIVE_BALANCE))
     validator = spec.Validator(
-        pubkey=active_pubkey,
-        withdrawal_credentials=withdrawal_credentials,
+        pubkey=pubkeys[i],
+        withdrawal_credentials=creds,
+        effective_balance=effective,
         activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
         activation_epoch=spec.FAR_FUTURE_EPOCH,
         exit_epoch=spec.FAR_FUTURE_EPOCH,
         withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
-        effective_balance=min(
-            balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT, spec.MAX_EFFECTIVE_BALANCE
-        ),
     )
-
     if spec.fork not in FORKS_BEFORE_CAPELLA:
         validator.fully_withdrawn_epoch = spec.FAR_FUTURE_EPOCH
-
     if spec.fork == CUSTODY_GAME:
-        # "FAR_FUTURE_EPOCH until done" (custody_game/beacon-chain.md
-        # Validator extension); the zero default would read as revealed
+        # The custody Validator extension reads epoch zero as "already
+        # revealed"; fresh validators must start at FAR_FUTURE_EPOCH
+        # (custody_game/beacon-chain.md Validator table).
         validator.all_custody_secrets_revealed_epoch = spec.FAR_FUTURE_EPOCH
-
     return validator
 
 
@@ -60,72 +61,71 @@ def get_sample_genesis_execution_payload_header(spec, eth1_block_hash=None):
     )
 
 
-def create_genesis_state(spec, validator_balances, activation_threshold):
-    deposit_root = b"\x42" * 32
-
-    eth1_block_hash = b"\xda" * 32
-    # fork versions follow the builder's fork topology so every fork —
-    # including the experimental branches — stamps its own version with
-    # its parent's as previous (matching the upgrade_to_* path)
+def _fork_at_genesis(spec):
+    """A Fork whose previous version follows the builder's fork topology, so
+    experimental branches stamp their parent's version as previous (the same
+    shape upgrade_to_* would have produced)."""
     from consensus_specs_tpu.specs.builder import FORK_PARENTS
 
     def _version(fork_name):
-        if fork_name is None or fork_name == "phase0":
+        if fork_name in (None, "phase0"):
             return spec.config.GENESIS_FORK_VERSION
         return getattr(spec.config, f"{fork_name.upper()}_FORK_VERSION")
 
-    current_version = _version(spec.fork)
-    previous_version = _version(FORK_PARENTS.get(spec.fork, None))
+    return spec.Fork(
+        previous_version=_version(FORK_PARENTS.get(spec.fork, None)),
+        current_version=_version(spec.fork),
+        epoch=spec.GENESIS_EPOCH,
+    )
+
+
+def create_genesis_state(spec, validator_balances, activation_threshold):
+    eth1_block_hash = b"\xda" * 32
+    count = len(validator_balances)
 
     state = spec.BeaconState(
         genesis_time=0,
-        eth1_deposit_index=len(validator_balances),
+        eth1_deposit_index=count,
         eth1_data=spec.Eth1Data(
-            deposit_root=deposit_root,
-            deposit_count=len(validator_balances),
+            deposit_root=b"\x42" * 32,
+            deposit_count=count,
             block_hash=eth1_block_hash,
         ),
-        fork=spec.Fork(
-            previous_version=previous_version,
-            current_version=current_version,
-            epoch=spec.GENESIS_EPOCH,
-        ),
+        fork=_fork_at_genesis(spec),
         latest_block_header=spec.BeaconBlockHeader(
-            body_root=spec.hash_tree_root(spec.BeaconBlockBody())
-        ),
+            body_root=spec.hash_tree_root(spec.BeaconBlockBody())),
         randao_mixes=[eth1_block_hash] * spec.EPOCHS_PER_HISTORICAL_VECTOR,
     )
 
-    # "Hack" in the initial validators — much faster than processing
-    # genesis deposits for every test case
+    # Registry injection: skip deposit processing entirely and write the
+    # validators in directly, activating those above the threshold.
     state.balances = validator_balances
-    state.validators = [
-        build_mock_validator(spec, i, state.balances[i]) for i in range(len(validator_balances))
-    ]
-
-    # Process genesis activations
-    for index in range(len(state.validators)):
-        validator = state.validators[index]
+    registry = []
+    for i, balance in enumerate(validator_balances):
+        validator = build_mock_validator(spec, i, balance)
         if validator.effective_balance >= activation_threshold:
             validator.activation_eligibility_epoch = spec.GENESIS_EPOCH
             validator.activation_epoch = spec.GENESIS_EPOCH
-        if spec.fork not in FORKS_BEFORE_ALTAIR:
-            state.previous_epoch_participation.append(spec.ParticipationFlags(0b0000_0000))
-            state.current_epoch_participation.append(spec.ParticipationFlags(0b0000_0000))
-            state.inactivity_scores.append(spec.uint64(0))
+        registry.append(validator)
+    state.validators = registry
 
-    # Set genesis validators root for domain separation and chain versioning
+    post_altair = spec.fork not in FORKS_BEFORE_ALTAIR
+    if post_altair:
+        zero_flags = [spec.ParticipationFlags(0)] * count
+        state.previous_epoch_participation = zero_flags
+        state.current_epoch_participation = zero_flags
+        state.inactivity_scores = [spec.uint64(0)] * count
+
+    # Domain separation / chain versioning root over the final registry.
     state.genesis_validators_root = spec.hash_tree_root(state.validators)
 
-    if spec.fork not in FORKS_BEFORE_ALTAIR:
-        # A duplicate committee is assigned for the current and next committee at genesis
+    if post_altair:
+        # Genesis assigns the same committee to both the current and next slots.
         state.current_sync_committee = spec.get_next_sync_committee(state)
         state.next_sync_committee = spec.get_next_sync_committee(state)
 
     if spec.fork not in FORKS_BEFORE_BELLATRIX:
-        # Initialize the execution payload header (block number and genesis time zero)
         state.latest_execution_payload_header = get_sample_genesis_execution_payload_header(
-            spec, eth1_block_hash=eth1_block_hash
-        )
+            spec, eth1_block_hash=eth1_block_hash)
 
     return state

@@ -1,5 +1,5 @@
-"""PoW-chain mocks for bellatrix terminal-block tests (reference
-capability: test/helpers/pow_block.py)."""
+"""Mock PoW chains for bellatrix terminal-block tests (parity capability:
+reference ``test/helpers/pow_block.py``)."""
 from __future__ import annotations
 
 from random import Random
@@ -22,9 +22,13 @@ class PowChain:
 
 def prepare_random_pow_block(spec, rng=None):
     rng = rng or Random(3131)
+
+    def _random_hash():
+        return spec.hash(rng.getrandbits(256).to_bytes(32, "big"))
+
     return spec.PowBlock(
-        block_hash=spec.hash(bytes(rng.getrandbits(8) for _ in range(32))),
-        parent_hash=spec.hash(bytes(rng.getrandbits(8) for _ in range(32))),
+        block_hash=_random_hash(),
+        parent_hash=_random_hash(),
         total_difficulty=0,
     )
 
@@ -32,9 +36,10 @@ def prepare_random_pow_block(spec, rng=None):
 def prepare_random_pow_chain(spec, length, rng=None) -> PowChain:
     assert length > 0
     rng = rng or Random(3131)
-    chain = [prepare_random_pow_block(spec, rng)]
-    for i in range(1, length):
+    chain = []
+    for _ in range(length):
         block = prepare_random_pow_block(spec, rng)
-        block.parent_hash = chain[i - 1].block_hash
+        if chain:
+            block.parent_hash = chain[-1].block_hash
         chain.append(block)
     return PowChain(chain)

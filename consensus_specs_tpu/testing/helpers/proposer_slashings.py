@@ -1,4 +1,9 @@
-"""Proposer-slashing helpers (reference: test/helpers/proposer_slashings.py)."""
+"""Proposer-slashing construction and balance-effect assertions (parity
+surface: reference ``eth2spec/test/helpers/proposer_slashings.py``).
+
+The effect check computes an expected balance delta per role first, then
+asserts, instead of the reference's branch-per-assert layout.
+"""
 from __future__ import annotations
 
 from ..context import is_post_altair, is_post_bellatrix
@@ -12,90 +17,79 @@ from .sync_committee import (
 
 
 def get_min_slashing_penalty_quotient(spec):
-    if is_post_bellatrix(spec):
-        return spec.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
-    elif is_post_altair(spec):
-        return spec.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
-    else:
-        return spec.MIN_SLASHING_PENALTY_QUOTIENT
+    for predicate, name in (
+        (is_post_bellatrix, "MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX"),
+        (is_post_altair, "MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR"),
+    ):
+        if predicate(spec):
+            return getattr(spec, name)
+    return spec.MIN_SLASHING_PENALTY_QUOTIENT
+
+
+def _sync_reward_and_penalty(spec, pre_state, state, index, block):
+    """(reward, penalty) the sync aggregate in ``block`` paid ``index``."""
+    if block is None or not is_post_altair(spec):
+        return 0, 0
+    reward, penalty = compute_sync_committee_participant_reward_and_penalty(
+        spec, pre_state, index,
+        compute_committee_indices(spec, state, state.current_sync_committee),
+        block.body.sync_aggregate.sync_committee_bits,
+    )
+    return int(reward), int(penalty)
 
 
 def check_proposer_slashing_effect(spec, pre_state, state, slashed_index, block=None):
-    slashed_validator = state.validators[slashed_index]
-    assert slashed_validator.slashed
-    assert slashed_validator.exit_epoch < spec.FAR_FUTURE_EPOCH
-    assert slashed_validator.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
+    slashed = state.validators[slashed_index]
+    assert slashed.slashed
+    assert slashed.exit_epoch < spec.FAR_FUTURE_EPOCH
+    assert slashed.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
 
+    slash_penalty = int(slashed.effective_balance // get_min_slashing_penalty_quotient(spec))
+    whistleblower_reward = int(slashed.effective_balance // spec.WHISTLEBLOWER_REWARD_QUOTIENT)
     proposer_index = spec.get_beacon_proposer_index(state)
-    slash_penalty = state.validators[slashed_index].effective_balance // get_min_slashing_penalty_quotient(spec)
-    whistleblower_reward = state.validators[slashed_index].effective_balance // spec.WHISTLEBLOWER_REWARD_QUOTIENT
 
-    # Altair introduces sync committee (SC) reward and penalty
-    sc_reward_for_slashed = sc_penalty_for_slashed = sc_reward_for_proposer = sc_penalty_for_proposer = 0
-    if is_post_altair(spec) and block is not None:
-        committee_indices = compute_committee_indices(spec, state, state.current_sync_committee)
-        committee_bits = block.body.sync_aggregate.sync_committee_bits
-        sc_reward_for_slashed, sc_penalty_for_slashed = compute_sync_committee_participant_reward_and_penalty(
-            spec, pre_state, slashed_index, committee_indices, committee_bits,
-        )
-        sc_reward_for_proposer, sc_penalty_for_proposer = compute_sync_committee_participant_reward_and_penalty(
-            spec, pre_state, proposer_index, committee_indices, committee_bits,
-        )
+    sc_r_slashed, sc_p_slashed = _sync_reward_and_penalty(
+        spec, pre_state, state, slashed_index, block)
+    sc_r_proposer, sc_p_proposer = _sync_reward_and_penalty(
+        spec, pre_state, state, proposer_index, block)
 
-    if proposer_index != slashed_index:
-        # slashed validator lost initial slash penalty
-        assert (
-            get_balance(state, slashed_index)
-            == get_balance(pre_state, slashed_index) - slash_penalty + sc_reward_for_slashed - sc_penalty_for_slashed
-        )
-        # block proposer gained whistleblower reward (>=: may have reported multiple)
-        assert (
-            get_balance(state, proposer_index)
-            >= (
-                get_balance(pre_state, proposer_index) + whistleblower_reward
-                + sc_reward_for_proposer - sc_penalty_for_proposer
-            )
-        )
+    # Deltas as plain ints: checked uint64 (rightly) refuses to go negative.
+    slashed_delta = int(get_balance(state, slashed_index)) - int(get_balance(pre_state, slashed_index))
+    if proposer_index == slashed_index:
+        # Self-report: penalty and whistleblower reward land on one account
+        # (">=" because the block may have carried multiple slashings).
+        assert slashed_delta >= int(
+            whistleblower_reward - slash_penalty + sc_r_slashed - sc_p_slashed)
     else:
-        # proposer reported themself so get penalty and reward (>=: may have reported multiple)
-        assert (
-            get_balance(state, slashed_index)
-            >= (
-                get_balance(pre_state, slashed_index) - slash_penalty + whistleblower_reward
-                + sc_reward_for_slashed - sc_penalty_for_slashed
-            )
-        )
+        assert slashed_delta == int(sc_r_slashed - sc_p_slashed - slash_penalty)
+        proposer_delta = (
+            int(get_balance(state, proposer_index)) - int(get_balance(pre_state, proposer_index)))
+        assert proposer_delta >= int(whistleblower_reward + sc_r_proposer - sc_p_proposer)
 
 
 def get_valid_proposer_slashing(spec, state, random_root=b"\x99" * 32,
                                 slashed_index=None, slot=None, signed_1=False, signed_2=False):
     if slashed_index is None:
-        current_epoch = spec.get_current_epoch(state)
-        slashed_index = spec.get_active_validator_indices(state, current_epoch)[-1]
+        active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+        slashed_index = active[-1]
     privkey = pubkey_to_privkey[state.validators[slashed_index].pubkey]
-    if slot is None:
-        slot = state.slot
 
-    header_1 = spec.BeaconBlockHeader(
-        slot=slot,
+    base_header = spec.BeaconBlockHeader(
+        slot=state.slot if slot is None else slot,
         proposer_index=slashed_index,
         parent_root=b"\x33" * 32,
         state_root=b"\x44" * 32,
         body_root=b"\x55" * 32,
     )
-    header_2 = header_1.copy()
-    header_2.parent_root = random_root
+    variant = base_header.copy()
+    variant.parent_root = random_root
 
-    if signed_1:
-        signed_header_1 = sign_block_header(spec, state, header_1, privkey)
-    else:
-        signed_header_1 = spec.SignedBeaconBlockHeader(message=header_1)
-    if signed_2:
-        signed_header_2 = sign_block_header(spec, state, header_2, privkey)
-    else:
-        signed_header_2 = spec.SignedBeaconBlockHeader(message=header_2)
+    def _wrap(header, do_sign):
+        if do_sign:
+            return sign_block_header(spec, state, header, privkey)
+        return spec.SignedBeaconBlockHeader(message=header)
 
     return spec.ProposerSlashing(
-        signed_header_1=signed_header_1,
-        signed_header_2=signed_header_2,
+        signed_header_1=_wrap(base_header, signed_1),
+        signed_header_2=_wrap(variant, signed_2),
     )

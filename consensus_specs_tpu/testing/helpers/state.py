@@ -1,5 +1,14 @@
-"""State advancement helpers (reference: test/helpers/state.py)."""
+"""Slot/epoch advancement and participation-flag manipulation for tests.
+
+Parity surface: reference ``eth2spec/test/helpers/state.py``. Participation
+fills use the framework's bulk packed-leaf seam (``ssz/bulk.py``) — one numpy
+fill per epoch column instead of the reference's per-validator Python loop.
+"""
 from __future__ import annotations
+
+import numpy as np
+
+from consensus_specs_tpu.ssz.bulk import set_packed_uint8_from_numpy
 
 from ..context import expect_assertion_error, is_post_altair
 from .block import apply_empty_block, sign_block, transition_unsigned_block
@@ -8,6 +17,15 @@ from .voluntary_exits import get_unslashed_exited_validators
 
 def get_balance(state, index):
     return state.balances[index]
+
+
+def get_state_root(spec, state, slot) -> bytes:
+    assert slot < state.slot <= slot + spec.SLOTS_PER_HISTORICAL_ROOT
+    return state.state_roots[slot % spec.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def _slots_until_next_epoch(spec, state) -> int:
+    return spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH
 
 
 def next_slot(spec, state):
@@ -21,9 +39,9 @@ def next_slots(spec, state, slots):
 
 def transition_to(spec, state, slot):
     assert state.slot <= slot
-    for _ in range(slot - state.slot):
+    # Step one slot at a time: a few suites rely on observing every boundary.
+    while state.slot < slot:
         next_slot(spec, state)
-    assert state.slot == slot
 
 
 def transition_to_slot_via_block(spec, state, slot):
@@ -33,35 +51,22 @@ def transition_to_slot_via_block(spec, state, slot):
 
 
 def next_epoch(spec, state):
-    slot = state.slot + spec.SLOTS_PER_EPOCH - (state.slot % spec.SLOTS_PER_EPOCH)
-    if slot > state.slot:
-        spec.process_slots(state, slot)
+    next_slots(spec, state, _slots_until_next_epoch(spec, state))
 
 
 def next_epoch_via_block(spec, state, insert_state_root=False):
-    block = apply_empty_block(
-        spec, state, state.slot + spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH
-    )
+    block = apply_empty_block(spec, state, state.slot + _slots_until_next_epoch(spec, state))
     if insert_state_root:
         block.state_root = state.hash_tree_root()
     return block
 
 
 def next_epoch_via_signed_block(spec, state):
-    block = next_epoch_via_block(spec, state, insert_state_root=True)
-    return sign_block(spec, state, block)
-
-
-def get_state_root(spec, state, slot) -> bytes:
-    assert slot < state.slot <= slot + spec.SLOTS_PER_HISTORICAL_ROOT
-    return state.state_roots[slot % spec.SLOTS_PER_HISTORICAL_ROOT]
+    return sign_block(spec, state, next_epoch_via_block(spec, state, insert_state_root=True))
 
 
 def state_transition_and_sign_block(spec, state, block, expect_fail=False):
-    """
-    State transition via the provided ``block``,
-    then package the block with the correct state root and signature.
-    """
+    """Run ``block`` through the transition, then seal in root + signature."""
     if expect_fail:
         expect_assertion_error(lambda: transition_unsigned_block(spec, state, block))
     else:
@@ -70,59 +75,52 @@ def state_transition_and_sign_block(spec, state, block, expect_fail=False):
     return sign_block(spec, state, block)
 
 
-# The following manipulate participation flags directly: post-altair only
+# -- participation flags (altair+) -------------------------------------------
 
-
-def _set_full_participation(spec, state, current=True, previous=True):
+def _fill_participation(spec, state, flags: int, current: bool, previous: bool):
     assert is_post_altair(spec)
+    column = np.full(len(state.validators), flags, dtype=np.uint8)
+    if current:
+        set_packed_uint8_from_numpy(state.current_epoch_participation, column)
+    if previous:
+        set_packed_uint8_from_numpy(state.previous_epoch_participation, column)
 
-    full_flags = spec.ParticipationFlags(0)
+
+def _all_flags(spec) -> int:
+    value = spec.ParticipationFlags(0)
     for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
-        full_flags = spec.add_flag(full_flags, flag_index)
-
-    for index in range(len(state.validators)):
-        if current:
-            state.current_epoch_participation[index] = full_flags
-        if previous:
-            state.previous_epoch_participation[index] = full_flags
+        value = spec.add_flag(value, flag_index)
+    return int(value)
 
 
 def set_full_participation(spec, state, rng=None):
-    _set_full_participation(spec, state)
+    _fill_participation(spec, state, _all_flags(spec), current=True, previous=True)
 
 
 def set_full_participation_previous_epoch(spec, state, rng=None):
-    _set_full_participation(spec, state, current=False, previous=True)
-
-
-def _set_empty_participation(spec, state, current=True, previous=True):
-    assert is_post_altair(spec)
-
-    for index in range(len(state.validators)):
-        if current:
-            state.current_epoch_participation[index] = spec.ParticipationFlags(0)
-        if previous:
-            state.previous_epoch_participation[index] = spec.ParticipationFlags(0)
+    _fill_participation(spec, state, _all_flags(spec), current=False, previous=True)
 
 
 def set_empty_participation(spec, state, rng=None):
-    _set_empty_participation(spec, state)
+    _fill_participation(spec, state, 0, current=True, previous=True)
 
+
+# -- registry shape probes ---------------------------------------------------
 
 def ensure_state_has_validators_across_lifecycle(spec, state):
-    has_pending = any(filter(spec.is_eligible_for_activation_queue, state.validators))
-
-    current_epoch = spec.get_current_epoch(state)
-    has_active = any(filter(lambda v: spec.is_active_validator(v, current_epoch), state.validators))
-
-    has_exited = any(get_unslashed_exited_validators(spec, state))
-
-    has_slashed = any(filter(lambda v: v.slashed, state.validators))
-
-    return has_pending and has_active and has_exited and has_slashed
+    """True iff the registry covers pending, active, exited and slashed."""
+    now = spec.get_current_epoch(state)
+    stages = (
+        any(spec.is_eligible_for_activation_queue(v) for v in state.validators),
+        any(spec.is_active_validator(v, now) for v in state.validators),
+        any(get_unslashed_exited_validators(spec, state)),
+        any(v.slashed for v in state.validators),
+    )
+    return all(stages)
 
 
 def has_active_balance_differential(spec, state):
-    active_balance = spec.get_total_active_balance(state)
-    total_balance = spec.get_total_balance(state, set(range(len(state.validators))))
-    return active_balance // spec.EFFECTIVE_BALANCE_INCREMENT != total_balance // spec.EFFECTIVE_BALANCE_INCREMENT
+    """Active balance differs from total balance by >= one increment."""
+    active = spec.get_total_active_balance(state)
+    total = spec.get_total_balance(state, set(range(len(state.validators))))
+    return active // spec.EFFECTIVE_BALANCE_INCREMENT != total // spec.EFFECTIVE_BALANCE_INCREMENT

@@ -1,4 +1,5 @@
-"""Voluntary-exit helpers (reference: test/helpers/voluntary_exits.py)."""
+"""Voluntary-exit construction and registry exit queries (parity surface:
+reference ``eth2spec/test/helpers/voluntary_exits.py``)."""
 from __future__ import annotations
 
 from random import Random
@@ -8,46 +9,38 @@ from consensus_specs_tpu.crypto import bls
 from .keys import privkeys
 
 
-def prepare_signed_exits(spec, state, indices):
-    domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT)
-
-    def create_signed_exit(index):
-        exit = spec.VoluntaryExit(
-            epoch=spec.get_current_epoch(state),
-            validator_index=index,
-        )
-        signing_root = spec.compute_signing_root(exit, domain)
-        return spec.SignedVoluntaryExit(message=exit, signature=bls.Sign(privkeys[index], signing_root))
-
-    return [create_signed_exit(index) for index in indices]
-
-
 def sign_voluntary_exit(spec, state, voluntary_exit, privkey):
     domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, voluntary_exit.epoch)
-    signing_root = spec.compute_signing_root(voluntary_exit, domain)
     return spec.SignedVoluntaryExit(
         message=voluntary_exit,
-        signature=bls.Sign(privkey, signing_root),
+        signature=bls.Sign(privkey, spec.compute_signing_root(voluntary_exit, domain)),
     )
 
 
-def get_exited_validators(spec, state):
-    current_epoch = spec.get_current_epoch(state)
-    return [index for (index, validator) in enumerate(state.validators) if validator.exit_epoch <= current_epoch]
-
-
-def get_unslashed_exited_validators(spec, state):
+def prepare_signed_exits(spec, state, indices):
+    epoch = spec.get_current_epoch(state)
     return [
-        index for index in get_exited_validators(spec, state)
-        if not state.validators[index].slashed
+        sign_voluntary_exit(
+            spec, state,
+            spec.VoluntaryExit(epoch=epoch, validator_index=index),
+            privkeys[index])
+        for index in indices
     ]
 
 
-def exit_validators(spec, state, validator_count, rng=None):
-    if rng is None:
-        rng = Random(1337)
+def get_exited_validators(spec, state):
+    now = spec.get_current_epoch(state)
+    return [i for i, v in enumerate(state.validators) if v.exit_epoch <= now]
 
-    indices = rng.sample(range(len(state.validators)), validator_count)
-    for index in indices:
+
+def get_unslashed_exited_validators(spec, state):
+    return [i for i in get_exited_validators(spec, state) if not state.validators[i].slashed]
+
+
+def exit_validators(spec, state, validator_count, rng=None):
+    """Initiate exit for ``validator_count`` randomly sampled validators."""
+    rng = rng or Random(1337)
+    chosen = rng.sample(range(len(state.validators)), validator_count)
+    for index in chosen:
         spec.initiate_validator_exit(state, index)
-    return indices
+    return chosen
