@@ -53,6 +53,14 @@ from typing import Dict, Iterable, Optional, Tuple
 REFERENCE_ROOT = Path("/root/reference")
 SRC_DIR = Path(__file__).parent / "src"
 
+# Pinned digests of the vendored reference markdown.  The compiler execs
+# python fences extracted from these third-party documents, so the checkout
+# is content-addressed: every document named in DOC_LISTS must hash to the
+# value recorded at pin time (tools/pin_md_manifest.py regenerates after an
+# intentional reference update).  A synthetic reference_root (tests) skips
+# the check — it execs only what that test itself wrote.
+MD_MANIFEST = Path(__file__).parent / "md_manifest.json"
+
 # Per-fork markdown document lists — the reference compiler's defaults
 # (setup.py:867-905).  Each fork compiles its ancestors' lists first.
 DOC_LISTS = {
@@ -355,6 +363,33 @@ def _handwritten_defs(src_file: str, names) -> str:
     return "\n\n\n".join(wanted[n] for n in names)
 
 
+_manifest_cache: Optional[Dict[str, str]] = None
+
+
+def _verify_pinned_digest(doc: str, text: str) -> None:
+    """Refuse to compile a vendored document whose content drifted from the
+    pinned manifest (defense against injected code fences — the extracted
+    python is exec'd)."""
+    # Hard raises, not asserts: this check must survive `python -O`.
+    global _manifest_cache
+    import hashlib
+    import json
+    if _manifest_cache is None:
+        if not MD_MANIFEST.exists():
+            raise RuntimeError(
+                f"{MD_MANIFEST} missing — run tools/pin_md_manifest.py against "
+                "a trusted reference checkout before compiling markdown specs")
+        _manifest_cache = json.loads(MD_MANIFEST.read_text())
+    digest = hashlib.sha256(text.encode()).hexdigest()
+    pinned = _manifest_cache.get(doc)
+    if pinned is None:
+        raise RuntimeError(f"{doc} is not in the pinned manifest")
+    if digest != pinned:
+        raise RuntimeError(
+            f"{doc} content drifted from the pinned digest ({digest} != {pinned});"
+            " refusing to exec extracted code. Re-pin only after auditing the diff.")
+
+
 def fork_spec_object(fork: str, preset: Dict[str, int],
                      config_keys: Iterable[str],
                      reference_root: Path = REFERENCE_ROOT) -> SpecObject:
@@ -374,6 +409,8 @@ def fork_spec_object(fork: str, preset: Dict[str, int],
             path = reference_root / doc
             assert path.exists(), f"spec document missing: {path}"
             text = path.read_text()
+            if reference_root == REFERENCE_ROOT:
+                _verify_pinned_digest(doc, text)
             if not text.strip():  # capella/p2p-interface.md is empty
                 continue
             merged.update(doc_spec_object(text, preset, config_keys))

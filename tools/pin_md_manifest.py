@@ -1,0 +1,25 @@
+"""Regenerate the pinned sha256 manifest of the vendored spec markdown.
+
+Run only after auditing a reference update; the mdcompiler refuses to exec
+code fences from any document whose digest differs from this manifest.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+from consensus_specs_tpu.specs.mdcompiler import DOC_LISTS, MD_MANIFEST, REFERENCE_ROOT
+
+
+def main() -> None:
+    manifest = {}
+    for docs in DOC_LISTS.values():
+        for doc in docs:
+            text = (REFERENCE_ROOT / doc).read_text()
+            manifest[doc] = hashlib.sha256(text.encode()).hexdigest()
+    MD_MANIFEST.write_text(json.dumps(manifest, indent=1, sort_keys=True) + "\n")
+    print(f"pinned {len(manifest)} documents -> {MD_MANIFEST}")
+
+
+if __name__ == "__main__":
+    main()
