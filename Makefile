@@ -19,7 +19,7 @@ bench:
 lint:
 	python tools/lint.py
 
-GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing finality rewards genesis random transition ssz_generic fork_choice
+GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing finality rewards genesis random transition ssz_generic fork_choice merkle
 
 gen-all: $(addprefix gen-,$(GENERATORS))
 

@@ -1,0 +1,26 @@
+"""Merkle single-proof vector generator (reference capability:
+tests/generators/merkle/main.py — the 15th runner).
+
+Emits ``<preset>/<fork>/merkle/single_proof/pyspec_tests/<case>/`` with a
+``state.ssz_snappy`` part and a ``proof.yaml`` data part per
+docs/formats/merkle/single_proof.md.
+"""
+from __future__ import annotations
+
+from consensus_specs_tpu.gen.gen_from_tests import run_state_test_generators
+
+
+def main(argv=None):
+    from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
+
+    ensure_vector_sources_importable()
+    single_proof = {"single_proof": "tests.spec.altair.merkle.test_single_proof"}
+    all_mods = {
+        "altair": single_proof,
+        "bellatrix": single_proof,
+    }
+    run_state_test_generators(runner_name="merkle", all_mods=all_mods, argv=argv)
+
+
+if __name__ == "__main__":
+    main()

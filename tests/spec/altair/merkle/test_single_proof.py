@@ -1,0 +1,42 @@
+"""Light-client single-leaf merkle proofs over BeaconState gindices
+(reference capability: test/altair/merkle/test_single_proof.py; format:
+docs/formats/merkle/single_proof.md)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_altair_and_later,
+)
+from consensus_specs_tpu.testing.helpers.merkle import build_proof
+
+
+def _run_single_proof(spec, state, gindex, leaf_root):
+    """Yield the state + proof parts and verify the branch both ways."""
+    yield "state", state
+    branch = build_proof(state.get_backing(), gindex)
+    yield "proof", {
+        "leaf": "0x" + bytes(leaf_root).hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + bytes(node).hex() for node in branch],
+    }
+    assert spec.is_valid_merkle_branch(
+        leaf=leaf_root,
+        branch=branch,
+        depth=spec.floorlog2(gindex),
+        index=spec.get_subtree_index(gindex),
+        root=state.hash_tree_root(),
+    )
+
+
+@with_altair_and_later
+@spec_state_test
+def test_next_sync_committee_merkle_proof(spec, state):
+    yield from _run_single_proof(
+        spec, state, spec.NEXT_SYNC_COMMITTEE_INDEX,
+        state.next_sync_committee.hash_tree_root())
+
+
+@with_altair_and_later
+@spec_state_test
+def test_finality_root_merkle_proof(spec, state):
+    yield from _run_single_proof(
+        spec, state, spec.FINALIZED_ROOT_INDEX,
+        state.finalized_checkpoint.root)

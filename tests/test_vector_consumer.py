@@ -164,3 +164,22 @@ def test_fork_choice_roundtrip(tmp_path):
     assert corrupted
     with pytest.raises(VectorFailure):
         consume_tree(tmp_path, preset="minimal", runners={"fork_choice"})
+
+
+def test_merkle_roundtrip(tmp_path):
+    """Light-client single-proof vectors: state + proof.yaml emitted by the
+    merkle runner, branch re-verified AND re-derived by the consumer."""
+    from consensus_specs_tpu.gen.runners.merkle import main as merkle
+    _generate(tmp_path, merkle)
+    stats = consume_tree(tmp_path, preset="minimal", runners={"merkle"})
+    assert stats["pass"] >= 4  # 2 handler tests x {altair, bellatrix}
+    assert stats["skip"] == 0
+
+    # corrupt one branch node: the replay must reject the proof
+    import yaml
+    proof_file = next(Path(tmp_path).rglob("proof.yaml"))
+    proof = yaml.safe_load(proof_file.read_text())
+    proof["branch"][0] = "0x" + "ab" * 32
+    proof_file.write_text(yaml.safe_dump(proof))
+    with pytest.raises(VectorFailure):
+        consume_tree(tmp_path, preset="minimal", runners={"merkle"})
