@@ -1,8 +1,9 @@
-"""Rewards suite — basic participation patterns (reference suite:
-test/phase0/rewards/test_basic.py); every case is simultaneously a
-differential test of the installed deltas kernel: helpers/rewards.py pins
-component sums against spec.get_attestation_deltas AND each component
-against an independent numpy expectation model."""
+"""Rewards suite under the inactivity leak (reference suite:
+test/phase0/rewards/test_leak.py): every basic scenario re-run after
+advancing past MIN_EPOCHS_TO_INACTIVITY_PENALTY, where the component
+formulas switch shape (full-base-reward compensation + quadratic
+inactivity penalties — phase0/beacon-chain.md get_attestation_component_
+deltas / get_inactivity_penalty_deltas)."""
 from random import Random
 
 from consensus_specs_tpu.testing.context import (
@@ -29,110 +30,6 @@ phase0 = with_phases(["phase0"])
 
 @phase0
 @spec_state_test
-def test_empty(spec, state):
-    yield from run_test_empty(spec, state)
-
-
-@phase0
-@spec_state_test
-def test_full_all_correct(spec, state):
-    yield from run_test_full_all_correct(spec, state)
-
-
-@phase0
-@spec_state_test
-def test_half_full(spec, state):
-    yield from run_test_partial(spec, state, 0.5)
-
-
-@phase0
-@spec_state_test
-def test_quarter_full(spec, state):
-    yield from run_test_partial(spec, state, 0.25)
-
-
-@phase0
-@spec_state_test
-def test_one_attestation_one_correct(spec, state):
-    yield from run_test_one_attestation_one_correct(spec, state)
-
-
-@phase0
-@spec_state_test
-def test_full_but_partial_participation(spec, state):
-    yield from run_test_partial(spec, state, 0.7)
-
-
-@phase0
-@spec_state_test
-def test_with_not_yet_activated_validators(spec, state):
-    yield from run_test_with_not_yet_activated_validators(spec, state)
-
-
-@phase0
-@spec_state_test
-def test_with_exited_validators(spec, state):
-    yield from run_test_with_exited_validators(spec, state)
-
-
-@phase0
-@spec_state_test
-def test_with_slashed_validators(spec, state):
-    yield from run_test_with_slashed_validators(spec, state)
-
-
-@phase0
-@spec_state_test
-def test_some_very_low_effective_balances_that_attested(spec, state):
-    yield from run_test_low_balances(spec, state, attested=True)
-
-
-@phase0
-@spec_state_test
-def test_some_very_low_effective_balances_that_did_not_attest(spec, state):
-    yield from run_test_low_balances(spec, state, attested=False)
-
-
-@phase0
-@spec_state_test
-def test_all_balances_too_low_for_reward(spec, state):
-    yield from run_test_all_balances_too_low_for_reward(spec, state)
-
-
-@phase0
-@spec_state_test
-def test_full_half_incorrect_target(spec, state):
-    yield from run_test_full_fraction_incorrect(
-        spec, state, correct_target=False, correct_head=True,
-        fraction_incorrect=0.5)
-
-
-@phase0
-@spec_state_test
-def test_full_half_incorrect_head(spec, state):
-    yield from run_test_full_fraction_incorrect(
-        spec, state, correct_target=True, correct_head=False,
-        fraction_incorrect=0.5)
-
-
-@phase0
-@spec_state_test
-def test_full_all_incorrect_target_and_head(spec, state):
-    yield from run_test_full_fraction_incorrect(
-        spec, state, correct_target=False, correct_head=False,
-        fraction_incorrect=1.0)
-
-
-@phase0
-@spec_state_test
-def test_full_random_seed_2(spec, state):
-    yield from run_test_full_random(spec, state, Random(2))
-
-
-# -- a few leak smoke cases stay here; the full leak matrix is test_leak.py --
-
-@phase0
-@spec_state_test
 @leaking()
 def test_empty_leak(spec, state):
     yield from run_test_empty(spec, state)
@@ -147,6 +44,117 @@ def test_full_leak(spec, state):
 
 @phase0
 @spec_state_test
-@leaking(epochs_extra=4)
-def test_half_full_deep_leak(spec, state):
+@leaking()
+def test_half_full_leak(spec, state):
     yield from run_test_partial(spec, state, 0.5)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_quarter_full_leak(spec, state):
+    yield from run_test_partial(spec, state, 0.25)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_one_attestation_one_correct_leak(spec, state):
+    yield from run_test_one_attestation_one_correct(spec, state)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_full_but_partial_participation_leak(spec, state):
+    yield from run_test_partial(spec, state, 0.7)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_with_not_yet_activated_validators_leak(spec, state):
+    yield from run_test_with_not_yet_activated_validators(spec, state)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_with_exited_validators_leak(spec, state):
+    yield from run_test_with_exited_validators(spec, state)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_with_slashed_validators_leak(spec, state):
+    yield from run_test_with_slashed_validators(spec, state)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_some_very_low_effective_balances_that_attested_leak(spec, state):
+    yield from run_test_low_balances(spec, state, attested=True)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_some_very_low_effective_balances_that_did_not_attest_leak(spec, state):
+    yield from run_test_low_balances(spec, state, attested=False)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_all_balances_too_low_for_reward_leak(spec, state):
+    yield from run_test_all_balances_too_low_for_reward(spec, state)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_full_half_incorrect_target_leak(spec, state):
+    yield from run_test_full_fraction_incorrect(
+        spec, state, correct_target=False, correct_head=True,
+        fraction_incorrect=0.5)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_full_half_incorrect_head_leak(spec, state):
+    yield from run_test_full_fraction_incorrect(
+        spec, state, correct_target=True, correct_head=False,
+        fraction_incorrect=0.5)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_full_all_incorrect_target_and_head_leak(spec, state):
+    yield from run_test_full_fraction_incorrect(
+        spec, state, correct_target=False, correct_head=False,
+        fraction_incorrect=1.0)
+
+
+@phase0
+@spec_state_test
+@leaking(epochs_extra=4)
+def test_full_deep_leak(spec, state):
+    yield from run_test_full_all_correct(spec, state)
+
+
+@phase0
+@spec_state_test
+@leaking(epochs_extra=8)
+def test_empty_very_deep_leak(spec, state):
+    yield from run_test_empty(spec, state)
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_full_random_leak_seed_3(spec, state):
+    yield from run_test_full_random(spec, state, Random(3))

@@ -1,0 +1,87 @@
+"""Rewards suite — randomized registry + participation shapes (reference
+suite: test/phase0/rewards/test_random.py).  Each seed drives random exits,
+slashings and per-committee participation through the full component
+triangulation in helpers/rewards.py."""
+from random import Random
+
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.rewards import (
+    leaking,
+    run_test_full_random,
+)
+
+phase0 = with_phases(["phase0"])
+
+
+@phase0
+@spec_state_test
+def test_full_random_0(spec, state):
+    yield from run_test_full_random(spec, state, Random(1010))
+
+
+@phase0
+@spec_state_test
+def test_full_random_1(spec, state):
+    yield from run_test_full_random(spec, state, Random(2020))
+
+
+@phase0
+@spec_state_test
+def test_full_random_2(spec, state):
+    yield from run_test_full_random(spec, state, Random(3030))
+
+
+@phase0
+@spec_state_test
+def test_full_random_3(spec, state):
+    yield from run_test_full_random(spec, state, Random(4040))
+
+
+@phase0
+@spec_state_test
+def test_full_random_4(spec, state):
+    yield from run_test_full_random(spec, state, Random(5050))
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_full_random_leak_0(spec, state):
+    yield from run_test_full_random(spec, state, Random(6060))
+
+
+@phase0
+@spec_state_test
+@leaking()
+def test_full_random_leak_1(spec, state):
+    yield from run_test_full_random(spec, state, Random(7070))
+
+
+@phase0
+@spec_state_test
+@leaking(epochs_extra=4)
+def test_full_random_deep_leak(spec, state):
+    yield from run_test_full_random(spec, state, Random(8080))
+
+
+@phase0
+@spec_state_test
+def test_full_random_low_balances(spec, state):
+    rng = Random(9090)
+    for index in rng.sample(range(len(state.validators)), 4):
+        state.validators[index].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    yield from run_test_full_random(spec, state, rng)
+
+
+@phase0
+@spec_state_test
+def test_full_random_five_epoch_history(spec, state):
+    from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+    rng = Random(111)
+    for _ in range(5):
+        next_epoch(spec, state)
+    yield from run_test_full_random(spec, state, rng)
