@@ -316,6 +316,11 @@ def _install_optimizations(g: Dict[str, Any]) -> None:
         n = len(indices)
         start = (n * index) // count
         end = (n * uint64_t(index + 1)) // count
+        # Failure-semantics parity with the sequential spec: an out-of-range
+        # committee index makes compute_shuffled_index trip its
+        # `index < index_count` assert there; raise AssertionError here too,
+        # not IndexError (fork-choice handlers catch AssertionError only).
+        assert end <= n
         perm = compute_shuffle_permutation(bytes(seed), n, round_count)
         return [indices[perm[i]] for i in range(start, end)]
 

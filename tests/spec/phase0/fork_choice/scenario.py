@@ -1,0 +1,75 @@
+"""Shared scaffolding for step-scripted fork-choice scenarios.
+
+Every adversarial case in this package is "build a small block DAG off a
+common base, deliver pieces in a chosen order, assert the head after each
+delivery".  The builders here keep the per-test bodies down to the
+scenario script itself (reference capability: the repeated inline setup of
+test/phase0/fork_choice/test_ex_ante.py et al.).
+"""
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+    sign_attestation,
+)
+from consensus_specs_tpu.testing.helpers.block import build_empty_block
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    state_transition_and_sign_block,
+)
+
+
+def slot_time(spec, store, slot) -> int:
+    return int(store.genesis_time) + int(slot) * int(spec.config.SECONDS_PER_SLOT)
+
+
+def begin_forkchoice(spec, state, test_steps):
+    """Yield anchor parts, tick to the anchor's wall time, return the store."""
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(spec, store, slot_time(spec, store, state.slot), test_steps)
+    assert store.time == slot_time(spec, store, state.slot)
+    return store
+
+
+def make_branch_block(spec, base_state, slot):
+    """(signed block, its post-state) at ``slot`` branching off ``base_state``."""
+    post = base_state.copy()
+    block = build_empty_block(spec, post, slot=slot)
+    return state_transition_and_sign_block(spec, post, block), post
+
+
+def head_of(spec, store):
+    return spec.get_head(store)
+
+
+def root_of(signed_block):
+    return signed_block.message.hash_tree_root()
+
+
+def vote_for(spec, state, signed_block, participants=1):
+    """An attestation at ``state.slot`` by the first ``participants``
+    committee members, pointed at ``signed_block``."""
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot, signed=False,
+        filter_participant_set=lambda comm: set(sorted(comm)[:participants]))
+    attestation.data.beacon_block_root = root_of(signed_block)
+    assert sum(1 for bit in attestation.aggregation_bits if bit) == participants
+    sign_attestation(spec, state, attestation)
+    return attestation
+
+
+def min_attesters_to_beat_boost(spec, store, state, boosted_root, target_root):
+    """Smallest single-slot attester count whose LMD weight exceeds the
+    proposer boost credited to ``boosted_root`` (all balances equal in the
+    mock registry, so weight = count * effective balance)."""
+    block = store.blocks[target_root]
+    boost_score = 0
+    if spec.get_ancestor(store, target_root, block.slot) == boosted_root:
+        active = len(spec.get_active_validator_indices(state, spec.get_current_epoch(state)))
+        avg_balance = spec.get_total_active_balance(state) // active
+        committee_weight = (active // spec.SLOTS_PER_EPOCH) * avg_balance
+        boost_score = committee_weight * spec.config.PROPOSER_SCORE_BOOST // 100
+    return int(boost_score // state.validators[0].effective_balance) + 1

@@ -1,0 +1,194 @@
+"""Ex-ante re-org attack scenarios (reference suite:
+test/phase0/fork_choice/test_ex_ante.py).
+
+The attacker withholds a block (and possibly a small attestation set) to
+displace an honest block; PROPOSER_SCORE_BOOST is the defense under test
+(phase0/fork-choice.md get_latest_attesting_balance proposer-boost term).
+"""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+    with_presets,
+)
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    add_attestation,
+    add_block,
+    on_tick_and_append_step,
+    tick_and_add_block,
+)
+from consensus_specs_tpu.testing.helpers.constants import MAINNET
+
+from .scenario import (
+    begin_forkchoice,
+    head_of,
+    make_branch_block,
+    min_attesters_to_beat_boost,
+    root_of,
+    slot_time,
+    vote_for,
+)
+
+
+def _base_plus_forks(spec, state, store, test_steps, with_d=False):
+    """Common DAG: A at N+1 (delivered, head), then withheld B (N+2, parent
+    A) and honest C (N+3, parent A); optionally D (N+4, parent B)."""
+    signed_a, state_a = make_branch_block(spec, state, state.slot + 1)
+    yield from tick_and_add_block(spec, store, signed_a, test_steps)
+    assert head_of(spec, store) == root_of(signed_a)
+
+    signed_b, state_b = make_branch_block(spec, state_a, state_a.slot + 1)
+    signed_c, state_c = make_branch_block(spec, state_a, state_a.slot + 2)
+    out = [signed_a, state_a, signed_b, state_b, signed_c, state_c]
+    if with_d:
+        signed_d, state_d = make_branch_block(spec, state_b, state_a.slot + 3)
+        out += [signed_d, state_d]
+    return out
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_vanilla(spec, state):
+    """One adversarial attestation is not enough against the boost:
+    deliver C at its slot (head), then late B (C keeps head via boost),
+    then a single vote for B (C still head)."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    (_, _, signed_b, state_b,
+     signed_c, state_c) = yield from _base_plus_forks(spec, state, store, test_steps)
+
+    withheld_vote = vote_for(spec, state_b, signed_b, participants=1)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state_c.slot), test_steps)
+    yield from add_block(spec, store, signed_c, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield from add_block(spec, store, signed_b, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield from add_attestation(spec, store, withheld_vote, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@with_presets([MAINNET], reason="needs non-duplicate committees across slots")
+@spec_state_test
+def test_ex_ante_attestations_is_greater_than_proposer_boost_with_boost(spec, state):
+    """Enough adversarial attestations DO beat the boost: B flips the head
+    once its single-slot vote weight exceeds C's proposer score."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    (_, _, signed_b, state_b,
+     signed_c, state_c) = yield from _base_plus_forks(spec, state, store, test_steps)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state_c.slot), test_steps)
+    yield from add_block(spec, store, signed_c, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield from add_block(spec, store, signed_b, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    needed = min_attesters_to_beat_boost(
+        spec, store, state, root_of(signed_b), root_of(signed_b))
+    attack = vote_for(spec, state_b, signed_b, participants=needed)
+    yield from add_attestation(spec, store, attack, test_steps)
+    assert head_of(spec, store) == root_of(signed_b)
+
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_sandwich_without_attestations(spec, state):
+    """Boost-only sandwich: C is boosted over late B, then D (child of B)
+    arrives on time and takes the head with its own boost."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    (_, _, signed_b, _, signed_c, state_c,
+     signed_d, state_d) = yield from _base_plus_forks(
+        spec, state, store, test_steps, with_d=True)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state_c.slot), test_steps)
+    yield from add_block(spec, store, signed_c, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield from add_block(spec, store, signed_b, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state_d.slot), test_steps)
+    yield from add_block(spec, store, signed_d, test_steps)
+    assert head_of(spec, store) == root_of(signed_d)
+
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_sandwich_with_honest_attestation(spec, state):
+    """An honest vote for C alone cannot stop the D-boost sandwich (one
+    vote < boost), so D still becomes head."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    (_, _, signed_b, _, signed_c, state_c,
+     signed_d, state_d) = yield from _base_plus_forks(
+        spec, state, store, test_steps, with_d=True)
+
+    honest_vote = vote_for(spec, state_c, signed_c, participants=1)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state_c.slot), test_steps)
+    yield from add_block(spec, store, signed_c, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield from add_block(spec, store, signed_b, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state_d.slot), test_steps)
+    yield from add_attestation(spec, store, honest_vote, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield from add_block(spec, store, signed_d, test_steps)
+    assert head_of(spec, store) == root_of(signed_d)
+
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@with_presets([MAINNET], reason="needs non-duplicate committees across slots")
+@spec_state_test
+def test_ex_ante_sandwich_with_boost_not_sufficient(spec, state):
+    """Once C has boost-beating honest votes, D's proposer boost is not
+    enough to complete the sandwich — C keeps the head."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    (_, _, signed_b, _, signed_c, state_c,
+     signed_d, state_d) = yield from _base_plus_forks(
+        spec, state, store, test_steps, with_d=True)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state_c.slot), test_steps)
+    yield from add_block(spec, store, signed_c, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield from add_block(spec, store, signed_b, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    needed = min_attesters_to_beat_boost(
+        spec, store, state, root_of(signed_c), root_of(signed_c))
+    honest_votes = vote_for(spec, state_c, signed_c, participants=needed)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state_d.slot), test_steps)
+    yield from add_attestation(spec, store, honest_votes, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield from add_block(spec, store, signed_d, test_steps)
+    assert head_of(spec, store) == root_of(signed_c)
+
+    yield "steps", "data", test_steps

@@ -1,0 +1,307 @@
+"""get_head scenarios: tie breaking, weight vs length, viability filtering,
+proposer-boost lifecycle and equivocation discard (reference suite:
+test/phase0/fork_choice/test_get_head.py)."""
+import random
+
+from consensus_specs_tpu.testing.context import (
+    is_post_altair,
+    spec_state_test,
+    with_all_phases,
+    with_presets,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+    next_epoch_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.attester_slashings import (
+    get_indexed_attestation_participants,
+)
+from consensus_specs_tpu.testing.helpers.block import (
+    apply_empty_block,
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testing.helpers.constants import MINIMAL
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    add_attestation,
+    add_attester_slashing,
+    add_block,
+    get_anchor_root,
+    get_formatted_head_output,
+    on_tick_and_append_step,
+    tick_and_add_block,
+    tick_and_run_on_attestation,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    next_slots,
+    state_transition_and_sign_block,
+)
+
+from .scenario import begin_forkchoice, head_of, root_of, slot_time
+
+_rng = random.Random(1001)
+
+
+def _check_head(spec, store, test_steps):
+    test_steps.append({"checks": {"head": get_formatted_head_output(spec, store)}})
+
+
+def _distinct_block_with_larger_root(spec, base_state, other_root):
+    """A next-slot block whose root exceeds ``other_root`` (graffiti-ground
+    until the tie-breaker ordering is deterministic for the test)."""
+    block = build_empty_block_for_next_slot(spec, base_state)
+    signed = state_transition_and_sign_block(spec, base_state.copy(), block)
+    while root_of(signed) <= other_root:
+        block.body.graffiti = spec.Bytes32(
+            _rng.getrandbits(256).to_bytes(32, "big"))
+        signed = state_transition_and_sign_block(spec, base_state.copy(), block)
+    return signed
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis(spec, state):
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    assert head_of(spec, store) == get_anchor_root(spec, state)
+    test_steps.append({"checks": {
+        "genesis_time": int(store.genesis_time),
+        "head": get_formatted_head_output(spec, store),
+    }})
+    yield "steps", "data", test_steps
+    if is_post_altair(spec):
+        yield "description", "meta", \
+            f"Although it's not phase 0, we may use {spec.fork} spec to start testnets."
+
+
+@with_all_phases
+@spec_state_test
+def test_chain_no_attestations(spec, state):
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    _check_head(spec, store, test_steps)
+
+    last = None
+    for _ in range(2):
+        block = build_empty_block_for_next_slot(spec, state)
+        last = state_transition_and_sign_block(spec, state, block)
+        yield from tick_and_add_block(spec, store, last, test_steps)
+
+    assert head_of(spec, store) == root_of(last)
+    _check_head(spec, store, test_steps)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_split_tie_breaker_no_attestations(spec, state):
+    test_steps = []
+    genesis_state = state.copy()
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    _check_head(spec, store, test_steps)
+
+    # Two competing blocks in the same slot; no votes, no boost (delivered
+    # a slot late), so lexicographically-largest root must win.
+    side_a = genesis_state.copy()
+    signed_a = state_transition_and_sign_block(
+        spec, side_a, build_empty_block_for_next_slot(spec, side_a))
+    side_b = genesis_state.copy()
+    block_b = build_empty_block_for_next_slot(spec, side_b)
+    block_b.body.graffiti = b"\x42" * 32
+    signed_b = state_transition_and_sign_block(spec, side_b, block_b)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, signed_b.message.slot + 1), test_steps)
+    yield from add_block(spec, store, signed_a, test_steps)
+    yield from add_block(spec, store, signed_b, test_steps)
+
+    assert head_of(spec, store) == max(root_of(signed_a), root_of(signed_b))
+    _check_head(spec, store, test_steps)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_shorter_chain_but_heavier_weight(spec, state):
+    test_steps = []
+    genesis_state = state.copy()
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    _check_head(spec, store, test_steps)
+
+    # Three-block chain vs a one-block fork.
+    long_state = genesis_state.copy()
+    long_signed = None
+    for _ in range(3):
+        long_signed = state_transition_and_sign_block(
+            spec, long_state, build_empty_block_for_next_slot(spec, long_state))
+        yield from tick_and_add_block(spec, store, long_signed, test_steps)
+
+    short_state = genesis_state.copy()
+    short_block = build_empty_block_for_next_slot(spec, short_state)
+    short_block.body.graffiti = b"\x42" * 32
+    short_signed = state_transition_and_sign_block(spec, short_state, short_block)
+    yield from tick_and_add_block(spec, store, short_signed, test_steps)
+    assert head_of(spec, store) == root_of(long_signed)
+
+    # One attestation on the short fork outweighs the longer empty chain.
+    short_vote = get_valid_attestation(
+        spec, short_state, short_block.slot, signed=True)
+    yield from tick_and_run_on_attestation(spec, store, short_vote, test_steps)
+    assert head_of(spec, store) == root_of(short_signed)
+    _check_head(spec, store, test_steps)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@with_presets([MINIMAL], reason="too slow")
+def test_filtered_block_tree(spec, state):
+    """A branch carrying votes but descending from a non-viable (unjustified
+    in its own chain) ancestor must be filtered out of the head walk
+    (phase0/fork-choice.md filter_block_tree)."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    _check_head(spec, store, test_steps)
+
+    # Justify an epoch on the honest branch.
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    prev_state, signed_blocks, state = next_epoch_with_attestations(
+        spec, state, True, False)
+    assert (state.current_justified_checkpoint.epoch
+            > prev_state.current_justified_checkpoint.epoch)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state.slot), test_steps)
+    for signed_block in signed_blocks:
+        yield from add_block(spec, store, signed_block, test_steps)
+    assert store.justified_checkpoint == state.current_justified_checkpoint
+
+    viable_head = root_of(signed_blocks[-1])
+    assert head_of(spec, store) == viable_head
+    test_steps.append({"checks": {
+        "head": get_formatted_head_output(spec, store),
+        "justified_checkpoint_root":
+            "0x" + bytes(store.justified_checkpoint.root).hex(),
+    }})
+
+    # Rogue branch: grows from the justified block but never justifies it
+    # on-chain, then soaks up a whole epoch of votes.
+    rogue_state = store.block_states[store.justified_checkpoint.root].copy()
+    for _ in range(3):
+        next_epoch(spec, rogue_state)
+    assert spec.get_current_epoch(rogue_state) > store.justified_checkpoint.epoch
+
+    rogue_block = build_empty_block_for_next_slot(spec, rogue_state)
+    signed_rogue = state_transition_and_sign_block(spec, rogue_state, rogue_block)
+
+    next_epoch(spec, rogue_state)
+    rogue_votes = []
+    for offset in range(spec.SLOTS_PER_EPOCH):
+        slot = rogue_block.slot + offset
+        for index in range(spec.get_committee_count_per_slot(
+                rogue_state, spec.compute_epoch_at_slot(slot))):
+            rogue_votes.append(get_valid_attestation(
+                spec, rogue_state, slot, index, signed=True))
+
+    on_tick_and_append_step(
+        spec, store,
+        slot_time(spec, store, rogue_votes[-1].data.slot + 1), test_steps)
+    yield from add_block(spec, store, signed_rogue, test_steps)
+    for vote in rogue_votes:
+        yield from tick_and_run_on_attestation(spec, store, vote, test_steps)
+
+    # All those votes must not move the head off the viable branch.
+    assert head_of(spec, store) == viable_head
+    _check_head(spec, store, test_steps)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_correct_head(spec, state):
+    """Boost wins the head only during the boosted slot; the next on_tick
+    clears proposer_boost_root and the head reverts."""
+    test_steps = []
+    genesis_state = state.copy()
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    _check_head(spec, store, test_steps)
+
+    timely_state = genesis_state.copy()
+    next_slots(spec, timely_state, 3)
+    timely_block = build_empty_block_for_next_slot(spec, timely_state)
+    signed_timely = state_transition_and_sign_block(spec, timely_state, timely_block)
+
+    rival_state = genesis_state.copy()
+    next_slots(spec, rival_state, 2)
+    signed_rival = _distinct_block_with_larger_root(
+        spec, rival_state, root_of(signed_timely))
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, timely_block.slot), test_steps)
+    yield from add_block(spec, store, signed_rival, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    assert head_of(spec, store) == root_of(signed_rival)
+
+    yield from add_block(spec, store, signed_timely, test_steps)
+    assert store.proposer_boost_root == root_of(signed_timely)
+    assert head_of(spec, store) == root_of(signed_timely)
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, timely_block.slot + 1), test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    assert head_of(spec, store) == root_of(signed_rival)
+    _check_head(spec, store, test_steps)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_discard_equivocations(spec, state):
+    """An attester slashing delivered to the store must erase the equivocating
+    validators' latest messages from the head walk."""
+    test_steps = []
+    genesis_state = state.copy()
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    _check_head(spec, store, test_steps)
+
+    fork_state = genesis_state.copy()
+    next_slots(spec, fork_state, 3)
+    fork_block = build_empty_block_for_next_slot(spec, fork_state)
+    signed_fork = state_transition_and_sign_block(spec, fork_state, fork_block)
+
+    # Two slashable votes for the same target slot from the same committee.
+    eqv_state = fork_state.copy()
+    eqv_block = apply_empty_block(spec, eqv_state, eqv_state.slot + 1)
+    vote_eqv = get_valid_attestation(spec, eqv_state, slot=eqv_block.slot, signed=True)
+    next_slots(spec, fork_state, 1)
+    vote = get_valid_attestation(spec, fork_state, slot=eqv_block.slot, signed=True)
+    assert spec.is_slashable_attestation_data(vote.data, vote_eqv.data)
+    slashing = spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(fork_state, vote),
+        attestation_2=spec.get_indexed_attestation(eqv_state, vote_eqv))
+    assert get_indexed_attestation_participants(spec, slashing.attestation_1)
+
+    rival_state = genesis_state.copy()
+    next_slots(spec, rival_state, 2)
+    signed_rival = _distinct_block_with_larger_root(
+        spec, rival_state, root_of(signed_fork))
+
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, eqv_block.slot + 2), test_steps)
+    yield from add_block(spec, store, signed_rival, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    assert head_of(spec, store) == root_of(signed_rival)
+
+    yield from add_block(spec, store, signed_fork, test_steps)
+    assert head_of(spec, store) == root_of(signed_rival)
+
+    # The vote flips the head to the fork...
+    yield from add_attestation(spec, store, vote, test_steps)
+    assert head_of(spec, store) == root_of(signed_fork)
+
+    # ...until the slashing discards those attesters' messages.
+    yield from add_attester_slashing(spec, store, slashing, test_steps)
+    assert head_of(spec, store) == root_of(signed_rival)
+    _check_head(spec, store, test_steps)
+    yield "steps", "data", test_steps

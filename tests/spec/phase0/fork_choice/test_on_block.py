@@ -1,0 +1,514 @@
+"""on_block handler scenarios: arrival-time rules, finalized-ancestry
+checks, justified-checkpoint update policy (safe-slots window), and the
+proposer-boost set/clear lifecycle (reference suite:
+test/phase0/fork_choice/test_on_block.py)."""
+import random
+
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+    with_presets,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    next_epoch_with_attestations,
+    next_slots_with_attestations,
+    state_transition_with_full_attestations_block,
+    state_transition_with_full_block,
+)
+from consensus_specs_tpu.testing.helpers.block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+    sign_block,
+    transition_unsigned_block,
+)
+from consensus_specs_tpu.testing.helpers.constants import MINIMAL
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    add_block,
+    apply_next_epoch_with_attestations,
+    apply_next_slots_with_attestations,
+    on_tick_and_append_step,
+    tick_and_add_block,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    next_slots,
+    state_transition_and_sign_block,
+)
+
+from .scenario import begin_forkchoice, head_of, root_of, slot_time
+
+_rng = random.Random(2020)
+
+
+def _drop_random_third(_slot, _index, indices):
+    keep = len(indices) - len(indices) // 3
+    assert len(indices) >= 3
+    return _rng.sample(sorted(indices), keep)
+
+
+def _tick_to_state_slot(spec, store, state, test_steps):
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, state.slot), test_steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_basic(spec, state):
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    signed = state_transition_and_sign_block(
+        spec, state, build_empty_block_for_next_slot(spec, state))
+    yield from tick_and_add_block(spec, store, signed, test_steps)
+    assert head_of(spec, store) == root_of(signed)
+
+    # A whole-epoch gap before the next block is fine.
+    store.time = int(store.time) + int(spec.config.SECONDS_PER_SLOT) * int(spec.SLOTS_PER_EPOCH)
+    signed = state_transition_and_sign_block(
+        spec, state, build_empty_block(spec, state, state.slot + spec.SLOTS_PER_EPOCH))
+    yield from tick_and_add_block(spec, store, signed, test_steps)
+    assert head_of(spec, store) == root_of(signed)
+
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@with_presets([MINIMAL], reason="too slow")
+def test_on_block_checkpoints(spec, state):
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    next_epoch(spec, state)
+    _tick_to_state_slot(spec, store, state, test_steps)
+    state, store, last_signed = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps=test_steps)
+    last_root = root_of(last_signed)
+    assert head_of(spec, store) == last_root
+
+    next_epoch(spec, state)
+    _tick_to_state_slot(spec, store, state, test_steps)
+
+    # Pretend the last block's justified checkpoint got finalized, and show
+    # a block built on that view is accepted.
+    mocked = store.block_states[last_root].copy()
+    mocked.finalized_checkpoint = mocked.current_justified_checkpoint.copy()
+    signed = state_transition_and_sign_block(
+        spec, mocked.copy(), build_empty_block_for_next_slot(spec, mocked))
+    yield from tick_and_add_block(spec, store, signed, test_steps)
+    assert head_of(spec, store) == root_of(signed)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_future_block(spec, state):
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    # Clock stays at genesis: a block for the next slot is from the future
+    # and must be rejected.
+    signed = state_transition_and_sign_block(
+        spec, state, build_empty_block_for_next_slot(spec, state))
+    yield from add_block(spec, store, signed, test_steps, valid=False)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_bad_parent_root(spec, state):
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_unsigned_block(spec, state, block)
+    block.state_root = state.hash_tree_root()
+    block.parent_root = b"\x45" * 32  # nonexistent parent
+    signed = sign_block(spec, state, block)
+    yield from add_block(spec, store, signed, test_steps, valid=False)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@with_presets([MINIMAL], reason="too slow")
+def test_on_block_before_finalized(spec, state):
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    stale_state = state.copy()
+
+    for _ in range(4):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, False, test_steps=test_steps)
+    assert store.finalized_checkpoint.epoch == 2
+
+    # A genesis-descended block below the finalized slot must be rejected.
+    block = build_empty_block_for_next_slot(spec, stale_state)
+    block.body.graffiti = b"\x12" * 32
+    signed = state_transition_and_sign_block(spec, stale_state, block)
+    assert root_of(signed) not in store.blocks
+    yield from tick_and_add_block(spec, store, signed, test_steps, valid=False)
+    yield "steps", "data", test_steps
+
+
+def _finalize_epoch_2_with_skipped_boundary(spec, state, store, test_steps):
+    """Shared ladder: fill epoch 0 + first slot of epoch 1, skip one epoch
+    (making the finalized epoch's start slot a skipped slot), fill two more
+    epochs -> finalized epoch 2 whose start slot is empty."""
+    state, store, _ = yield from apply_next_slots_with_attestations(
+        spec, state, store, spec.SLOTS_PER_EPOCH, True, False, test_steps)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    target_state = state.copy()
+    for _ in range(2):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, True, test_steps=test_steps)
+    assert state.finalized_checkpoint.epoch == store.finalized_checkpoint.epoch == 2
+    assert store.finalized_checkpoint.root == spec.get_block_root(state, 1) \
+        == spec.get_block_root(state, 2)
+    assert state.current_justified_checkpoint.epoch == store.justified_checkpoint.epoch == 3
+    return state, target_state
+
+
+@with_all_phases
+@spec_state_test
+@with_presets([MINIMAL], reason="too slow")
+def test_on_block_finalized_skip_slots(spec, state):
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    state, target_state = yield from _finalize_epoch_2_with_skipped_boundary(
+        spec, state, store, test_steps)
+
+    # Build through the skipped slots ON the finalized chain: accepted.
+    signed = state_transition_and_sign_block(
+        spec, target_state, build_empty_block_for_next_slot(spec, target_state))
+    yield from tick_and_add_block(spec, store, signed, test_steps)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@with_presets([MINIMAL], reason="too slow")
+def test_on_block_finalized_skip_slots_not_in_skip_chain(spec, state):
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+    state, _ = yield from _finalize_epoch_2_with_skipped_boundary(
+        spec, state, store, test_steps)
+
+    # Build from the finalized root itself (one epoch BELOW the finalized
+    # slot, since the boundary slot was skipped): must be rejected.
+    stale = store.block_states[store.finalized_checkpoint.root].copy()
+    assert stale.slot == spec.compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch - 1)
+    signed = state_transition_and_sign_block(
+        spec, stale, build_empty_block_for_next_slot(spec, stale))
+    yield from tick_and_add_block(spec, store, signed, test_steps, valid=False)
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@with_presets([MINIMAL], reason="needs more pre-generated keys than mainnet config allows")
+def test_on_block_update_justified_checkpoint_within_safe_slots(spec, state):
+    """Inside SAFE_SLOTS_TO_UPDATE_JUSTIFIED, a block with a newer justified
+    checkpoint updates store.justified_checkpoint immediately."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    for _ in range(2):
+        next_epoch(spec, state)
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps=test_steps)
+    assert store.justified_checkpoint.epoch == 2
+    for _ in range(2):
+        next_epoch(spec, state)
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, False,
+        participation_fn=_drop_random_third, test_steps=test_steps)
+    assert store.justified_checkpoint.epoch == 2
+
+    next_epoch(spec, state)
+    pre_finalized_epoch = int(state.finalized_checkpoint.epoch)
+
+    signed = state_transition_with_full_block(spec, state, True, True)
+    assert state.current_justified_checkpoint.epoch == 5
+    assert state.current_justified_checkpoint.epoch > store.justified_checkpoint.epoch
+    assert (spec.get_current_slot(store) % spec.SLOTS_PER_EPOCH
+            < spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED)
+    yield from tick_and_add_block(spec, store, signed, test_steps)
+
+    assert store.justified_checkpoint.epoch == 5
+    assert store.justified_checkpoint == state.current_justified_checkpoint
+    assert int(store.finalized_checkpoint.epoch) == pre_finalized_epoch == 0
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@with_presets([MINIMAL], reason="assumes MAX_ATTESTATIONS >= 2/3 of an epoch")
+@spec_state_test
+def test_on_block_outside_safe_slots_but_finality(spec, state):
+    """Outside the safe-slots window, the update still happens when the new
+    justified checkpoint does not conflict (finality advanced)."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    next_epoch(spec, state)
+    for _ in range(3):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, False, test_steps=test_steps)
+    assert store.finalized_checkpoint.epoch == 2
+    assert store.justified_checkpoint.epoch == 3
+
+    for _ in range(3):
+        next_epoch(spec, state)
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, True, test_steps=test_steps)
+    assert state.current_justified_checkpoint.epoch == 7
+
+    state, store, _ = yield from apply_next_slots_with_attestations(
+        spec, state, store, 5, True, True, test_steps)
+    assert store.justified_checkpoint.epoch == 7
+
+    # Block at epoch 9 slot 5 carrying the full backlog.
+    next_epoch(spec, state)
+    next_slots(spec, state, 4)
+    signed = state_transition_with_full_attestations_block(spec, state, True, True)
+    yield from tick_and_add_block(spec, store, signed, test_steps)
+    assert store.justified_checkpoint.epoch == 7
+
+    # Empty block late in epoch 10, past the safe window, advancing finality.
+    next_epoch(spec, state)
+    next_slots(spec, state, 4)
+    signed = state_transition_and_sign_block(
+        spec, state, build_empty_block_for_next_slot(spec, state))
+    assert state.finalized_checkpoint.epoch == 7
+    assert state.current_justified_checkpoint.epoch == 8
+    if store.time < spec.compute_time_at_slot(state, signed.message.slot):
+        on_tick_and_append_step(
+            spec, store, slot_time(spec, store, signed.message.slot), test_steps)
+    assert (spec.get_current_slot(store) % spec.SLOTS_PER_EPOCH
+            >= spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED)
+    yield from add_block(spec, store, signed, test_steps)
+
+    assert store.finalized_checkpoint == state.finalized_checkpoint
+    assert store.justified_checkpoint == state.current_justified_checkpoint
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@with_presets([MINIMAL], reason="assumes MAX_ATTESTATIONS >= 2/3 of an epoch")
+@spec_state_test
+def test_new_justified_is_later_than_store_justified(spec, state):
+    """Three competing forks: one parks a later checkpoint in
+    best_justified (outside safe slots), another later supersedes the
+    store's justified checkpoint via finality."""
+    fork_1 = state.copy()
+    fork_3 = state.copy()
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    # Fork 1: justify epoch 3.
+    next_epoch(spec, fork_1)
+    fork_1, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, fork_1, store, False, True, test_steps=test_steps)
+    fork_2 = fork_1.copy()
+    assert spec.get_current_epoch(fork_2) == 2
+    next_epoch(spec, fork_1)
+    for _ in range(2):
+        fork_1, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, fork_1, store, False, True, test_steps=test_steps)
+    assert store.justified_checkpoint.epoch == 3
+    assert store.finalized_checkpoint.epoch == 0
+
+    # Fork 2: deliver a justified-epoch-5 block outside safe slots — only
+    # best_justified_checkpoint moves.
+    signed = state_transition_and_sign_block(
+        spec, fork_2, build_empty_block_for_next_slot(spec, fork_2))
+    yield from tick_and_add_block(spec, store, signed, test_steps)
+    for _ in range(2):
+        next_epoch(spec, fork_2)
+    for _ in range(2):
+        next_epoch(spec, fork_2)
+        next_slots(spec, fork_2, 4)
+        signed = state_transition_with_full_attestations_block(spec, fork_2, True, True)
+        yield from tick_and_add_block(spec, store, signed, test_steps)
+        assert fork_2.current_justified_checkpoint.epoch == 0
+    next_epoch(spec, fork_2)
+    next_slots(spec, fork_2, spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED + 2)
+    signed = state_transition_with_full_attestations_block(spec, fork_2, True, True)
+    assert fork_2.current_justified_checkpoint.epoch == 5
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, fork_2.slot), test_steps)
+    assert (spec.compute_slots_since_epoch_start(spec.get_current_slot(store))
+            >= spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED)
+    yield from add_block(spec, store, signed, test_steps)
+    assert store.justified_checkpoint.epoch == 3
+    assert store.best_justified_checkpoint.epoch == 5
+
+    # Fork 3: finality-driven update replaces the store's justified
+    # checkpoint with its own (later than 3, distinct from fork 2's).
+    blocks = []
+    for _ in range(3):
+        next_epoch(spec, fork_3)
+    _, signed_blocks, fork_3 = next_epoch_with_attestations(spec, fork_3, True, True)
+    blocks += signed_blocks
+    _, signed_blocks, fork_3 = next_slots_with_attestations(spec, fork_3, 5, True, True)
+    blocks += signed_blocks.copy()
+    for _ in range(2):
+        next_epoch(spec, fork_3)
+        next_slots(spec, fork_3, 4)
+        blocks.append(state_transition_with_full_block(spec, fork_3, True, True).copy())
+    assert fork_3.finalized_checkpoint.epoch == 3
+    assert fork_3.current_justified_checkpoint.epoch == 4
+
+    for signed_block in blocks:
+        if store.time < spec.compute_time_at_slot(fork_2, signed_block.message.slot):
+            on_tick_and_append_step(
+                spec, store, slot_time(spec, store, signed_block.message.slot),
+                test_steps)
+        yield from add_block(spec, store, signed_block, test_steps)
+
+    assert store.finalized_checkpoint == fork_3.finalized_checkpoint
+    assert store.justified_checkpoint == fork_3.current_justified_checkpoint
+    assert store.justified_checkpoint != store.best_justified_checkpoint
+    assert store.best_justified_checkpoint == fork_2.current_justified_checkpoint
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@with_presets([MINIMAL], reason="too slow")
+def test_new_finalized_slot_is_not_justified_checkpoint_ancestor(spec, state):
+    """Competing fork finalizes an epoch whose boundary is NOT an ancestor
+    of the store's justified root: both checkpoints must be replaced."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    next_epoch(spec, state)
+    rival = state.copy()
+
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, False, True, test_steps=test_steps)
+    next_epoch(spec, state)
+    for _ in range(2):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, False, True, test_steps=test_steps)
+    assert store.finalized_checkpoint.epoch == 0
+    assert store.justified_checkpoint.epoch == 3
+
+    blocks = []
+    for _ in range(3):
+        _, signed_blocks, rival = next_epoch_with_attestations(spec, rival, True, True)
+        blocks += signed_blocks
+    assert rival.finalized_checkpoint.epoch == 2
+    assert rival.current_justified_checkpoint.epoch == 3
+    assert state.current_justified_checkpoint != rival.current_justified_checkpoint
+
+    old_justified_root = store.justified_checkpoint.root
+    for signed_block in blocks:  # no on_tick: arrivals are all "late"
+        yield from add_block(spec, store, signed_block, test_steps)
+
+    finalized_slot = spec.compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+    assert spec.get_ancestor(store, old_justified_root, finalized_slot) \
+        != store.finalized_checkpoint.root
+    assert store.finalized_checkpoint == rival.finalized_checkpoint
+    assert store.justified_checkpoint == rival.current_justified_checkpoint
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@with_presets([MINIMAL], reason="too slow")
+def test_new_finalized_slot_is_justified_checkpoint_ancestor(spec, state):
+    """Competing fork finalizes a boundary that IS an ancestor of the
+    store's justified root; justified updates via the non-conflict path."""
+    test_steps = []
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    next_epoch(spec, state)
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, False, True, test_steps=test_steps)
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps=test_steps)
+    next_epoch(spec, state)
+    for _ in range(2):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, False, True, test_steps=test_steps)
+    assert store.finalized_checkpoint.epoch == 2
+    assert store.justified_checkpoint.epoch == 4
+
+    rival = store.block_states[
+        spec.get_block_root_at_slot(state, spec.compute_start_slot_at_epoch(3))].copy()
+    blocks = []
+    for _ in range(2):
+        _, signed_blocks, rival = next_epoch_with_attestations(spec, rival, True, True)
+        blocks += signed_blocks
+    assert rival.finalized_checkpoint.epoch == 3
+    assert rival.current_justified_checkpoint.epoch == 4
+
+    old_justified_root = store.justified_checkpoint.root
+    for signed_block in blocks:
+        yield from tick_and_add_block(spec, store, signed_block, test_steps)
+
+    finalized_slot = spec.compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+    assert spec.get_ancestor(store, old_justified_root, finalized_slot) \
+        == store.finalized_checkpoint.root
+    assert store.finalized_checkpoint == rival.finalized_checkpoint
+    assert store.justified_checkpoint == rival.current_justified_checkpoint
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost(spec, state):
+    """Boost is granted on arrival inside the attesting interval (at its
+    edge and at its start) and cleared by the next-slot tick."""
+    test_steps = []
+    genesis_state = state.copy()
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    state = genesis_state.copy()
+    next_slots(spec, state, 3)
+    interval = int(spec.config.SECONDS_PER_SLOT) // int(spec.INTERVALS_PER_SLOT)
+
+    for arrival_offset in (interval - 1, 0):  # edge of interval, then start
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        on_tick_and_append_step(
+            spec, store,
+            slot_time(spec, store, block.slot) + arrival_offset, test_steps)
+        yield from add_block(spec, store, signed, test_steps)
+        assert store.proposer_boost_root == root_of(signed)
+        assert spec.get_latest_attesting_balance(store, root_of(signed)) > 0
+
+        on_tick_and_append_step(
+            spec, store, slot_time(spec, store, block.slot + 1), test_steps)
+        assert store.proposer_boost_root == spec.Root()
+        assert spec.get_latest_attesting_balance(store, root_of(signed)) == 0
+        next_slots(spec, state, 2)
+
+    test_steps.append({"checks": {
+        "proposer_boost_root": "0x" + bytes(store.proposer_boost_root).hex()}})
+    yield "steps", "data", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_root_same_slot_untimely_block(spec, state):
+    """Arrival one interval into the slot is untimely: no boost."""
+    test_steps = []
+    genesis_state = state.copy()
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    state = genesis_state.copy()
+    next_slots(spec, state, 3)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+
+    interval = int(spec.config.SECONDS_PER_SLOT) // int(spec.INTERVALS_PER_SLOT)
+    on_tick_and_append_step(
+        spec, store, slot_time(spec, store, block.slot) + interval, test_steps)
+    yield from add_block(spec, store, signed, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+
+    test_steps.append({"checks": {
+        "proposer_boost_root": "0x" + bytes(store.proposer_boost_root).hex()}})
+    yield "steps", "data", test_steps

@@ -1,0 +1,245 @@
+"""on_attestation validation matrix (reference suite:
+test/phase0/unittests/fork_choice/test_on_attestation.py): epoch-window
+rules, target/head store-membership and consistency rules, LMD message
+recording (phase0/fork-choice.md validate_on_attestation)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+    sign_attestation,
+)
+from consensus_specs_tpu.testing.helpers.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    get_genesis_forkchoice_store,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    next_slot,
+    state_transition_and_sign_block,
+    transition_to,
+)
+
+
+def _check_on_attestation(spec, state, store, attestation, valid=True):
+    """Feed on_attestation; valid deliveries must record the attesters'
+    latest LMD message, invalid ones must abort."""
+    if not valid:
+        try:
+            spec.on_attestation(store, attestation)
+        except AssertionError:
+            return
+        raise AssertionError("on_attestation accepted an invalid attestation")
+
+    indexed = spec.get_indexed_attestation(state, attestation)
+    spec.on_attestation(store, attestation)
+    probe = indexed.attesting_indices[0]
+    assert store.latest_messages[probe] == spec.LatestMessage(
+        epoch=attestation.data.target.epoch,
+        root=attestation.data.beacon_block_root,
+    )
+
+
+def _tick_slots(spec, store, slots):
+    spec.on_tick(store, int(store.time) + int(spec.config.SECONDS_PER_SLOT) * int(slots))
+
+
+def _block_into_store(spec, state, store):
+    signed = state_transition_and_sign_block(
+        spec, state, build_empty_block_for_next_slot(spec, state))
+    spec.on_block(store, signed)
+    return signed.message
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_current_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_slots(spec, store, 2)
+    block = _block_into_store(spec, state, store)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
+    assert spec.compute_epoch_at_slot(spec.get_current_slot(store)) == spec.GENESIS_EPOCH
+    _check_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_previous_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_slots(spec, store, spec.SLOTS_PER_EPOCH)
+    block = _block_into_store(spec, state, store)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
+    assert spec.compute_epoch_at_slot(spec.get_current_slot(store)) == spec.GENESIS_EPOCH + 1
+    _check_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_past_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_slots(spec, store, 2 * spec.SLOTS_PER_EPOCH)
+    _block_into_store(spec, state, store)
+
+    # Clock is 2 epochs ahead of the attestation's target: out of window.
+    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
+    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
+    _check_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_mismatched_target_and_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_slots(spec, store, spec.SLOTS_PER_EPOCH)
+    block = _block_into_store(spec, state, store)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot)
+    attestation.data.target.epoch += 1  # target epoch != slot's epoch
+    sign_attestation(spec, state, attestation)
+    assert spec.compute_epoch_at_slot(attestation.data.slot) == spec.GENESIS_EPOCH
+    _check_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_inconsistent_target_and_head(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_slots(spec, store, 2 * spec.SLOTS_PER_EPOCH)
+
+    # Chain 1: empty through the first epoch boundary.
+    chain_1 = state.copy()
+    next_epoch(spec, chain_1)
+    # Chain 2: contains one distinct block, then crosses the boundary.
+    chain_2 = state.copy()
+    signed_diff = state_transition_and_sign_block(
+        spec, chain_2, build_empty_block_for_next_slot(spec, chain_2))
+    spec.on_block(store, signed_diff)
+    next_epoch(spec, chain_2)
+    next_slot(spec, chain_2)
+
+    # Head on chain 1, target checkpoint taken from chain 2: inconsistent.
+    head_block = build_empty_block_for_next_slot(spec, chain_1)
+    spec.on_block(store, state_transition_and_sign_block(spec, chain_1, head_block))
+    attestation = get_valid_attestation(spec, chain_1, slot=head_block.slot, signed=False)
+    epoch = spec.compute_epoch_at_slot(attestation.data.slot)
+    attestation.data.target = spec.Checkpoint(
+        epoch=epoch, root=spec.get_block_root(chain_2, epoch))
+    sign_attestation(spec, chain_1, attestation)
+    assert spec.get_block_root(chain_1, epoch) != attestation.data.target.root
+    _check_on_attestation(spec, state, store, attestation, valid=False)
+
+
+def _target_block_near_epoch_boundary(spec, state, store, slots_before_boundary):
+    _tick_slots(spec, store, spec.SLOTS_PER_EPOCH + 1)
+    boundary = spec.compute_start_slot_at_epoch(spec.get_current_epoch(state) + 1)
+    transition_to(spec, state, boundary - slots_before_boundary)
+    target_block = build_empty_block_for_next_slot(spec, state)
+    return target_block, state_transition_and_sign_block(spec, state, target_block)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_target_block_not_in_store(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    target_block, _ = _target_block_near_epoch_boundary(spec, state, store, 1)
+    # deliberately NOT delivered to the store
+    attestation = get_valid_attestation(spec, state, slot=target_block.slot, signed=True)
+    assert attestation.data.target.root == target_block.hash_tree_root()
+    _check_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_target_checkpoint_not_in_store(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    target_block, signed = _target_block_near_epoch_boundary(spec, state, store, 1)
+    spec.on_block(store, signed)
+    # checkpoint state not yet materialized in store: must be derived
+    attestation = get_valid_attestation(spec, state, slot=target_block.slot, signed=True)
+    assert attestation.data.target.root == target_block.hash_tree_root()
+    _check_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_target_checkpoint_not_in_store_diff_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    target_block, signed = _target_block_near_epoch_boundary(spec, state, store, 2)
+    spec.on_block(store, signed)
+    # attest one (empty) slot after the target block
+    attestation_slot = target_block.slot + 1
+    transition_to(spec, state, attestation_slot)
+    attestation = get_valid_attestation(spec, state, slot=attestation_slot, signed=True)
+    assert attestation.data.target.root == target_block.hash_tree_root()
+    _check_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_beacon_block_not_in_store(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    target_block, signed = _target_block_near_epoch_boundary(spec, state, store, 1)
+    spec.on_block(store, signed)
+
+    head_block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, head_block)
+    # head block withheld from the store
+    attestation = get_valid_attestation(spec, state, slot=head_block.slot, signed=True)
+    assert attestation.data.target.root == target_block.hash_tree_root()
+    assert attestation.data.beacon_block_root == head_block.hash_tree_root()
+    _check_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_future_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_slots(spec, store, 3)
+    _block_into_store(spec, state, store)
+    next_epoch(spec, state)  # state leaves the store's clock behind
+    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
+    _check_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_future_block(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_slots(spec, store, 5)
+    signed = state_transition_and_sign_block(
+        spec, state, build_empty_block_for_next_slot(spec, state))
+    spec.on_block(store, signed)
+    # attestation dated before the block it points at
+    attestation = get_valid_attestation(
+        spec, state, slot=signed.message.slot - 1, signed=False)
+    attestation.data.beacon_block_root = signed.message.hash_tree_root()
+    sign_attestation(spec, state, attestation)
+    _check_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_same_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_slots(spec, store, 1)
+    block = _block_into_store(spec, state, store)
+    # same-slot delivery violates the one-slot propagation delay
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    _check_on_attestation(spec, state, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_invalid_attestation(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_slots(spec, store, 3)
+    block = _block_into_store(spec, state, store)
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    # out-of-range committee index makes the attestation itself invalid
+    attestation.data.index = spec.MAX_COMMITTEES_PER_SLOT * spec.SLOTS_PER_EPOCH
+    _check_on_attestation(spec, state, store, attestation, valid=False)

@@ -1,0 +1,77 @@
+"""on_block best-justified bookkeeping when multiple better justifications
+arrive outside the safe-slots window (reference suite:
+test/phase0/unittests/fork_choice/test_on_block.py)."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    apply_next_epoch_with_attestations,
+    get_genesis_forkchoice_store,
+    run_on_block,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_outside_safe_slots_and_multiple_better_justified(spec, state):
+    """Outside the safe window with a conflicting store.justified_checkpoint,
+    each better block only raises best_justified_checkpoint — justified and
+    finalized stay put until the next boundary tick."""
+    store = get_genesis_forkchoice_store(spec, state)
+
+    next_epoch(spec, state)
+    spec.on_tick(store, int(store.genesis_time) + int(state.slot) * int(spec.config.SECONDS_PER_SLOT))
+    state, store, last_signed_block = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, False)
+    last_block_root = last_signed_block.message.hash_tree_root()
+
+    # Fictitious justified checkpoint that no real chain contains.
+    store.justified_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(last_signed_block.message.slot),
+        root=spec.Root(b"JUSTIFIED".ljust(32, b"\x00")))
+
+    next_epoch(spec, state)
+    spec.on_tick(store, int(store.genesis_time) + int(state.slot) * int(spec.config.SECONDS_PER_SLOT))
+
+    # The would-be better justified root, registered but chain-less.
+    just_block = build_empty_block_for_next_slot(spec, state)
+    store.blocks[just_block.hash_tree_root()] = just_block
+
+    spec.on_tick(store, int(store.time)
+                 + int(spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED) * int(spec.config.SECONDS_PER_SLOT))
+    assert (spec.get_current_slot(store) % spec.SLOTS_PER_EPOCH
+            >= spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED)
+
+    finalized_before = store.finalized_checkpoint
+    justified_before = store.justified_checkpoint
+
+    best_seen = spec.Checkpoint(epoch=0)
+    for bump in range(3, 0, -1):
+        parent_state = store.block_states[last_block_root]
+        candidate = spec.Checkpoint(
+            epoch=justified_before.epoch + bump,
+            root=just_block.hash_tree_root())
+        if candidate.epoch > best_seen.epoch:
+            best_seen = candidate
+        parent_state.current_justified_checkpoint = candidate
+
+        block = build_empty_block_for_next_slot(spec, parent_state)
+        signed = state_transition_and_sign_block(spec, parent_state.copy(), block)
+
+        # Re-root the parent so the mutated state is reachable from the block.
+        patched_parent = store.blocks[last_block_root].copy()
+        patched_parent.state_root = parent_state.hash_tree_root()
+        store.blocks[block.parent_root] = patched_parent
+        store.block_states[block.parent_root] = parent_state.copy()
+
+        run_on_block(spec, store, signed)
+
+    assert store.finalized_checkpoint == finalized_before
+    assert store.justified_checkpoint == justified_before
+    assert store.best_justified_checkpoint == best_seen

@@ -1,0 +1,161 @@
+"""on_tick justified-checkpoint promotion rules (reference suite:
+test/phase0/unittests/fork_choice/test_on_tick.py): best_justified is
+promoted only at an epoch-boundary tick, only when newer, and only when
+its chain contains the store's finalized checkpoint."""
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testing.helpers.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    get_genesis_forkchoice_store,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+    transition_to,
+)
+
+
+def _tick_and_check(spec, store, time, expect_promotion=False):
+    before = store.justified_checkpoint
+    spec.on_tick(store, time)
+    assert store.time == time
+    if expect_promotion:
+        assert store.justified_checkpoint == store.best_justified_checkpoint
+        assert store.justified_checkpoint.epoch > before.epoch
+        assert store.justified_checkpoint.root != before.root
+    else:
+        assert store.justified_checkpoint == before
+
+
+def _register(spec, store, block, state):
+    store.blocks[block.hash_tree_root()] = block.copy()
+    store.block_states[block.hash_tree_root()] = state.copy()
+
+
+def _mock_best_justified_chain(spec, state, store):
+    """Grow a chain whose epoch-2 block claims an epoch-1 justified
+    checkpoint, and point store.best_justified_checkpoint at it."""
+    next_epoch(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+    _register(spec, store, block, state)
+    anchor_block = block.copy()
+
+    # park at the last slot of the epoch so the next tick is a boundary
+    transition_to(
+        spec, state,
+        state.slot + spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH - 1)
+    block = build_empty_block_for_next_slot(spec, state)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(anchor_block.slot),
+        root=anchor_block.hash_tree_root())
+    state_transition_and_sign_block(spec, state, block)
+    _register(spec, store, block, state)
+    store.best_justified_checkpoint = state.current_justified_checkpoint.copy()
+    return state
+
+
+@with_all_phases
+@spec_state_test
+def test_basic(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    _tick_and_check(spec, store, int(store.time) + 1)
+
+
+@with_all_phases
+@spec_state_test
+def test_update_justified_single_on_store_finalized_chain(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    state = _mock_best_justified_chain(spec, state, store)
+    _tick_and_check(
+        spec, store,
+        int(store.genesis_time) + int(state.slot) * int(spec.config.SECONDS_PER_SLOT),
+        expect_promotion=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_update_justified_single_not_on_store_finalized_chain(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    init_state = state.copy()
+
+    # Finalize a block on a DIFFERENT branch than the best-justified chain.
+    next_epoch(spec, state)
+    rival_block = build_empty_block_for_next_slot(spec, state)
+    rival_block.body.graffiti = b"\x11" * 32
+    state_transition_and_sign_block(spec, state, rival_block)
+    _register(spec, store, rival_block, state)
+    store.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(rival_block.slot),
+        root=rival_block.hash_tree_root())
+
+    # Best-justified chain grows from genesis, NOT through rival_block.
+    state = init_state.copy()
+    next_epoch(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.graffiti = b"\x22" * 32
+    state_transition_and_sign_block(spec, state, block)
+    _register(spec, store, block, state)
+    anchor_block = block.copy()
+    transition_to(
+        spec, state,
+        state.slot + spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH - 1)
+    block = build_empty_block_for_next_slot(spec, state)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(anchor_block.slot),
+        root=anchor_block.hash_tree_root())
+    state_transition_and_sign_block(spec, state, block)
+    _register(spec, store, block, state)
+    store.best_justified_checkpoint = state.current_justified_checkpoint.copy()
+
+    # Boundary tick, but the candidate's chain misses the finalized block.
+    _tick_and_check(
+        spec, store,
+        int(store.genesis_time) + int(state.slot) * int(spec.config.SECONDS_PER_SLOT))
+
+
+@with_all_phases
+@spec_state_test
+def test_no_update_same_slot_at_epoch_boundary(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    store.best_justified_checkpoint = spec.Checkpoint(
+        epoch=store.justified_checkpoint.epoch + 1, root=b"\x55" * 32)
+    # clock already sits exactly on the boundary; +1s is not a new boundary
+    store.time = int(spec.config.SECONDS_PER_SLOT) * int(spec.SLOTS_PER_EPOCH)
+    _tick_and_check(spec, store, int(store.time) + 1)
+
+
+@with_all_phases
+@spec_state_test
+def test_no_update_not_epoch_boundary(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    store.best_justified_checkpoint = spec.Checkpoint(
+        epoch=store.justified_checkpoint.epoch + 1, root=b"\x55" * 32)
+    _tick_and_check(
+        spec, store, int(store.time) + int(spec.config.SECONDS_PER_SLOT))
+
+
+@with_all_phases
+@spec_state_test
+def test_no_update_new_justified_equal_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    per_epoch = int(spec.config.SECONDS_PER_SLOT) * int(spec.SLOTS_PER_EPOCH)
+    store.best_justified_checkpoint = spec.Checkpoint(
+        epoch=store.justified_checkpoint.epoch + 1, root=b"\x55" * 32)
+    store.justified_checkpoint = spec.Checkpoint(
+        epoch=store.best_justified_checkpoint.epoch, root=b"\x44" * 32)
+    _tick_and_check(spec, store, int(store.time) + per_epoch)
+
+
+@with_all_phases
+@spec_state_test
+def test_no_update_new_justified_later_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    per_epoch = int(spec.config.SECONDS_PER_SLOT) * int(spec.SLOTS_PER_EPOCH)
+    store.best_justified_checkpoint = spec.Checkpoint(
+        epoch=store.justified_checkpoint.epoch + 1, root=b"\x55" * 32)
+    store.justified_checkpoint = spec.Checkpoint(
+        epoch=store.best_justified_checkpoint.epoch + 1, root=b"\x44" * 32)
+    _tick_and_check(spec, store, int(store.time) + per_epoch)
