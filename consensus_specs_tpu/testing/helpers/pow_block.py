@@ -20,8 +20,13 @@ class PowChain:
         return {block.block_hash: block for block in self.blocks}
 
 
+# One shared default stream: successive no-rng calls must produce DISTINCT
+# blocks (callers link them into chains by hash).
+_default_rng = Random(3131)
+
+
 def prepare_random_pow_block(spec, rng=None):
-    rng = rng or Random(3131)
+    rng = rng or _default_rng
 
     def _random_hash():
         return spec.hash(rng.getrandbits(256).to_bytes(32, "big"))
@@ -35,7 +40,7 @@ def prepare_random_pow_block(spec, rng=None):
 
 def prepare_random_pow_chain(spec, length, rng=None) -> PowChain:
     assert length > 0
-    rng = rng or Random(3131)
+    rng = rng or _default_rng  # same shared stream as the block helper
     chain = []
     for _ in range(length):
         block = prepare_random_pow_block(spec, rng)

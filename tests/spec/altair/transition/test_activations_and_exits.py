@@ -1,0 +1,179 @@
+"""Registry lifecycle straddling the fork boundary: exits initiated
+pre-fork that land after/at the fork, and activation queues crossing it
+(reference suite: test/altair/transition/test_activations_and_exits.py)."""
+import random
+
+from consensus_specs_tpu.testing.context import (
+    ForkMeta,
+    with_fork_metas,
+    with_presets,
+)
+from consensus_specs_tpu.testing.helpers.constants import (
+    ALL_PRE_POST_FORKS,
+    ALTAIR,
+    MINIMAL,
+)
+from consensus_specs_tpu.testing.helpers.fork_transition import (
+    do_fork,
+    transition_to_next_epoch_and_append_blocks,
+    transition_until_fork,
+)
+from consensus_specs_tpu.testing.helpers.random import (
+    exit_random_validators,
+    set_some_activations,
+    set_some_new_deposits,
+)
+
+_AT_FORK_2 = [ForkMeta(pre_fork_name=pre, post_fork_name=post, fork_epoch=2)
+              for pre, post in ALL_PRE_POST_FORKS]
+
+
+def _exit_quarter(spec, state, exit_epoch):
+    exited = exit_random_validators(
+        spec, state, rng=random.Random(5566), fraction=0.25,
+        exit_epoch=exit_epoch, from_epoch=spec.get_current_epoch(state))
+    assert exited
+    return exited
+
+
+@with_fork_metas(_AT_FORK_2)
+@with_presets([MINIMAL], reason="needs a registry larger than one sync committee")
+def test_transition_with_one_fourth_exiting_validators_exit_post_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    """Exits initiated pre-fork take effect only after the transition; the
+    exiting validators are still active on both sides."""
+    exited = _exit_quarter(spec, state, exit_epoch=10)
+
+    transition_until_fork(spec, state, fork_epoch)
+    now = spec.get_current_epoch(state)
+    for index in exited:
+        v = state.validators[index]
+        assert not v.slashed
+        assert fork_epoch < v.exit_epoch < spec.FAR_FUTURE_EPOCH
+        assert spec.is_active_validator(v, now)
+    assert not spec.is_in_inactivity_leak(state)
+
+    yield "pre", state
+
+    blocks = []
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(post_tag(fork_block))
+
+    # still-active exiting validators remain sync-committee eligible, so
+    # some (but not all) committee seats belong to them
+    exiting_keys = {bytes(state.validators[i].pubkey) for i in exited}
+    committee_keys = {bytes(pk) for pk in state.current_sync_committee.pubkeys}
+    assert exiting_keys & committee_keys
+    assert exiting_keys - committee_keys
+
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks, only_last_block=True)
+
+    now = post_spec.get_current_epoch(state)
+    for index in exited:
+        v = state.validators[index]
+        assert not v.slashed
+        assert post_spec.is_active_validator(v, now)
+    assert not post_spec.is_in_inactivity_leak(state)
+
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_fork_metas(_AT_FORK_2)
+def test_transition_with_one_fourth_exiting_validators_exit_at_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    """Exits land exactly on the fork epoch: active before, inactive after.
+    The altair upgrade builds its first sync committee from active
+    validators only, so none of the exited may hold a seat."""
+    exited = _exit_quarter(spec, state, exit_epoch=fork_epoch)
+
+    transition_until_fork(spec, state, fork_epoch)
+    now = spec.get_current_epoch(state)
+    for index in exited:
+        v = state.validators[index]
+        assert not v.slashed
+        assert v.exit_epoch == fork_epoch
+        assert spec.is_active_validator(v, now)
+
+    yield "pre", state
+
+    blocks = []
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(post_tag(fork_block))
+
+    now = post_spec.get_current_epoch(state)
+    for index in exited:
+        v = state.validators[index]
+        assert not v.slashed
+        assert not post_spec.is_active_validator(v, now)
+    assert not post_spec.is_in_inactivity_leak(state)
+
+    exited_keys = {bytes(state.validators[i].pubkey) for i in exited}
+    committee_keys = {bytes(pk) for pk in state.current_sync_committee.pubkeys}
+    if post_spec.fork == ALTAIR:
+        # the upgrade itself samples the committee from active validators
+        assert not (exited_keys & committee_keys)
+    else:
+        # later upgrades inherit the committee assembled pre-fork
+        assert exited_keys & committee_keys
+
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks, only_last_block=True)
+
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_fork_metas(_AT_FORK_2)
+def test_transition_with_non_empty_activation_queue(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    """Pending (not yet activated) deposits ride through the upgrade."""
+    transition_until_fork(spec, state, fork_epoch)
+    queued = set_some_new_deposits(spec, state, rng=random.Random(5566))
+    assert queued
+    now = spec.get_current_epoch(state)
+    for index in queued:
+        assert not spec.is_active_validator(state.validators[index], now)
+
+    yield "pre", state
+
+    blocks = []
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(post_tag(fork_block))
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks, only_last_block=True)
+
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_fork_metas(_AT_FORK_2)
+def test_transition_with_activation_at_fork_epoch(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    """Validators scheduled to activate exactly at the fork epoch must be
+    active right after the upgrade."""
+    transition_until_fork(spec, state, fork_epoch)
+    pending = set_some_activations(
+        spec, state, rng=random.Random(5566), activation_epoch=fork_epoch)
+    assert pending
+    now = spec.get_current_epoch(state)
+    for index in pending:
+        v = state.validators[index]
+        assert not spec.is_active_validator(v, now)
+        assert v.activation_epoch == fork_epoch
+
+    yield "pre", state
+
+    blocks = []
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(post_tag(fork_block))
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks, only_last_block=True)
+
+    now = post_spec.get_current_epoch(state)
+    for index in pending:
+        assert post_spec.is_active_validator(state.validators[index], now)
+
+    yield "blocks", blocks
+    yield "post", state

@@ -1,0 +1,48 @@
+"""Inactivity leak straddling the fork boundary (reference suite:
+test/altair/transition/test_leaking.py).  Minimal-preset leak onset is
+MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2 = epoch 6 with no attestations."""
+from consensus_specs_tpu.testing.context import ForkMeta, with_fork_metas
+from consensus_specs_tpu.testing.helpers.constants import ALL_PRE_POST_FORKS
+from consensus_specs_tpu.testing.helpers.fork_transition import (
+    do_fork,
+    transition_to_next_epoch_and_append_blocks,
+    transition_until_fork,
+)
+
+
+def _run_leak_transition(state, fork_epoch, spec, post_spec, post_tag,
+                         leaking_pre_fork):
+    transition_until_fork(spec, state, fork_epoch)
+    assert spec.is_in_inactivity_leak(state) == leaking_pre_fork
+
+    yield "pre", state
+
+    blocks = []
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(post_tag(fork_block))
+    assert spec.is_in_inactivity_leak(state)
+
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks, only_last_block=True)
+
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_fork_metas([ForkMeta(pre_fork_name=pre, post_fork_name=post, fork_epoch=7)
+                  for pre, post in ALL_PRE_POST_FORKS])
+def test_transition_with_leaking_pre_fork(state, fork_epoch, spec, post_spec,
+                                          pre_tag, post_tag):
+    """The chain is already leaking when the fork hits (onset epoch 6 <
+    fork epoch 7)."""
+    yield from _run_leak_transition(
+        state, fork_epoch, spec, post_spec, post_tag, leaking_pre_fork=True)
+
+
+@with_fork_metas([ForkMeta(pre_fork_name=pre, post_fork_name=post, fork_epoch=6)
+                  for pre, post in ALL_PRE_POST_FORKS])
+def test_transition_with_leaking_at_fork(state, fork_epoch, spec, post_spec,
+                                         pre_tag, post_tag):
+    """Leak onset coincides with the fork epoch itself."""
+    yield from _run_leak_transition(
+        state, fork_epoch, spec, post_spec, post_tag, leaking_pre_fork=False)

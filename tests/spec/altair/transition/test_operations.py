@@ -1,0 +1,97 @@
+"""Operations carried across the fork boundary — included in the last
+pre-fork block or the fork block itself (reference suite:
+test/altair/transition/test_operations.py)."""
+from consensus_specs_tpu.testing.context import (
+    ForkMeta,
+    always_bls,
+    with_fork_metas,
+    with_presets,
+)
+from consensus_specs_tpu.testing.helpers.constants import (
+    ALL_PRE_POST_FORKS,
+    MINIMAL,
+)
+from consensus_specs_tpu.testing.helpers.fork_transition import (
+    OperationType,
+    run_transition_with_operation,
+)
+
+_AT_FORK_2 = [ForkMeta(pre_fork_name=pre, post_fork_name=post, fork_epoch=2)
+              for pre, post in ALL_PRE_POST_FORKS]
+# Voluntary exits need SHARD_COMMITTEE_PERIOD (64 epochs on minimal) of
+# validator age, so those metas fork at epoch 66.
+_AT_FORK_66 = [ForkMeta(pre_fork_name=pre, post_fork_name=post, fork_epoch=66)
+               for pre, post in ALL_PRE_POST_FORKS]
+
+
+def _run(state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+         operation_type, offset):
+    yield from run_transition_with_operation(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+        operation_type=operation_type,
+        operation_at_slot=fork_epoch * spec.SLOTS_PER_EPOCH + offset)
+
+
+@with_fork_metas(_AT_FORK_2)
+@always_bls
+def test_transition_with_proposer_slashing_right_after_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    yield from _run(state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+                    OperationType.PROPOSER_SLASHING, 0)
+
+
+@with_fork_metas(_AT_FORK_2)
+@always_bls
+def test_transition_with_proposer_slashing_right_before_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    yield from _run(state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+                    OperationType.PROPOSER_SLASHING, -1)
+
+
+@with_fork_metas(_AT_FORK_2)
+@always_bls
+def test_transition_with_attester_slashing_right_after_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    yield from _run(state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+                    OperationType.ATTESTER_SLASHING, 0)
+
+
+@with_fork_metas(_AT_FORK_2)
+@always_bls
+def test_transition_with_attester_slashing_right_before_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    yield from _run(state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+                    OperationType.ATTESTER_SLASHING, -1)
+
+
+@with_fork_metas(_AT_FORK_2)
+def test_transition_with_deposit_right_after_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    yield from _run(state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+                    OperationType.DEPOSIT, 0)
+
+
+@with_fork_metas(_AT_FORK_2)
+def test_transition_with_deposit_right_before_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    yield from _run(state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+                    OperationType.DEPOSIT, -1)
+
+
+@with_fork_metas(_AT_FORK_66)
+@with_presets([MINIMAL], reason="too slow")
+def test_transition_with_voluntary_exit_right_after_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    # age validator 0 past the shard committee period first
+    state.slot = spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    yield from _run(state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+                    OperationType.VOLUNTARY_EXIT, 0)
+
+
+@with_fork_metas(_AT_FORK_66)
+@with_presets([MINIMAL], reason="too slow")
+def test_transition_with_voluntary_exit_right_before_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    state.slot = spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    yield from _run(state, fork_epoch, spec, post_spec, pre_tag, post_tag,
+                    OperationType.VOLUNTARY_EXIT, -1)

@@ -1,0 +1,63 @@
+"""Slashed-but-active validators crossing the fork boundary (reference
+suite: test/altair/transition/test_slashing.py)."""
+import random
+
+from consensus_specs_tpu.testing.context import (
+    ForkMeta,
+    with_fork_metas,
+    with_presets,
+)
+from consensus_specs_tpu.testing.helpers.constants import (
+    ALL_PRE_POST_FORKS,
+    MINIMAL,
+)
+from consensus_specs_tpu.testing.helpers.fork_transition import (
+    do_fork,
+    transition_to_next_epoch_and_append_blocks,
+    transition_until_fork,
+)
+from consensus_specs_tpu.testing.helpers.random import slash_random_validators
+
+
+@with_fork_metas([ForkMeta(pre_fork_name=pre, post_fork_name=post, fork_epoch=1)
+                  for pre, post in ALL_PRE_POST_FORKS])
+@with_presets([MINIMAL], reason="needs a registry larger than one sync committee")
+def test_transition_with_one_fourth_slashed_active_validators_pre_fork(
+        state, fork_epoch, spec, post_spec, pre_tag, post_tag):
+    """A quarter of the registry is slashed (still active) at the fork.
+    Slashed validators keep their sync-committee eligibility but cannot
+    propose, so post-fork blocks must dodge slashed proposers."""
+    slashed = slash_random_validators(
+        spec, state, rng=random.Random(5566), fraction=0.25)
+    assert slashed
+    now = spec.get_current_epoch(state)
+    for index in slashed:
+        v = state.validators[index]
+        assert v.slashed
+        assert spec.is_active_validator(v, now)
+    assert not spec.is_in_inactivity_leak(state)
+
+    transition_until_fork(spec, state, fork_epoch)
+    assert spec.get_current_epoch(state) < fork_epoch
+
+    yield "pre", state
+
+    state, _ = do_fork(state, spec, post_spec, fork_epoch, with_block=False)
+
+    slashed_keys = {bytes(state.validators[i].pubkey) for i in slashed}
+    committee_keys = {bytes(pk) for pk in state.current_sync_committee.pubkeys}
+    assert slashed_keys & committee_keys
+    assert slashed_keys - committee_keys
+
+    blocks = []
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks, only_last_block=True,
+        ignoring_proposers=set(slashed))
+
+    now = post_spec.get_current_epoch(state)
+    for v in state.validators:
+        assert post_spec.is_active_validator(v, now)
+    assert not post_spec.is_in_inactivity_leak(state)
+
+    yield "blocks", blocks
+    yield "post", state

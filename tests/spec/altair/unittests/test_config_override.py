@@ -1,0 +1,25 @@
+"""Config-override spec rebuild (reference suite:
+test/altair/unittests/test_config_override.py): a per-test config must
+produce a fresh spec module whose containers and genesis state reflect
+the overridden fork versions."""
+from consensus_specs_tpu.testing.context import (
+    spec_configured_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.constants import ALTAIR
+
+
+@with_phases([ALTAIR])
+@spec_configured_state_test({
+    "GENESIS_FORK_VERSION": "0x12345678",
+    "ALTAIR_FORK_VERSION": "0x11111111",
+    "ALTAIR_FORK_EPOCH": 4,
+})
+def test_config_override(spec, state):
+    assert spec.config.ALTAIR_FORK_EPOCH == 4
+    assert spec.config.GENESIS_FORK_VERSION != spec.Version(b"\x00" * 4)
+    assert spec.config.GENESIS_FORK_VERSION == spec.Version(bytes.fromhex("12345678"))
+    assert spec.config.ALTAIR_FORK_VERSION == spec.Version(bytes.fromhex("11111111"))
+    # the mock-genesis state is built against the overridden config
+    assert state.fork.current_version == spec.Version(bytes.fromhex("11111111"))
+    yield from ()

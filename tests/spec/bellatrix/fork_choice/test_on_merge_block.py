@@ -1,0 +1,111 @@
+"""on_block at the merge transition: terminal-block validation against a
+mocked PoW chain (reference suite:
+test/bellatrix/fork_choice/test_on_merge_block.py; spec:
+bellatrix/fork-choice.md on_block + validate_merge_block)."""
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.exceptions import BlockNotFoundException
+from consensus_specs_tpu.testing.helpers.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.testing.helpers.execution_payload import (
+    build_state_with_incomplete_transition,
+)
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    add_pow_block,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    tick_and_add_block,
+)
+from consensus_specs_tpu.testing.helpers.pow_block import prepare_random_pow_block
+from consensus_specs_tpu.testing.helpers.state import state_transition_and_sign_block
+
+
+def _merge_scenario(spec, state, parent_gap, head_excess, chain_length=2):
+    """Common driver: pre-merge anchor state, a mocked PoW chain with the
+    head at TTD + head_excess (parent at TTD - parent_gap), and one beacon
+    block claiming the PoW head as payload parent.
+
+    Returns a generator to be yield-driven by the test; the ``expect``
+    kwargs of _deliver control validity.
+    """
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    pow_blocks = []
+    pow_head = prepare_random_pow_block(spec)
+    pow_head.total_difficulty = ttd + head_excess
+    pow_blocks.append(pow_head)
+    if chain_length > 1:
+        pow_parent = prepare_random_pow_block(spec)
+        pow_parent.total_difficulty = max(0, ttd - parent_gap)
+        pow_head.parent_hash = pow_parent.block_hash
+        pow_blocks.append(pow_parent)
+    return pow_blocks
+
+
+def _run_merge_case(spec, state, pow_blocks, valid, block_not_found=False,
+                    expect_head=False):
+    test_steps = []
+    state = build_state_with_incomplete_transition(spec, state)
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(
+        spec, store,
+        int(store.genesis_time) + int(state.slot) * int(spec.config.SECONDS_PER_SLOT),
+        test_steps)
+
+    for pow_block in pow_blocks:
+        yield from add_pow_block(spec, store, pow_block, test_steps)
+
+    by_hash = {bytes(b.block_hash): b for b in pow_blocks}
+    original = spec.get_pow_block
+
+    def get_pow_block(block_hash):
+        try:
+            return by_hash[bytes(block_hash)]
+        except KeyError:
+            raise BlockNotFoundException()
+
+    spec.get_pow_block = get_pow_block
+    try:
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.execution_payload.parent_hash = pow_blocks[0].block_hash
+        signed = state_transition_and_sign_block(spec, state, block)
+        yield from tick_and_add_block(
+            spec, store, signed, test_steps, valid=valid,
+            merge_block=True, block_not_found=block_not_found)
+        if expect_head:
+            assert spec.get_head(store) == signed.message.hash_tree_root()
+    finally:
+        spec.get_pow_block = original
+    yield "steps", "data", test_steps
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_all_valid(spec, state):
+    pow_blocks = _merge_scenario(spec, state, parent_gap=1, head_excess=0)
+    yield from _run_merge_case(spec, state, pow_blocks, valid=True, expect_head=True)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_block_lookup_failed(spec, state):
+    # single sub-TTD PoW block: the parent lookup raises BlockNotFound
+    pow_blocks = _merge_scenario(spec, state, parent_gap=1, head_excess=-1,
+                                 chain_length=1)
+    yield from _run_merge_case(
+        spec, state, pow_blocks, valid=False, block_not_found=True)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_too_early_for_merge(spec, state):
+    # head one short of TTD: not terminal yet
+    pow_blocks = _merge_scenario(spec, state, parent_gap=2, head_excess=-1)
+    yield from _run_merge_case(spec, state, pow_blocks, valid=False)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_too_late_for_merge(spec, state):
+    # parent already at TTD: the head is past the terminal block
+    pow_blocks = _merge_scenario(spec, state, parent_gap=0, head_excess=1)
+    yield from _run_merge_case(spec, state, pow_blocks, valid=False)

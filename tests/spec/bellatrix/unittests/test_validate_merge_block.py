@@ -1,0 +1,151 @@
+"""validate_merge_block matrix: TTD crossing, PoW-chain lookups, and the
+TERMINAL_BLOCK_HASH override path (reference suite:
+test/bellatrix/unittests/test_validate_merge_block.py; spec:
+bellatrix/fork-choice.md validate_merge_block)."""
+from contextlib import contextmanager
+
+from consensus_specs_tpu.testing.context import (
+    spec_configured_state_test,
+    spec_state_test,
+    with_bellatrix_and_later,
+)
+from consensus_specs_tpu.testing.helpers.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.testing.helpers.pow_block import prepare_random_pow_chain
+
+_TBH_HEX = "0x" + "00" * 31 + "01"
+_TBH = bytes.fromhex(_TBH_HEX[2:])
+
+
+@contextmanager
+def _pow_chain_visible(spec, pow_chain):
+    """Temporarily route spec.get_pow_block through the mock chain."""
+    by_hash = pow_chain.to_dict()
+    original = spec.get_pow_block
+
+    def get_pow_block(block_hash):
+        return by_hash.get(bytes(block_hash))
+
+    spec.get_pow_block = get_pow_block
+    try:
+        yield
+    finally:
+        spec.get_pow_block = original
+
+
+def _check_validate_merge_block(spec, pow_chain, beacon_block, valid=True):
+    with _pow_chain_visible(spec, pow_chain):
+        try:
+            spec.validate_merge_block(beacon_block)
+            aborted = False
+        except AssertionError:
+            aborted = True
+    assert aborted != valid
+
+
+def _chain_crossing_ttd(spec, length=2, head_excess=0, parent_gap=1):
+    """A chain whose head sits at TTD + head_excess, parent at
+    TTD - parent_gap (clamped at zero)."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    chain = prepare_random_pow_chain(spec, length)
+    if length > 1:
+        chain.head(-1).total_difficulty = max(0, ttd - parent_gap)
+    chain.head().total_difficulty = ttd + head_excess
+    return chain
+
+
+def _block_on_pow_head(spec, state, pow_chain):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.execution_payload.parent_hash = pow_chain.head().block_hash
+    return block
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_validate_merge_block_success(spec, state):
+    pow_chain = _chain_crossing_ttd(spec)
+    _check_validate_merge_block(
+        spec, pow_chain, _block_on_pow_head(spec, state, pow_chain))
+    yield from ()
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_validate_merge_block_fail_block_lookup(spec, state):
+    pow_chain = _chain_crossing_ttd(spec)
+    # payload parent hash left at default: not in the PoW chain
+    block = build_empty_block_for_next_slot(spec, state)
+    _check_validate_merge_block(spec, pow_chain, block, valid=False)
+    yield from ()
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_validate_merge_block_fail_parent_block_lookup(spec, state):
+    # single-block chain: the terminal block's parent is unknown
+    pow_chain = _chain_crossing_ttd(spec, length=1)
+    _check_validate_merge_block(
+        spec, pow_chain, _block_on_pow_head(spec, state, pow_chain), valid=False)
+    yield from ()
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_validate_merge_block_fail_after_terminal(spec, state):
+    # both head and parent are at/past TTD: the head is not terminal
+    pow_chain = _chain_crossing_ttd(spec, head_excess=1, parent_gap=0)
+    _check_validate_merge_block(
+        spec, pow_chain, _block_on_pow_head(spec, state, pow_chain), valid=False)
+    yield from ()
+
+
+@with_bellatrix_and_later
+@spec_configured_state_test({
+    "TERMINAL_BLOCK_HASH": _TBH_HEX,
+    "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": "0",
+})
+def test_validate_merge_block_tbh_override_success(spec, state):
+    # TTD deliberately NOT reached: only the hash override validates this
+    pow_chain = _chain_crossing_ttd(spec, head_excess=-1, parent_gap=2)
+    pow_chain.head().block_hash = _TBH
+    _check_validate_merge_block(
+        spec, pow_chain, _block_on_pow_head(spec, state, pow_chain))
+    yield from ()
+
+
+@with_bellatrix_and_later
+@spec_configured_state_test({
+    "TERMINAL_BLOCK_HASH": _TBH_HEX,
+    "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": "0",
+})
+def test_validate_merge_block_fail_parent_hash_is_not_tbh(spec, state):
+    # TTD reached, but with a hash override configured only the TBH counts
+    pow_chain = _chain_crossing_ttd(spec)
+    _check_validate_merge_block(
+        spec, pow_chain, _block_on_pow_head(spec, state, pow_chain), valid=False)
+    yield from ()
+
+
+@with_bellatrix_and_later
+@spec_configured_state_test({
+    "TERMINAL_BLOCK_HASH": _TBH_HEX,
+    "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": "1",
+})
+def test_validate_merge_block_terminal_block_hash_fail_activation_not_reached(spec, state):
+    # correct TBH, but the activation epoch is still in the future
+    pow_chain = _chain_crossing_ttd(spec)
+    pow_chain.head().block_hash = _TBH
+    _check_validate_merge_block(
+        spec, pow_chain, _block_on_pow_head(spec, state, pow_chain), valid=False)
+    yield from ()
+
+
+@with_bellatrix_and_later
+@spec_configured_state_test({
+    "TERMINAL_BLOCK_HASH": _TBH_HEX,
+    "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": "1",
+})
+def test_validate_merge_block_fail_activation_not_reached_parent_hash_is_not_tbh(spec, state):
+    pow_chain = _chain_crossing_ttd(spec)
+    _check_validate_merge_block(
+        spec, pow_chain, _block_on_pow_head(spec, state, pow_chain), valid=False)
+    yield from ()

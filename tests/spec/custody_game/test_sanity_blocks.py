@@ -1,0 +1,130 @@
+"""Custody-game sanity: full signed blocks carrying custody operations
+through ``state_transition`` (reference suite:
+test/custody_game/sanity/test_blocks.py, adapted to this snapshot's
+ShardBlob-era sharding layout)."""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.ssz.merkle_minimal import (
+    calc_merkle_tree_from_leaves,
+    get_merkle_proof,
+)
+from consensus_specs_tpu.testing.helpers.attestations import get_valid_attestation
+from consensus_specs_tpu.testing.helpers.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.testing.helpers.keys import privkeys
+from consensus_specs_tpu.testing.helpers.state import (
+    next_slots,
+    state_transition_and_sign_block,
+    transition_to,
+)
+
+
+@pytest.fixture(autouse=True)
+def _bls_on():
+    old = bls.bls_active
+    bls.bls_active = True
+    yield
+    bls.bls_active = old
+
+
+def _signed_key_reveal(spec, state, index):
+    revealer = state.validators[index]
+    epoch_to_sign = spec.get_randao_epoch_for_custody_period(
+        revealer.next_custody_secret_to_reveal, spec.ValidatorIndex(index))
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch_to_sign)
+    return spec.CustodyKeyReveal(
+        revealer_index=index,
+        reveal=bls.Sign(privkeys[index],
+                        spec.compute_signing_root(epoch_to_sign, domain)),
+    )
+
+
+def test_block_with_custody_key_reveal(spec, state):
+    transition_to(
+        spec, state,
+        int(spec.EPOCHS_PER_CUSTODY_PERIOD) * int(spec.SLOTS_PER_EPOCH) + 1)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.custody_key_reveals.append(_signed_key_reveal(spec, state, 0))
+
+    pre_next = int(state.validators[0].next_custody_secret_to_reveal)
+    state_transition_and_sign_block(spec, state, block)
+    assert int(state.validators[0].next_custody_secret_to_reveal) == pre_next + 1
+
+
+def test_block_with_premature_key_reveal_rejected(spec, state):
+    # No custody period has elapsed: the reveal (and thus the block) fails.
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.custody_key_reveals.append(_signed_key_reveal(spec, state, 0))
+    state_transition_and_sign_block(spec, state, block, expect_fail=True)
+
+
+def test_block_with_early_derived_secret_reveal(spec, state):
+    epoch = int(spec.get_current_epoch(state)) + int(spec.RANDAO_PENALTY_EPOCHS)
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    mask = b"\x11" * 32
+    reveal = spec.EarlyDerivedSecretReveal(
+        revealed_index=1,
+        epoch=epoch,
+        reveal=bls.Aggregate([
+            bls.Sign(privkeys[1], spec.compute_signing_root(spec.Epoch(epoch), domain)),
+            bls.Sign(privkeys[2], spec.compute_signing_root(spec.Bytes32(mask), domain)),
+        ]),
+        masker_index=2,
+        mask=mask,
+    )
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.early_derived_secret_reveals.append(reveal)
+
+    pre_balance = int(state.balances[1])
+    state_transition_and_sign_block(spec, state, block)
+    assert int(state.balances[1]) < pre_balance
+    assert not state.validators[1].slashed
+
+
+def test_block_with_chunk_challenge_and_response(spec, state):
+    """Two blocks: one carrying a chunk challenge against an included-era
+    attestation, the next carrying the winning response."""
+    bls.bls_active = False  # structure under test; attestation is unsigned
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY) + 1)
+
+    # chunked shard data the response must open into
+    depth = int(spec.CUSTODY_RESPONSE_DEPTH)
+    chunk = spec.ByteVector[spec.BYTES_PER_CUSTODY_CHUNK](
+        b"\x07" * int(spec.BYTES_PER_CUSTODY_CHUNK))
+    leaves = [bytes(chunk.hash_tree_root())] * 2
+    tree = calc_merkle_tree_from_leaves(leaves, depth)
+    length_leaf = (2).to_bytes(32, "little")
+    data_root = spec.hash(tree[-1][0] + length_leaf)
+
+    shard_transition = spec.ShardTransition(
+        start_slot=1,
+        shard_block_lengths=[int(spec.BYTES_PER_CUSTODY_CHUNK) * 2],
+        shard_data_roots=[data_root],
+    )
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.shard_transition_root = spec.hash_tree_root(shard_transition)
+    responder = int(min(spec.get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits)))
+
+    challenge_block = build_empty_block_for_next_slot(spec, state)
+    challenge_block.body.chunk_challenges.append(spec.CustodyChunkChallenge(
+        responder_index=responder,
+        shard_transition=shard_transition,
+        attestation=attestation,
+        data_index=0,
+        chunk_index=1,
+    ))
+    state_transition_and_sign_block(spec, state, challenge_block)
+    record = state.custody_chunk_challenge_records[0]
+    assert int(record.responder_index) == responder
+
+    response_block = build_empty_block_for_next_slot(spec, state)
+    response_block.body.chunk_challenge_responses.append(spec.CustodyChunkResponse(
+        challenge_index=record.challenge_index,
+        chunk_index=1,
+        chunk=chunk,
+        branch=get_merkle_proof(tree, 1, depth) + [length_leaf],
+    ))
+    state_transition_and_sign_block(spec, state, response_block)
+    cleared = state.custody_chunk_challenge_records[0]
+    assert bytes(cleared.data_root) == b"\x00" * 32
