@@ -137,3 +137,268 @@ def test_too_many_aggregation_bits(spec, state):
         list(attestation.aggregation_bits) + [False]
     )
     yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_multi_proposer_index_iterations(spec, state):
+    # several slots in: proposer lookup iterates past empty preceding slots
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 2)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_empty_participants_zeroes_sig(spec, state):
+    attestation = get_valid_attestation(
+        spec, state, filter_participant_set=lambda comm: set())
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_empty_participants_seemingly_valid_sig(spec, state):
+    from consensus_specs_tpu.crypto import bls as bls_mod
+    from consensus_specs_tpu.testing.helpers.keys import privkeys
+
+    attestation = get_valid_attestation(
+        spec, state, filter_participant_set=lambda comm: set())
+    # a real signature over the data, but from nobody in the (empty) set
+    attestation.signature = bls_mod.Sign(
+        privkeys[0], spec.compute_signing_root(
+            attestation.data,
+            spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                            attestation.data.target.epoch)))
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+def _justification_backdrop(spec, state):
+    """Fast-forward to epoch 5 with distinct justified checkpoint roots."""
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 5)
+    state.finalized_checkpoint.epoch = 2
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=3, root=b"\x01" * 32)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=4, root=b"\x32" * 32)
+
+
+@with_all_phases
+@spec_state_test
+def test_old_source_epoch(spec, state):
+    _justification_backdrop(spec, state)
+    attestation = get_valid_attestation(
+        spec, state, slot=spec.SLOTS_PER_EPOCH * 3 + 1)
+    assert attestation.data.source.epoch == state.previous_justified_checkpoint.epoch
+    # point the source below the oldest admissible epoch
+    attestation.data.source.epoch = state.finalized_checkpoint.epoch
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_source_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.source.epoch += 1
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_source_root_is_target_root(spec, state):
+    # target-root correctness is a rewards concern, not a validity rule
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.target.root = attestation.data.source.root
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_current_source_root(spec, state):
+    _justification_backdrop(spec, state)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    # current-epoch attestation must cite the CURRENT justified root
+    attestation = get_valid_attestation(
+        spec, state, slot=spec.SLOTS_PER_EPOCH * 5)
+    assert attestation.data.target.epoch == spec.get_current_epoch(state)
+    assert attestation.data.source.root == state.current_justified_checkpoint.root
+    attestation.data.source.root = state.previous_justified_checkpoint.root
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_previous_source_root(spec, state):
+    _justification_backdrop(spec, state)
+    # previous-epoch attestation must cite the PREVIOUS justified root
+    attestation = get_valid_attestation(
+        spec, state, slot=spec.SLOTS_PER_EPOCH * 4 + 1)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    assert attestation.data.target.epoch == spec.get_previous_epoch(state)
+    assert attestation.data.source.root == state.previous_justified_checkpoint.root
+    attestation.data.source.root = state.current_justified_checkpoint.root
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_wrong_index_for_committee_signature(spec, state):
+    # signature belongs to committee `index`; shifting the index breaks it
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.data.index += 1
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_old_target_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # age the state beyond the attestation's whole target-epoch window
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 2)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+# -- inclusion-delay x head/target correctness matrix ------------------------
+#
+# Validity only depends on the delay (<= SLOTS_PER_EPOCH); wrong head or
+# target roots stay *valid* and exercise the reduced-credit paths (altair
+# participation-flag branches in particular).
+
+def _run_delay_matrix_case(spec, state, delay, wrong_head=False, wrong_target=False):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    if wrong_head:
+        attestation.data.beacon_block_root = b"\x42" * 32
+    if wrong_target:
+        attestation.data.target.root = b"\x33" * 32
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, delay)
+    yield from run_attestation_processing(
+        spec, state, attestation, valid=delay <= spec.SLOTS_PER_EPOCH)
+
+
+def _sqrt_epoch(spec):
+    return int(spec.integer_squareroot(spec.uint64(int(spec.SLOTS_PER_EPOCH))))
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_min_inclusion_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_sqrt_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(spec, state, _sqrt_epoch(spec))
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(spec, state, int(spec.SLOTS_PER_EPOCH))
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_after_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(spec, state, int(spec.SLOTS_PER_EPOCH) + 1)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_min_inclusion_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY), wrong_head=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_sqrt_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(spec, state, _sqrt_epoch(spec), wrong_head=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.SLOTS_PER_EPOCH), wrong_head=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_after_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.SLOTS_PER_EPOCH) + 1, wrong_head=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_min_inclusion_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY),
+        wrong_head=True, wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_sqrt_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, _sqrt_epoch(spec), wrong_head=True, wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.SLOTS_PER_EPOCH), wrong_head=True, wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_after_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.SLOTS_PER_EPOCH) + 1,
+        wrong_head=True, wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_min_inclusion_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY), wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_sqrt_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, _sqrt_epoch(spec), wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.SLOTS_PER_EPOCH), wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_after_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, int(spec.SLOTS_PER_EPOCH) + 1, wrong_target=True)

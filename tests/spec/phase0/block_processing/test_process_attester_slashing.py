@@ -17,6 +17,10 @@ from consensus_specs_tpu.testing.helpers.state import get_balance, next_epoch
 
 
 def run_attester_slashing_processing(spec, state, attester_slashing, valid=True):
+    from consensus_specs_tpu.testing.helpers.proposer_slashings import (
+        get_min_slashing_penalty_quotient,
+    )
+
     yield "pre", state
     yield "attester_slashing", attester_slashing
     if not valid:
@@ -32,19 +36,39 @@ def run_attester_slashing_processing(spec, state, attester_slashing, valid=True)
         & set(get_indexed_attestation_participants(spec, attester_slashing.attestation_2))
     )
     proposer_index = spec.get_beacon_proposer_index(state)
-    pre_proposer_balance = get_balance(state, proposer_index)
-    pre_balances = {i: get_balance(state, i) for i in slashed_indices}
+    pre_proposer_balance = int(get_balance(state, proposer_index))
+    pre_balances = {i: int(get_balance(state, i)) for i in slashed_indices}
+    pre_effectives = {
+        i: int(state.validators[i].effective_balance) for i in slashed_indices}
+    pre_withdrawables = {
+        i: int(state.validators[i].withdrawable_epoch) for i in slashed_indices}
+    whistleblower_total = sum(
+        eff // int(spec.WHISTLEBLOWER_REWARD_QUOTIENT)
+        for eff in pre_effectives.values())
 
     spec.process_attester_slashing(state, attester_slashing)
     yield "post", state
 
     for i in slashed_indices:
-        assert state.validators[i].slashed
+        slashed_validator = state.validators[i]
+        assert slashed_validator.slashed
+        assert slashed_validator.exit_epoch < spec.FAR_FUTURE_EPOCH
+        if pre_withdrawables[i] < int(spec.FAR_FUTURE_EPOCH):
+            # already-exiting validators only ever extend their window
+            assert int(slashed_validator.withdrawable_epoch) == max(
+                pre_withdrawables[i],
+                int(spec.get_current_epoch(state)) + int(spec.EPOCHS_PER_SLASHINGS_VECTOR))
+        else:
+            assert slashed_validator.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
         if i != proposer_index:
-            assert get_balance(state, i) < pre_balances[i]
-    assert get_balance(state, proposer_index) > pre_proposer_balance - (
-        pre_balances.get(proposer_index, 0) // spec.MIN_SLASHING_PENALTY_QUOTIENT
-    )
+            # the proposer's whistleblower income can outweigh their penalty
+            assert int(get_balance(state, i)) < pre_balances[i]
+
+    expected_proposer = pre_proposer_balance + whistleblower_total
+    if proposer_index in slashed_indices:
+        expected_proposer -= (
+            pre_effectives[proposer_index] // int(get_min_slashing_penalty_quotient(spec)))
+    assert int(get_balance(state, proposer_index)) == expected_proposer
 
 
 @with_all_phases
@@ -177,3 +201,202 @@ def test_partially_overlapping_participants(spec, state):
         signed_1=True, signed_2=True,
     )
     yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_already_exited_recent(spec, state):
+    from consensus_specs_tpu.testing.helpers.attestations import get_valid_attestation
+
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    victims = get_indexed_attestation_participants(spec, slashing.attestation_1)
+    # initiated exit, still within the slashable window
+    spec.initiate_validator_exit(state, victims[0])
+    yield from run_attester_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_already_exited_long_ago(spec, state):
+    # every participant is deep in the exit queue (withdrawable soon, but
+    # still inside the slashable window): the withdrawable-epoch max() path
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    victims = get_indexed_attestation_participants(spec, slashing.attestation_1)
+    for index in victims:
+        spec.initiate_validator_exit(state, index)
+        state.validators[index].withdrawable_epoch = (
+            spec.get_current_epoch(state) + 2)
+    yield from run_attester_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_index_slashed(spec, state):
+    from consensus_specs_tpu.testing.helpers.state import next_epoch_via_block
+
+    # past genesis slot so a real proposer exists, then self-slash them
+    next_epoch_via_block(spec, state)
+    proposer = spec.get_beacon_proposer_index(state)
+    slashing = get_valid_attester_slashing_by_indices(
+        spec, state, [proposer], signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_from_future(spec, state):
+    from consensus_specs_tpu.testing.helpers.state import next_epoch_via_block
+
+    # evidence dated past the state's slot is still slashable evidence
+    future_state = state.copy()
+    next_epoch_via_block(spec, future_state)
+    slashing = get_valid_attester_slashing(
+        spec, future_state, slot=state.slot + 5, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_with_effective_balance_disparity(spec, state):
+    # nudge balances so effective balances and balances disagree
+    for i in range(len(state.validators)):
+        state.balances[i] = int(state.balances[i]) - i * 1000
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(spec, state, slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1_and_2(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=False)
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+def _tamper_indices(spec, state, which, mutate):
+    """Build a signed slashing, corrupt one side's indices WITHOUT re-signing."""
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    side = slashing.attestation_1 if which == 1 else slashing.attestation_2
+    indices = list(side.attesting_indices)
+    mutate(indices)
+    side.attesting_indices = indices
+    return slashing
+
+
+@with_all_phases
+@spec_state_test
+def test_att1_high_index(spec, state):
+    slashing = _tamper_indices(
+        spec, state, 1, lambda ix: ix.append(len(state.validators)))
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_att2_high_index(spec, state):
+    slashing = _tamper_indices(
+        spec, state, 2, lambda ix: ix.append(len(state.validators)))
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_att1_empty_indices(spec, state):
+    slashing = _tamper_indices(spec, state, 1, lambda ix: ix.clear())
+    slashing.attestation_1.signature = spec.bls.G2_POINT_AT_INFINITY \
+        if hasattr(spec, "bls") else slashing.attestation_1.signature
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_all_empty_indices(spec, state):
+    slashing = _tamper_indices(spec, state, 1, lambda ix: ix.clear())
+    slashing.attestation_2.attesting_indices = []
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att1_bad_extra_index(spec, state):
+    # extra index not covered by the aggregate signature
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    indices = list(slashing.attestation_1.attesting_indices)
+    options = [i for i in range(len(state.validators)) if i not in indices]
+    slashing.attestation_1.attesting_indices = sorted(indices + options[:1])
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att1_bad_replaced_index(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    indices = list(slashing.attestation_1.attesting_indices)
+    options = [i for i in range(len(state.validators)) if i not in indices]
+    indices[0] = options[0]
+    slashing.attestation_1.attesting_indices = sorted(indices)
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att2_bad_extra_index(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    indices = list(slashing.attestation_2.attesting_indices)
+    options = [i for i in range(len(state.validators)) if i not in indices]
+    slashing.attestation_2.attesting_indices = sorted(indices + options[:1])
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att2_bad_replaced_index(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    indices = list(slashing.attestation_2.attesting_indices)
+    options = [i for i in range(len(state.validators)) if i not in indices]
+    indices[0] = options[0]
+    slashing.attestation_2.attesting_indices = sorted(indices)
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att1_duplicate_index_normal_signed(spec, state):
+    # drop one participant, duplicate another, re-sign: indices not sorted-unique
+    slashing = get_valid_attester_slashing(spec, state, signed_1=False, signed_2=True)
+    indices = list(slashing.attestation_1.attesting_indices)
+    indices.pop(1)
+    indices.append(indices[0])  # duplicate, list still "sorted"
+    slashing.attestation_1.attesting_indices = indices
+    sign_indexed_attestation(spec, state, slashing.attestation_1)
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_att2_duplicate_index_double_signed(spec, state):
+    # the duplicated participant double-signs: still invalid (not unique)
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=False)
+    indices = list(slashing.attestation_2.attesting_indices)
+    indices.insert(1, indices[0])
+    slashing.attestation_2.attesting_indices = indices
+    sign_indexed_attestation(spec, state, slashing.attestation_2)
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_unsorted_att_2(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=False)
+    indices = list(slashing.attestation_2.attesting_indices)
+    assert len(indices) >= 3
+    indices[1], indices[2] = indices[2], indices[1]
+    slashing.attestation_2.attesting_indices = indices
+    sign_indexed_attestation(spec, state, slashing.attestation_2)
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)

@@ -7,6 +7,12 @@ from consensus_specs_tpu.testing.context import (
     with_all_phases,
 )
 from consensus_specs_tpu.testing.helpers.attestations import get_valid_attestation
+from consensus_specs_tpu.testing.helpers.attester_slashings import (
+    get_valid_attester_slashing_by_indices,
+)
+from consensus_specs_tpu.testing.helpers.proposer_slashings import (
+    get_valid_proposer_slashing,
+)
 from consensus_specs_tpu.testing.helpers.block import (
     build_empty_block,
     build_empty_block_for_next_slot,
@@ -323,3 +329,471 @@ def test_eth1_data_votes_consensus(spec, state):
     assert state.slot % voting_period_slots == 0
     assert len(state.eth1_data_votes) == 1
     assert state.eth1_data_votes[0].block_hash == c
+
+
+@with_all_phases
+@spec_state_test
+def test_proposal_for_genesis_slot(spec, state):
+    assert state.slot == spec.GENESIS_SLOT
+    yield "pre", state
+    block = build_empty_block(spec, state, spec.GENESIS_SLOT)
+    block.parent_root = state.latest_block_header.hash_tree_root()
+
+    # a block for the genesis slot can never transition (slot must advance)
+    expect_assertion_error(
+        lambda: spec.state_transition(
+            state, spec.SignedBeaconBlock(message=block), validate_result=False))
+    yield "blocks", [spec.SignedBeaconBlock(message=block)]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_parent_from_same_slot(spec, state):
+    yield "pre", state
+
+    parent_block = build_empty_block_for_next_slot(spec, state)
+    signed_parent = state_transition_and_sign_block(spec, state, parent_block)
+
+    # sibling claiming a parent in its own slot
+    child_block = parent_block.copy()
+    child_block.parent_root = state.latest_block_header.hash_tree_root()
+
+    failed_state = state.copy()
+    expect_assertion_error(
+        lambda: spec.state_transition(
+            failed_state, spec.SignedBeaconBlock(message=child_block),
+            validate_result=False))
+    yield "blocks", [signed_parent, spec.SignedBeaconBlock(message=child_block)]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_proposer_index_sig_from_proposer_index(spec, state):
+    invalid_block = build_empty_block_for_next_slot(spec, state)
+    # steal the slot from the expected proposer, sign with the thief's key
+    expected_proposer = invalid_block.proposer_index
+    active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    thief = next(i for i in active if i != expected_proposer)
+    invalid_block.proposer_index = thief
+
+    yield "pre", state
+    invalid_signed = sign_block(spec, state, invalid_block, proposer_index=thief)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, invalid_signed))
+    yield "blocks", [invalid_signed]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch_transition_not_finalizing(spec, state):
+    if spec.preset_name == "mainnet":
+        return  # minimal-only: four empty epochs are cheap there
+    yield "pre", state
+    block = build_empty_block(
+        spec, state, state.slot + spec.SLOTS_PER_EPOCH * 5)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.slot == block.slot
+    assert state.finalized_checkpoint.epoch < spec.get_current_epoch(state) - 4
+    for index in range(len(state.validators)):
+        assert state.balances[index] < spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_self_slashing(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    assert not state.validators[block.proposer_index].slashed
+    slashing = get_valid_proposer_slashing(
+        spec, state, slashed_index=block.proposer_index,
+        signed_1=True, signed_2=True)
+    block.body.proposer_slashings.append(slashing)
+
+    yield "pre", state
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[block.proposer_index].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    victim = slashing.signed_header_1.message.proposer_index
+    assert not state.validators[victim].slashed
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(slashing)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[victim].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_double_same_proposer_slashings_same_block(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [slashing, slashing]
+    signed_block = state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_double_similar_proposer_slashings_same_block(spec, state):
+    # same proposer, two distinct evidence pairs: second must fail (already slashed)
+    victim = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    slashing_1 = get_valid_proposer_slashing(
+        spec, state, slashed_index=victim, random_root=b"\x66" * 32,
+        signed_1=True, signed_2=True)
+    slashing_2 = get_valid_proposer_slashing(
+        spec, state, slashed_index=victim, random_root=b"\x77" * 32,
+        signed_1=True, signed_2=True)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [slashing_1, slashing_2]
+    signed_block = state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_different_proposer_slashings_same_block(spec, state):
+    active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    proposer = spec.get_beacon_proposer_index(state)
+    victims = [i for i in active if i != proposer][:3]
+    slashings = [
+        get_valid_proposer_slashing(
+            spec, state, slashed_index=victim, signed_1=True, signed_2=True)
+        for victim in victims
+    ]
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = slashings
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    for victim in victims:
+        assert state.validators[victim].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing(spec, state):
+    slashing = get_valid_attester_slashing_by_indices(
+        spec, state, sorted(spec.get_active_validator_indices(
+            state, spec.get_current_epoch(state))[:2]),
+        signed_1=True, signed_2=True)
+    victims = slashing.attestation_1.attesting_indices
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings.append(slashing)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    for victim in victims:
+        assert state.validators[victim].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_duplicate_attester_slashing(spec, state):
+    slashing = get_valid_attester_slashing_by_indices(
+        spec, state, [0, 1], signed_1=True, signed_2=True)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings = [slashing, slashing]
+    signed_block = state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_attester_slashings_no_overlap(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings = [
+        get_valid_attester_slashing_by_indices(
+            spec, state, [0, 1], signed_1=True, signed_2=True),
+        get_valid_attester_slashing_by_indices(
+            spec, state, [2, 3], signed_1=True, signed_2=True),
+    ]
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    for victim in range(4):
+        assert state.validators[victim].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_attester_slashings_partial_overlap(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings = [
+        get_valid_attester_slashing_by_indices(
+            spec, state, [0, 1, 2], signed_1=True, signed_2=True),
+        get_valid_attester_slashing_by_indices(
+            spec, state, [1, 2, 3], signed_1=True, signed_2=True),
+    ]
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    for victim in range(4):
+        assert state.validators[victim].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_after_inactive_index(spec, state):
+    # exit a low index and skip ahead until it would have proposed
+    inactive_index = 10
+    spec.initiate_validator_exit(state, inactive_index)
+    exit_epoch = state.validators[inactive_index].exit_epoch
+    from consensus_specs_tpu.testing.helpers.state import transition_to
+    transition_to(spec, state, spec.compute_start_slot_at_epoch(exit_epoch))
+
+    yield "pre", state
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        proposer = spec.get_beacon_proposer_index(state)
+        assert proposer != inactive_index
+        next_slot(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_expected_deposit_in_block(spec, state):
+    # state advertises one pending deposit the block fails to deliver
+    state.eth1_data.deposit_count = state.eth1_deposit_index + 1
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_in_block(spec, state):
+    from consensus_specs_tpu.testing.helpers.deposits import prepare_state_and_deposit
+
+    new_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, new_index, amount, signed=True)
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert len(state.validators) == new_index + 1
+    assert state.balances[new_index] == amount
+    from consensus_specs_tpu.testing.helpers.keys import pubkeys
+    assert state.validators[new_index].pubkey == pubkeys[new_index]
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_top_up(spec, state):
+    from consensus_specs_tpu.testing.helpers.deposits import prepare_state_and_deposit
+
+    index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, index, amount)
+    pre_balance = int(state.balances[index])
+    pre_count = len(state.validators)
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert len(state.validators) == pre_count
+    expected = pre_balance + int(amount)
+    from consensus_specs_tpu.testing.context import is_post_altair
+    if is_post_altair(spec):
+        # an empty sync aggregate penalizes every absent committee seat
+        from consensus_specs_tpu.testing.helpers.sync_committee import (
+            compute_committee_indices,
+            compute_sync_committee_participant_reward_and_penalty,
+        )
+        reward, penalty = compute_sync_committee_participant_reward_and_penalty(
+            spec, state, index,
+            compute_committee_indices(spec, state, state.current_sync_committee),
+            block.body.sync_aggregate.sync_committee_bits)
+        expected += int(reward) - int(penalty)
+    assert int(state.balances[index]) == expected
+
+
+def _age_for_exits(spec, state):
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit(spec, state):
+    from consensus_specs_tpu.testing.helpers.voluntary_exits import prepare_signed_exits
+
+    _age_for_exits(spec, state)
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    signed_exit = prepare_signed_exits(spec, state, [index])[0]
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits.append(signed_exit)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_double_validator_exit_same_block(spec, state):
+    from consensus_specs_tpu.testing.helpers.voluntary_exits import prepare_signed_exits
+
+    _age_for_exits(spec, state)
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    signed_exit = prepare_signed_exits(spec, state, [index])[0]
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits = [signed_exit, signed_exit]
+    signed_block = state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_different_validator_exits_same_block(spec, state):
+    from consensus_specs_tpu.testing.helpers.voluntary_exits import prepare_signed_exits
+
+    _age_for_exits(spec, state)
+    indices = spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state))[-3:]
+    exits = prepare_signed_exits(spec, state, indices)
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits = exits
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    for index in indices:
+        assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+def _run_slash_and_exit(spec, state, slash_index, exit_index, valid):
+    from consensus_specs_tpu.testing.helpers.voluntary_exits import prepare_signed_exits
+
+    _age_for_exits(spec, state)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings.append(get_valid_attester_slashing_by_indices(
+        spec, state, [slash_index], signed_1=True, signed_2=True))
+    block.body.voluntary_exits.append(
+        prepare_signed_exits(spec, state, [exit_index])[0])
+    signed_block = state_transition_and_sign_block(
+        spec, state, block, expect_fail=not valid)
+    yield "blocks", [signed_block]
+    yield "post", state if valid else None
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_same_index(spec, state):
+    # slashing sets an exit epoch, so the voluntary exit's
+    # exit_epoch==FAR_FUTURE precondition fails
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    yield from _run_slash_and_exit(spec, state, index, index, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_diff_index(spec, state):
+    active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    yield from _run_slash_and_exit(spec, state, active[-1], active[-2], valid=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_batch(spec, state):
+    # park one slot short of a historical-root boundary
+    state.slot += spec.SLOTS_PER_HISTORICAL_ROOT - (
+        state.slot % spec.SLOTS_PER_HISTORICAL_ROOT) - 1
+    pre_historical_len = len(state.historical_roots)
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.slot == block.slot
+    assert len(state.historical_roots) == pre_historical_len + 1
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_votes_no_consensus(spec, state):
+    voting_period_slots = spec.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.SLOTS_PER_EPOCH
+
+    offset_block = build_empty_block(spec, state, slot=voting_period_slots - 1)
+    state_transition_and_sign_block(spec, state, offset_block)
+    yield "pre", state
+
+    pre_eth1_hash = state.eth1_data.block_hash
+    a, b = b"\xaa" * 32, b"\xbb" * 32
+    blocks = []
+    for i in range(voting_period_slots):
+        block = build_empty_block_for_next_slot(spec, state)
+        # a 50/50 split never reaches the strict-majority threshold
+        block.body.eth1_data.block_hash = b if i * 2 >= voting_period_slots else a
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+
+    assert len(state.eth1_data_votes) == voting_period_slots
+    assert state.eth1_data.block_hash == pre_eth1_hash
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_operations_1(spec, state):
+    import random as _random
+
+    from consensus_specs_tpu.testing.helpers.multi_operations import (
+        run_test_full_random_operations,
+    )
+    yield from run_test_full_random_operations(spec, state, _random.Random(2080))
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_operations_2(spec, state):
+    import random as _random
+
+    from consensus_specs_tpu.testing.helpers.multi_operations import (
+        run_test_full_random_operations,
+    )
+    yield from run_test_full_random_operations(spec, state, _random.Random(2090))
