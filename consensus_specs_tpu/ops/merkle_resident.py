@@ -1,0 +1,174 @@
+"""Device-resident merkleization of hot SSZ subtrees.
+
+The round-2 measurement showed the device hasher losing to hashlib 8.5x —
+not on compute, but because every dirty-subtree pass shipped chunk data
+through the ~6 MB/s tunnel.  The TPU-native fix is residency: the packed
+leaf data of a hot subtree (balances is the canonical case — every epoch
+rewrites all of it) lives on the device across calls.  Mutations are
+expressed as device ops on the resident buffers, the whole subtree
+reduction runs as ONE jit dispatch, and only the 32-byte root crosses the
+link.  The host keeps the rest of the state tree and folds the subtree
+root into the state root with a handful of hashlib hashes.
+
+Reference seams: eth2spec/utils/ssz/ssz_impl.py:12-13 (hash_tree_root =
+backing.merkle_root()); merkleization rules ssz/simple-serialize.md:210-248
+(pack / merkleize / mix_in_length).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from consensus_specs_tpu.ssz.node import (
+    BranchNode,
+    LeafNode,
+    Node,
+    ZERO_HASHES,
+    merkle_root,
+    uint_to_leaf,
+)
+
+from .sha256_jax import sha256_block64
+
+
+def _byteswap32(x: jnp.ndarray) -> jnp.ndarray:
+    """uint32 little-endian value -> big-endian word (SHA-256 reads bytes)."""
+    return ((x >> 24) | ((x >> 8) & 0x0000FF00)
+            | ((x << 8) & 0x00FF0000) | (x << 24))
+
+
+def _reduce_to_root(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """Full merkle reduction of a packed uint64 leaf array, on device.
+
+    ``lo``/``hi`` are the 32-bit halves of the (LE) uint64 values, length a
+    multiple of 4 and a power of two in chunks.  Returns the [8] uint32
+    (big-endian word) root of the 2^k-chunk subtree.
+    """
+    # chunk words: per value the LE bytes are lo,hi; as BE words that is
+    # byteswap(lo), byteswap(hi); 4 values -> 8 words -> one 32-byte chunk
+    words = jnp.stack([_byteswap32(lo), _byteswap32(hi)], axis=1).reshape(-1, 8)
+    level = words
+    while level.shape[0] > 1:
+        level = sha256_block64(level.reshape(level.shape[0] // 2, 16))
+    return level[0]
+
+
+_jit_reduce = jax.jit(_reduce_to_root)
+
+
+def _add_u64(lo, hi, dlo, dhi):
+    """(lo,hi) += (dlo,dhi) with carry, element-wise on uint32 halves."""
+    new_lo = lo + dlo
+    carry = (new_lo < lo).astype(jnp.uint32)
+    return new_lo, hi + dhi + carry
+
+
+_jit_add = jax.jit(_add_u64)
+
+
+class ResidentPackedU64List:
+    """A packed ``List[uint64, limit]`` whose leaves live on the device.
+
+    upload() once; mutate via apply_add()/set_values() (device ops); root()
+    runs the reduction on device and downloads 32 bytes.  ``root()`` output
+    is bit-identical to ``hash_tree_root`` of the equivalent SSZ list.
+    """
+
+    def __init__(self, limit: int, device=None):
+        assert limit % 4 == 0
+        self.limit = limit
+        self.chunk_limit = limit // 4
+        self.contents_depth = max((self.chunk_limit - 1).bit_length(), 0)
+        self.device = device if device is not None else jax.devices()[0]
+        self.length = 0
+        self._lo: Optional[jnp.ndarray] = None
+        self._hi: Optional[jnp.ndarray] = None
+
+    # -- data movement -------------------------------------------------------
+
+    def upload(self, values: np.ndarray) -> None:
+        """One-time (or rare) bulk upload of the full value array."""
+        values = np.ascontiguousarray(values, dtype="<u8")
+        self.length = len(values)
+        n_chunks = max((self.length + 3) // 4, 1)
+        n_pad = 1 << (n_chunks - 1).bit_length() if n_chunks > 1 else 1
+        padded = np.zeros(n_pad * 4, dtype="<u8")
+        padded[: self.length] = values
+        as_u32 = padded.view("<u4").reshape(-1, 2)
+        self._lo = jax.device_put(
+            jnp.asarray(as_u32[:, 0].copy()), self.device)
+        self._hi = jax.device_put(
+            jnp.asarray(as_u32[:, 1].copy()), self.device)
+
+    def to_numpy(self) -> np.ndarray:
+        """Download the current values (verification/debug path)."""
+        lo = np.asarray(self._lo)[: self.length].astype(np.uint64)
+        hi = np.asarray(self._hi)[: self.length].astype(np.uint64)
+        return lo | (hi << np.uint64(32))
+
+    # -- device-side mutation ------------------------------------------------
+
+    def apply_add(self, delta) -> None:
+        """Add ``delta`` (scalar or per-element array, may be negative) to
+        every live element, entirely on device.  A jnp array delta (the
+        epoch-kernel-output case) never leaves the device; a scalar ships
+        only its two u32 halves; a numpy vector is the one case that pays
+        an upload."""
+        dlo = jnp.zeros_like(self._lo)
+        dhi = jnp.zeros_like(self._hi)
+        if isinstance(delta, jnp.ndarray):
+            dlo = dlo.at[: self.length].set(delta.astype(jnp.uint32))
+            dhi = dhi.at[: self.length].set((delta >> 32).astype(jnp.uint32))
+        elif np.isscalar(delta):
+            half = np.array([delta], dtype=np.int64).view("<u4")
+            dlo = dlo.at[: self.length].set(np.uint32(half[0]))
+            dhi = dhi.at[: self.length].set(np.uint32(half[1]))
+        else:
+            halves = np.ascontiguousarray(
+                np.asarray(delta, dtype=np.int64)).view("<u4").reshape(-1, 2)
+            dlo = dlo.at[: self.length].set(jnp.asarray(halves[:, 0].copy()))
+            dhi = dhi.at[: self.length].set(jnp.asarray(halves[:, 1].copy()))
+        self._lo, self._hi = _jit_add(self._lo, self._hi, dlo, dhi)
+
+    # -- roots ---------------------------------------------------------------
+
+    def contents_subtree_root(self) -> bytes:
+        """Root of the real-data subtree (padded to its power of two)."""
+        out = np.asarray(_jit_reduce(self._lo, self._hi))
+        return out.astype(">u4").tobytes()
+
+    def as_backing_node(self) -> Node:
+        """The list's backing as a fixed-root node pair (contents, length)
+        — spliceable into a host-side container backing."""
+        import hashlib
+
+        node_root = self.contents_subtree_root()
+        n_chunks_padded = max(len(self._lo) // 4, 1)
+        level = (n_chunks_padded - 1).bit_length()
+        for d in range(level, self.contents_depth):
+            node_root = hashlib.sha256(node_root + ZERO_HASHES[d]).digest()
+        return BranchNode(LeafNode(node_root), uint_to_leaf(self.length))
+
+    def root(self) -> bytes:
+        """Full SSZ ``hash_tree_root`` of the list (zero-hash fold up to
+        the virtual depth, then mix in the length)."""
+        return merkle_root(self.as_backing_node())
+
+
+def replace_field_subtree(backing: Node, field_index: int, depth: int,
+                          new_node: Node) -> Node:
+    """Rebuild the spine of a container backing with one field's subtree
+    replaced (everything else structurally shared)."""
+    if depth == 0:
+        return new_node
+    bit = (field_index >> (depth - 1)) & 1
+    assert isinstance(backing, BranchNode)
+    if bit:
+        return BranchNode(backing.left, replace_field_subtree(
+            backing.right, field_index, depth - 1, new_node))
+    return BranchNode(replace_field_subtree(
+        backing.left, field_index, depth - 1, new_node), backing.right)

@@ -1,0 +1,72 @@
+"""Device-resident merkleization parity: the resident subtree root and the
+spliced full-state root must be bit-identical to the SSZ host path
+(ops/merkle_resident.py; reference seam: ssz_impl.hash_tree_root)."""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.ops.merkle_resident import (
+    ResidentPackedU64List,
+    replace_field_subtree,
+)
+from consensus_specs_tpu.ssz.impl import hash_tree_root
+from consensus_specs_tpu.ssz.node import merkle_root
+from consensus_specs_tpu.ssz.types import List, uint64
+
+LIMIT = 2**40
+
+
+@pytest.mark.parametrize("n", [1, 3, 4, 5, 63, 1024])
+def test_resident_root_matches_ssz(n):
+    rng = np.random.default_rng(n)
+    values = rng.integers(0, 2**63, n, dtype=np.uint64)
+    resident = ResidentPackedU64List(LIMIT)
+    resident.upload(values)
+    expected = bytes(hash_tree_root(List[uint64, LIMIT](*map(int, values))))
+    assert resident.root() == expected
+
+
+def test_resident_apply_add_scalar_and_vector():
+    rng = np.random.default_rng(99)
+    values = rng.integers(0, 2**62, 200, dtype=np.uint64)
+    resident = ResidentPackedU64List(LIMIT)
+    resident.upload(values)
+
+    resident.apply_add(7)
+    values = values + np.uint64(7)
+    assert (resident.to_numpy() == values).all()
+
+    deltas = rng.integers(-1000, 1000, 200)
+    resident.apply_add(deltas)
+    values = (values.astype(np.int64) + deltas).astype(np.uint64)
+    assert (resident.to_numpy() == values).all()
+    assert resident.root() == bytes(
+        hash_tree_root(List[uint64, LIMIT](*map(int, values))))
+
+
+def test_resident_splice_into_state_root():
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.ssz import bulk
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+    spec = get_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    cls = type(state)
+
+    balances = bulk.packed_uint64_to_numpy(state.balances).astype(np.uint64)
+    resident = ResidentPackedU64List(type(state.balances).LENGTH)
+    resident.upload(balances)
+    resident.apply_add(5)
+
+    clean = state.get_backing()
+    spliced = replace_field_subtree(
+        clean, cls._field_index["balances"], cls._depth,
+        resident.as_backing_node())
+
+    host = state.copy()
+    bulk.set_packed_uint64_from_numpy(host.balances, balances + np.uint64(5))
+    assert merkle_root(spliced) == bytes(host.hash_tree_root())
