@@ -138,3 +138,34 @@ def attestation_subnet_topic(fork_digest: bytes, subnet_id: int) -> str:
 
 def sync_committee_subnet_topic(fork_digest: bytes, subnet_id: int) -> str:
     return gossip_topic(fork_digest, f"sync_committee_{subnet_id}")
+
+
+# -- eip4844 blob-sidecar wire layer (eip4844/p2p-interface.md) -------------
+#
+# Gossip: one global `blobs_sidecar` topic carrying SignedBlobsSidecar.
+# Req/Resp: BlobsSidecarsByRange v1 returns up to MAX_REQUEST_BLOBS_SIDECARS
+# sidecars for [start_slot, start_slot + count); servers must cover the
+# trailing MIN_EPOCHS_FOR_BLOBS_SIDECARS_REQUESTS epochs.
+
+MAX_REQUEST_BLOBS_SIDECARS = 2**7
+MIN_EPOCHS_FOR_BLOBS_SIDECARS_REQUESTS = 2**13
+
+BLOBS_SIDECARS_BY_RANGE_PROTOCOL_ID = \
+    "/eth2/beacon_chain/req/blobs_sidecars_by_range/1/"
+
+
+class BlobsSidecarsByRangeRequest(Container):
+    start_slot: uint64
+    count: uint64
+
+
+def blobs_sidecar_topic(fork_digest: bytes) -> str:
+    """Gossip topic carrying ``SignedBlobsSidecar`` (eip4844+)."""
+    return gossip_topic(fork_digest, "blobs_sidecar")
+
+
+def blobs_sidecar_request_bounds(current_epoch: int, genesis_epoch: int = 0):
+    """The epoch range a compliant server must answer sidecar requests for."""
+    low = max(genesis_epoch,
+              current_epoch - MIN_EPOCHS_FOR_BLOBS_SIDECARS_REQUESTS)
+    return low, current_epoch

@@ -123,6 +123,28 @@ def process_blob_kzgs(state: BeaconState, body: BeaconBlockBody) -> None:
     )
 
 
+# Availability gate (eip4844/validator.md:49-55).  ``retrieve_blobs_sidecar``
+# is implementation-dependent in the reference ("raises an exception if not
+# available"); here it is a pluggable seam like get_pow_block/EXECUTION_ENGINE
+# so tests and a real client can install a blob store.  Without the sidecar a
+# block may be processed optimistically but MUST NOT be considered valid.
+
+
+class BlobsSidecarUnavailable(Exception):
+    """Raised when no sidecar is retrievable for (slot, block root)."""
+
+
+def retrieve_blobs_sidecar(slot: Slot, beacon_block_root: Root) -> BlobsSidecar:
+    raise BlobsSidecarUnavailable(
+        f"no blobs sidecar for slot={int(slot)} root={bytes(beacon_block_root).hex()}")
+
+
+def is_data_available(slot: Slot, beacon_block_root: Root,
+                      kzgs: Sequence[KZGCommitment]) -> None:
+    sidecar = retrieve_blobs_sidecar(slot, beacon_block_root)  # implementation dependent, raises an exception if not available
+    verify_blobs_sidecar(slot, beacon_block_root, kzgs, sidecar)
+
+
 # Sidecar validation (eip4844/validator.md)
 def verify_blobs_sidecar(slot: Slot, beacon_block_root: Root,
                          expected_kzgs: Sequence[KZGCommitment],

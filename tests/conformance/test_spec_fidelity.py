@@ -83,6 +83,9 @@ CHECKS = [
         "kzg_to_versioned_hash", "tx_peek_blob_versioned_hashes",
         "verify_kzgs_against_transactions", "process_block", "process_blob_kzgs",
     ]),
+    ("specs/eip4844/validator.md", "eip4844.py", [
+        "is_data_available", "verify_blobs_sidecar",
+    ]),
     ("specs/sharding/beacon-chain.md", "sharding.py", [
         "next_power_of_two", "compute_previous_slot",
         "compute_updated_sample_price", "compute_committee_source_epoch",

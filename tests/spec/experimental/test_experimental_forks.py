@@ -89,6 +89,46 @@ def test_eip4844_block_body_has_blob_kzgs(eip4844):
     assert "blob_kzgs" in type(body)._field_names
 
 
+def test_is_data_available_retrieve_and_verify_roundtrip(eip4844):
+    """The availability gate end-to-end over the from-scratch KZG: install a
+    blob store behind the retrieve seam, gate a (slot, root, kzgs) triple,
+    and check both the unavailable and the wrong-commitment paths fail
+    (eip4844/validator.md:49-55)."""
+    spec = eip4844
+    blob = spec.Blob([rng.randrange(int(spec.BLS_MODULUS))
+                      for _ in range(int(spec.FIELD_ELEMENTS_PER_BLOB))])
+    commitment = spec.blob_to_kzg(blob)
+    root, slot = b"\x77" * 32, 9
+    sidecar = spec.BlobsSidecar(
+        beacon_block_root=root, beacon_block_slot=slot, blobs=[blob])
+
+    # nothing retrievable: the block must not be considered valid
+    with pytest.raises(spec.BlobsSidecarUnavailable):
+        spec.is_data_available(slot, root, [commitment])
+
+    store = {(slot, root): sidecar}
+    original = spec.retrieve_blobs_sidecar
+
+    def retrieve(s, r):
+        try:
+            return store[(int(s), bytes(r))]
+        except KeyError:
+            raise spec.BlobsSidecarUnavailable()
+
+    spec.retrieve_blobs_sidecar = retrieve
+    try:
+        spec.is_data_available(slot, root, [commitment])  # passes
+
+        wrong = spec.blob_to_kzg(
+            spec.Blob([5] * int(spec.FIELD_ELEMENTS_PER_BLOB)))
+        with pytest.raises(AssertionError):
+            spec.is_data_available(slot, root, [wrong])
+        with pytest.raises(spec.BlobsSidecarUnavailable):
+            spec.is_data_available(slot + 1, root, [commitment])
+    finally:
+        spec.retrieve_blobs_sidecar = original
+
+
 # --- sharding ---------------------------------------------------------------
 
 

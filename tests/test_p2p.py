@@ -121,3 +121,35 @@ def test_gossip_topic_names():
     assert p2p.gossip_topic(fd, "beacon_block") == "/eth2/01020304/beacon_block/ssz_snappy"
     assert p2p.attestation_subnet_topic(fd, 9).endswith("/beacon_attestation_9/ssz_snappy")
     assert p2p.sync_committee_subnet_topic(fd, 3).endswith("/sync_committee_3/ssz_snappy")
+
+
+def test_blobs_sidecar_wire_layer():
+    """eip4844 p2p additions: gossip topic, by-range request container and
+    server range bounds (eip4844/p2p-interface.md)."""
+    from consensus_specs_tpu import p2p
+
+    digest = b"\x0a\x0b\x0c\x0d"
+    assert p2p.blobs_sidecar_topic(digest) == \
+        "/eth2/0a0b0c0d/blobs_sidecar/ssz_snappy"
+    assert p2p.BLOBS_SIDECARS_BY_RANGE_PROTOCOL_ID == \
+        "/eth2/beacon_chain/req/blobs_sidecars_by_range/1/"
+
+    req = p2p.BlobsSidecarsByRangeRequest(start_slot=11, count=4)
+    from consensus_specs_tpu.ssz.impl import serialize
+    assert type(req).decode_bytes(serialize(req)) == req
+    assert p2p.MAX_REQUEST_BLOBS_SIDECARS == 128
+
+    low, high = p2p.blobs_sidecar_request_bounds(10000)
+    assert (low, high) == (10000 - 8192, 10000)
+    assert p2p.blobs_sidecar_request_bounds(100) == (0, 100)
+
+
+def test_signed_blobs_sidecar_container_round_trip():
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.ssz.impl import serialize
+
+    spec = get_spec("eip4844", "minimal")
+    sidecar = spec.BlobsSidecar(beacon_block_root=b"\x31" * 32,
+                                beacon_block_slot=3)
+    signed = spec.SignedBlobsSidecar(message=sidecar, signature=b"\x09" * 96)
+    assert type(signed).decode_bytes(serialize(signed)) == signed
