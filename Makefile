@@ -7,6 +7,13 @@ PRESETS ?=
 test:
 	python -m pytest tests/ -x -q
 
+# process-parallel run (reference capability: Makefile's `pytest -n 4`).
+# Worker count defaults to the core count; on the 1-vCPU bench host this
+# degrades gracefully to the serial run.
+NPROC ?= auto
+test-par:
+	python -m pytest tests/ -q -n $(NPROC)
+
 test-fast:
 	python -m pytest tests/ -x -q --disable-bls
 
@@ -37,4 +44,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-fast test-mainnet bench lint consume mdspec gen-all $(addprefix gen-,$(GENERATORS))
+.PHONY: test test-par test-fast test-mainnet bench lint consume mdspec gen-all $(addprefix gen-,$(GENERATORS))

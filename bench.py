@@ -298,12 +298,36 @@ def bench_altair_epoch(results):
 
     t_cold, _ = _timed(spec.process_epoch, state.copy())
     t_epoch, _ = _timed(spec.process_epoch, state)
+
+    # sequential twin (the reference's algorithmic shape): bypass every
+    # altair kernel substitution, measure at BASELINE_N, scale linearly
+    from consensus_specs_tpu.specs.builder import build_spec
+
+    seq_spec = build_spec("altair", "mainnet", name="bench_seq_altair")
+    for name in ("process_justification_and_finalization",
+                 "process_rewards_and_penalties",
+                 "process_inactivity_updates",
+                 "process_participation_flag_updates"):
+        setattr(seq_spec, name, getattr(seq_spec, name).__wrapped__)
+    seq_state = build_state(seq_spec, BASELINE_N)
+    m = len(seq_state.validators)
+    bulk.set_packed_uint8_from_numpy(
+        seq_state.previous_epoch_participation,
+        rng.integers(0, 8, m).astype(np.uint8))
+    bulk.set_packed_uint8_from_numpy(
+        seq_state.current_epoch_participation,
+        rng.integers(0, 8, m).astype(np.uint8))
+    t_seq, _ = _timed(seq_spec.process_epoch, seq_state)
+    t_seq_scaled = t_seq * (N_VALIDATORS / BASELINE_N)
+
     results["altair_epoch"] = {
         "metric": f"altair_mainnet_epoch_transition_{N_VALIDATORS}_validators",
         "value": round(t_epoch, 3),
         "unit": "s",
         "cold_first_epoch_s": round(t_cold, 3),
         "state_build_s": round(t_build, 3),
+        "sequential_spec_scaled_s": round(t_seq_scaled, 3),
+        "vs_sequential": round(t_seq_scaled / t_epoch, 1),
     }
 
 

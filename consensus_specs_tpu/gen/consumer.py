@@ -54,9 +54,12 @@ def _load_ssz_list(case_dir: Path, name: str, count: int, typ):
 
 
 def _expect_failure(fn):
+    from consensus_specs_tpu.testing.exceptions import BlockNotFoundException
+
     try:
         fn()
-    except (AssertionError, IndexError, ValueError, KeyError, OverflowError):
+    except (AssertionError, IndexError, ValueError, KeyError, OverflowError,
+            BlockNotFoundException):
         return
     raise VectorFailure("invalid case executed without error")
 
@@ -319,7 +322,9 @@ def run_transition_case(case_dir: Path, meta, preset: str,
                         config=None) -> None:
     """Cross-fork transition: apply mixed pre/post-fork blocks, upgrading
     at the fork epoch (reference: tests/formats/transition/)."""
-    post_fork = meta["fork"]
+    # with_meta_tags-style modules record "fork"; with_fork_metas-driven
+    # modules record "post_fork" (the reference transition format's key)
+    post_fork = meta.get("post_fork", meta.get("fork"))
     fork_epoch = int(meta["fork_epoch"])
     pre_spec = _build(_FORK_PARENT[post_fork], preset, config)
     post_spec = _build(post_fork, preset, config)
@@ -366,9 +371,34 @@ def run_fork_choice_case(spec, case_dir: Path, meta) -> None:
     store = spec.get_forkchoice_store(anchor_state, anchor_block)
     steps = _yaml.safe_load((case_dir / "steps.yaml").read_text()) or []
 
+    # on_merge_block cases deliver PowBlocks; resolve them through the
+    # spec's get_pow_block seam for the duration of the replay
+    pow_blocks: Dict[bytes, Any] = {}
+    original_get_pow_block = getattr(spec, "get_pow_block", None)
+    if original_get_pow_block is not None:
+        from consensus_specs_tpu.testing.exceptions import BlockNotFoundException
+
+        def _get_pow_block(block_hash):
+            try:
+                return pow_blocks[bytes(block_hash)]
+            except KeyError:
+                raise BlockNotFoundException()
+
+        spec.get_pow_block = _get_pow_block
+    try:
+        _replay_fork_choice_steps(spec, store, steps, case_dir, pow_blocks)
+    finally:
+        if original_get_pow_block is not None:
+            spec.get_pow_block = original_get_pow_block
+
+
+def _replay_fork_choice_steps(spec, store, steps, case_dir, pow_blocks) -> None:
     for step in steps:
         if "tick" in step:
             spec.on_tick(store, int(step["tick"]))
+        elif "pow_block" in step:
+            pow_block = _load_ssz(case_dir, step["pow_block"], spec.PowBlock)
+            pow_blocks[bytes(pow_block.block_hash)] = pow_block
         elif "block" in step:
             signed = _load_ssz(case_dir, step["block"], spec.SignedBeaconBlock)
 
@@ -428,6 +458,10 @@ def _run_store_checks(spec, store, checks) -> None:
         elif name == "genesis_time":
             got = int(store.genesis_time)
             if got != int(want):
+                fail(name, got, want)
+        elif name == "justified_checkpoint_root":
+            got = _hex(store.justified_checkpoint.root)
+            if got != want:
                 fail(name, got, want)
         elif name.endswith("_checkpoint"):
             cp = getattr(store, name)

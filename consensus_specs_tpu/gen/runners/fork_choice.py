@@ -11,11 +11,20 @@ def main(argv=None):
     from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
 
     ensure_vector_sources_importable()
-    mods = {"get_head": "tests.spec.phase0.test_fork_choice"}
+    # reference handler taxonomy (tests/generators/fork_choice/main.py):
+    # get_head / on_block / ex_ante, plus on_merge_block from bellatrix
+    mods = {
+        "get_head": ["tests.spec.phase0.test_fork_choice",
+                     "tests.spec.phase0.fork_choice.test_get_head"],
+        "ex_ante": "tests.spec.phase0.fork_choice.test_ex_ante",
+        "on_block": "tests.spec.phase0.fork_choice.test_on_block",
+    }
     all_mods = {
         "phase0": mods,
         "altair": mods,
-        "bellatrix": mods,
+        "bellatrix": {**mods,
+                      "on_merge_block":
+                          "tests.spec.bellatrix.fork_choice.test_on_merge_block"},
         "capella": mods,
     }
     run_state_test_generators(

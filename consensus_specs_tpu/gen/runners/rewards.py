@@ -9,7 +9,11 @@ def main(argv=None):
     from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
 
     ensure_vector_sources_importable()
-    mods = {"basic": "tests.spec.phase0.rewards.test_basic"}
+    mods = {
+        "basic": "tests.spec.phase0.rewards.test_basic",
+        "leak": "tests.spec.phase0.rewards.test_leak",
+        "random": "tests.spec.phase0.rewards.test_random",
+    }
     altair_mods = {"basic": "tests.spec.altair.rewards.test_basic"}
     all_mods = {
         "phase0": mods,

@@ -12,11 +12,24 @@ def main(argv=None):
     from consensus_specs_tpu.gen.runners import ensure_vector_sources_importable
 
     ensure_vector_sources_importable()
+    from consensus_specs_tpu.testing.helpers.constants import ALL_PRE_POST_FORKS
+
+    # Reference taxonomy (tests/generators/transition/main.py): EVERY
+    # module emits under handler "core", for every pre/post fork pair.
+    modules = (
+        "tests.spec.altair.test_transition",
+        "tests.spec.altair.transition.test_activations_and_exits",
+        "tests.spec.altair.transition.test_leaking",
+        "tests.spec.altair.transition.test_operations",
+        "tests.spec.altair.transition.test_slashing",
+    )
     providers = [
         make_cross_fork_provider(
-            "tests.spec.altair.test_transition", preset, "phase0", "altair",
+            mod, preset, pre_fork, post_fork,
             runner_name="transition", handler_name="core")
         for preset in ("minimal", "mainnet")
+        for mod in modules
+        for pre_fork, post_fork in ALL_PRE_POST_FORKS
     ]
     gen_runner.run_generator("transition", providers, argv=argv)
 

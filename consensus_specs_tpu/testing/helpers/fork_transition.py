@@ -46,17 +46,23 @@ def state_transition_across_slots(spec, state, to_slot, block_filter=_all_blocks
                                   ignoring_proposers=None):
     """Advance to ``to_slot``, yielding a signed block per admitted slot.
 
-    ``ignoring_proposers``: slot is left empty when its proposer is in the
-    set (e.g. slashed validators who can no longer propose)."""
+    ``ignoring_proposers``: slots whose proposer is in the set (e.g. slashed
+    validators, who can no longer propose) stay empty; the walk then runs
+    PAST ``to_slot`` until one block actually lands, so the caller's post
+    state always includes a block at slot >= to_slot (reference semantics:
+    state_transition_across_slots_with_ignoring_proposers)."""
     assert state.slot < to_slot
-    while state.slot < to_slot:
-        should_make_block = block_filter(state)
+    produced_at_or_after_target = ignoring_proposers is None
+    while state.slot < to_slot or not produced_at_or_after_target:
+        should_make_block = block_filter(state) or state.slot >= to_slot
         if should_make_block and ignoring_proposers is not None:
             proposer = get_proposer_index_maybe(spec, state, state.slot + 1)
             should_make_block = proposer not in ignoring_proposers
         if should_make_block:
             block = build_empty_block_for_next_slot(spec, state)
             yield state_transition_and_sign_block(spec, state, block)
+            if state.slot >= to_slot:
+                produced_at_or_after_target = True
         else:
             next_slot(spec, state)
 
