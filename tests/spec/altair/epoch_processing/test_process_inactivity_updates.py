@@ -106,3 +106,155 @@ def test_nonparticipant_bias_then_floor(spec, state):
     expected = max(7 + bias - rate, 0)
     for index in [int(i) for i in spec.get_eligible_validator_indices(state)]:
         assert int(state.inactivity_scores[index]) == expected
+
+
+# -- scores x participation x leak matrix ------------------------------------
+#
+# Shared runner (reference capability: the run_inactivity_scores_test matrix
+# of test_process_inactivity_updates.py): seed the scores and participation
+# shape, run the sub-transition, and verify the spec formula per validator.
+
+
+def _seed_scores(spec, state, rng=None):
+    for index in range(len(state.validators)):
+        state.inactivity_scores[index] = (
+            0 if rng is None else rng.randint(0, 100))
+
+
+def _expected_score(spec, state, index, pre_score, participated_target):
+    score = pre_score
+    if participated_target:
+        score -= min(1, score)
+    else:
+        score += int(spec.config.INACTIVITY_SCORE_BIAS)
+    if not spec.is_in_inactivity_leak(state):
+        score -= min(int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE), score)
+    return score
+
+
+def _run_inactivity_matrix_case(spec, state, participation_fn, rng=None):
+    next_epoch(spec, state)
+    _seed_scores(spec, state, rng)
+    participation_fn(spec, state)
+    pre_scores = [int(x) for x in state.inactivity_scores]
+    eligible = {int(i) for i in spec.get_eligible_validator_indices(state)}
+    on_target = {int(i) for i in spec.get_unslashed_participating_indices(
+        state, int(spec.TIMELY_TARGET_FLAG_INDEX), spec.get_previous_epoch(state))}
+
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+
+    for index in range(len(state.validators)):
+        if index not in eligible:
+            assert int(state.inactivity_scores[index]) == pre_scores[index]
+        else:
+            assert int(state.inactivity_scores[index]) == _expected_score(
+                spec, state, index, pre_scores[index], index in on_target)
+
+
+def _random_participation(spec, state):
+    from consensus_specs_tpu.testing.helpers.random import (
+        randomize_attestation_participation,
+    )
+    randomize_attestation_participation(spec, state, rng=Random(5522))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_all_zero_inactivity_scores_empty_participation(spec, state):
+    yield from _run_inactivity_matrix_case(spec, state, set_empty_participation)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_all_zero_inactivity_scores_empty_participation_leaking(spec, state):
+    yield from _run_inactivity_matrix_case(spec, state, set_empty_participation)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_all_zero_inactivity_scores_random_participation(spec, state):
+    yield from _run_inactivity_matrix_case(spec, state, _random_participation)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_all_zero_inactivity_scores_random_participation_leaking(spec, state):
+    yield from _run_inactivity_matrix_case(spec, state, _random_participation)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_random_inactivity_scores_empty_participation(spec, state):
+    yield from _run_inactivity_matrix_case(
+        spec, state, set_empty_participation, rng=Random(10101))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_random_inactivity_scores_empty_participation_leaking(spec, state):
+    yield from _run_inactivity_matrix_case(
+        spec, state, set_empty_participation, rng=Random(10102))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_random_inactivity_scores_random_participation(spec, state):
+    yield from _run_inactivity_matrix_case(
+        spec, state, _random_participation, rng=Random(10103))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_random_inactivity_scores_random_participation_leaking(spec, state):
+    yield from _run_inactivity_matrix_case(
+        spec, state, _random_participation, rng=Random(10104))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_random_inactivity_scores_full_participation(spec, state):
+    yield from _run_inactivity_matrix_case(
+        spec, state, set_full_participation, rng=Random(10105))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_random_inactivity_scores_full_participation_leaking(spec, state):
+    yield from _run_inactivity_matrix_case(
+        spec, state, set_full_participation, rng=Random(10106))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_some_slashed_zero_scores_full_participation(spec, state):
+    from consensus_specs_tpu.testing.helpers.random import slash_random_validators
+
+    slash_random_validators(spec, state, rng=Random(10107), fraction=0.25)
+    yield from _run_inactivity_matrix_case(spec, state, set_full_participation)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_some_slashed_zero_scores_full_participation_leaking(spec, state):
+    from consensus_specs_tpu.testing.helpers.random import slash_random_validators
+
+    slash_random_validators(spec, state, rng=Random(10108), fraction=0.25)
+    yield from _run_inactivity_matrix_case(spec, state, set_full_participation)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_some_exited_full_random_leaking(spec, state):
+    from consensus_specs_tpu.testing.helpers.random import exit_random_validators
+
+    exit_random_validators(spec, state, rng=Random(10109), fraction=0.25,
+                           exit_epoch=spec.get_current_epoch(state))
+    yield from _run_inactivity_matrix_case(
+        spec, state, _random_participation, rng=Random(10110))

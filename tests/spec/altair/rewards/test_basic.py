@@ -121,3 +121,79 @@ def test_half_participation_leak_with_scores(spec, state):
     for index in range(len(state.validators)):
         state.inactivity_scores[index] = rng.randrange(0, 50)
     yield from run_flag_deltas(spec, state)
+
+
+# -- inactivity-score-focused scenarios (reference suite:
+#    test/altair/rewards/test_inactivity_scores.py) ---------------------------
+
+
+def _seed_inactivity_scores(spec, state, rng=None, uniform=None):
+    for index in range(len(state.validators)):
+        state.inactivity_scores[index] = (
+            uniform if uniform is not None else rng.randint(0, 1000))
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_random_inactivity_scores_full_participation(spec, state):
+    _advance(spec, state)
+    set_full_participation(spec, state)
+    _seed_inactivity_scores(spec, state, Random(9001))
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_random_inactivity_scores_full_participation_leaking(spec, state):
+    set_full_participation(spec, state)
+    _seed_inactivity_scores(spec, state, Random(9002))
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+def test_random_inactivity_scores_empty_participation(spec, state):
+    _advance(spec, state)
+    set_empty_participation(spec, state)
+    _seed_inactivity_scores(spec, state, Random(9003))
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_random_inactivity_scores_empty_participation_leaking(spec, state):
+    set_empty_participation(spec, state)
+    _seed_inactivity_scores(spec, state, Random(9004))
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_maximal_inactivity_scores_leaking(spec, state):
+    """Quadratic penalties at the score ceiling must not overflow or go
+    negative through the balance floor."""
+    set_empty_participation(spec, state)
+    _seed_inactivity_scores(
+        spec, state, uniform=int(spec.config.INACTIVITY_SCORE_BIAS) * 100)
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking()
+def test_zero_inactivity_scores_leaking(spec, state):
+    set_empty_participation(spec, state)
+    _seed_inactivity_scores(spec, state, uniform=0)
+    yield from run_flag_deltas(spec, state)
+
+
+@with_phases(ALTAIR_AND_LATER)
+@spec_state_test
+@leaking(epochs_extra=6)
+def test_random_scores_deep_leak_partial_participation(spec, state):
+    _set_partial_participation(spec, state, Random(9005), fraction=0.3)
+    _seed_inactivity_scores(spec, state, Random(9006))
+    yield from run_flag_deltas(spec, state)

@@ -141,3 +141,182 @@ def test_proposer_in_committee_without_participation(spec, state):
         else:
             transition_to(spec, state, state.slot + 1)
     raise AssertionError("no proposer in committee found within an epoch")
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_no_participants(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    # no participants, but a random (non-infinity) signature
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[False] * len(block.body.sync_aggregate.sync_committee_bits),
+        sync_committee_signature=b"\x55" * 96,
+    )
+    yield from run_sync_committee_processing(spec, state, block, expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_infinite_signature_with_all_participants(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    # G2 infinity only verifies for the EMPTY participant set
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(block.body.sync_aggregate.sync_committee_bits),
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY,
+    )
+    yield from run_sync_committee_processing(spec, state, block, expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_infinite_signature_with_single_participant(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    bits = [False] * len(block.body.sync_aggregate.sync_committee_bits)
+    bits[0] = True
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY,
+    )
+    yield from run_sync_committee_processing(spec, state, block, expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_past_block(spec, state):
+    from consensus_specs_tpu.testing.helpers.state import (
+        state_transition_and_sign_block,
+    )
+
+    committee_indices = compute_committee_indices(spec, state)
+    for _ in range(2):  # build some history
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.sync_aggregate = spec.SyncAggregate(
+            sync_committee_bits=[True] * len(committee_indices),
+            sync_committee_signature=compute_aggregate_sync_committee_signature(
+                spec, state, block.slot - 1, committee_indices,
+                block_root=block.parent_root))
+        state_transition_and_sign_block(spec, state, block)
+
+    # aggregate signs a TWO-slots-old root: wrong message for this slot
+    invalid_block = build_empty_block_for_next_slot(spec, state)
+    invalid_block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, invalid_block.slot - 2, committee_indices),
+    )
+    yield from run_sync_committee_processing(
+        spec, state, invalid_block, expect_exception=True)
+
+
+def _sync_member_in_lifecycle_stage(spec, state, committee_indices, mutate):
+    """Apply ``mutate`` to one committee member picked deterministically."""
+    victim = committee_indices[0]
+    mutate(state.validators[victim])
+    return victim
+
+
+def _aged_state_with_committee(spec, state):
+    from consensus_specs_tpu.testing.helpers.state import next_epoch_via_block
+
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    for _ in range(2):
+        next_epoch_via_block(spec, state)
+    return compute_committee_indices(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_sync_committee_with_participating_exited_member(spec, state):
+    committee_indices = _aged_state_with_committee(spec, state)
+    victim = _sync_member_in_lifecycle_stage(
+        spec, state, committee_indices,
+        lambda v: spec.initiate_validator_exit(
+            state, committee_indices[0]))
+    # past the exit epoch but not yet withdrawable: still a valid signer
+    from consensus_specs_tpu.testing.helpers.state import transition_to as _tt
+    _tt(spec, state, int(spec.compute_start_slot_at_epoch(
+        state.validators[victim].exit_epoch + 1)))
+    assert spec.get_current_epoch(state) < state.validators[victim].withdrawable_epoch
+    assert not spec.is_active_validator(
+        state.validators[victim], spec.get_current_epoch(state))
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices,
+            block_root=block.parent_root))
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_sync_committee_with_nonparticipating_exited_member(spec, state):
+    committee_indices = _aged_state_with_committee(spec, state)
+    victim = committee_indices[0]
+    spec.initiate_validator_exit(state, victim)
+    from consensus_specs_tpu.testing.helpers.state import transition_to as _tt
+    _tt(spec, state, int(spec.compute_start_slot_at_epoch(
+        state.validators[victim].exit_epoch + 1)))
+
+    # the exited seat abstains; everyone else signs
+    victim_pubkey = state.validators[victim].pubkey
+    seat = list(state.current_sync_committee.pubkeys).index(victim_pubkey)
+    bits = [i != seat for i in range(len(committee_indices))]
+    participants = [idx for i, idx in enumerate(committee_indices) if i != seat]
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participants,
+            block_root=block.parent_root))
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_sync_committee_with_participating_withdrawable_member(spec, state):
+    committee_indices = _aged_state_with_committee(spec, state)
+    victim = committee_indices[0]
+    # fully withdrawable, yet the committee seat still signs validly
+    state.validators[victim].exit_epoch = spec.get_current_epoch(state) - 2
+    state.validators[victim].withdrawable_epoch = spec.get_current_epoch(state) - 1
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices,
+            block_root=block.parent_root))
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_sync_committee_with_nonparticipating_withdrawable_member(spec, state):
+    committee_indices = _aged_state_with_committee(spec, state)
+    victim = committee_indices[0]
+    state.validators[victim].exit_epoch = spec.get_current_epoch(state) - 2
+    state.validators[victim].withdrawable_epoch = spec.get_current_epoch(state) - 1
+
+    victim_pubkey = state.validators[victim].pubkey
+    seat = list(state.current_sync_committee.pubkeys).index(victim_pubkey)
+    bits = [i != seat for i in range(len(committee_indices))]
+    participants = [idx for i, idx in enumerate(committee_indices) if i != seat]
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participants,
+            block_root=block.parent_root))
+    yield from run_sync_committee_processing(spec, state, block)

@@ -58,3 +58,135 @@ def test_process_withdrawals_wrong_payload_fails(spec, state):
     yield "execution_payload", payload
     expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
     yield "post", None
+
+
+@with_capella_and_later
+@spec_state_test
+def test_process_withdrawals_empty_queue_empty_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    assert len(state.withdrawals_queue) == 0
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 0
+
+    yield "pre", state
+    yield "execution_payload", payload
+    spec.process_withdrawals(state, payload)
+    yield "post", state
+    assert len(state.withdrawals_queue) == 0
+
+
+@with_capella_and_later
+@spec_state_test
+def test_process_withdrawals_multiple_dequeued_in_order(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    for index in (0, 1, 2):
+        _make_validator_withdrawable(spec, state, index)
+    next_epoch(spec, state)
+    assert len(state.withdrawals_queue) == 3
+    queued = [w.copy() for w in state.withdrawals_queue]
+
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 3
+
+    yield "pre", state
+    yield "execution_payload", payload
+    spec.process_withdrawals(state, payload)
+    yield "post", state
+    assert len(state.withdrawals_queue) == 0
+    # FIFO: payload order matched the queue's
+    for want, got in zip(queued, payload.withdrawals):
+        assert want == got
+
+
+@with_capella_and_later
+@spec_state_test
+def test_process_withdrawals_caps_at_max_per_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    count = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) + 2
+    for index in range(count):
+        _make_validator_withdrawable(spec, state, index)
+    next_epoch(spec, state)
+    assert len(state.withdrawals_queue) == count
+
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)
+
+    yield "pre", state
+    yield "execution_payload", payload
+    spec.process_withdrawals(state, payload)
+    yield "post", state
+    assert len(state.withdrawals_queue) == 2  # the overflow stays queued
+
+
+@with_capella_and_later
+@spec_state_test
+def test_process_withdrawals_extra_payload_withdrawal_fails(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    _make_validator_withdrawable(spec, state, 0)
+    next_epoch(spec, state)
+
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals.append(payload.withdrawals[0])  # uncovenanted extra
+
+    yield "pre", state
+    yield "execution_payload", payload
+    expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+    yield "post", None
+
+
+@with_capella_and_later
+@spec_state_test
+def test_process_withdrawals_wrong_order_fails(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    for index in (0, 1):
+        _make_validator_withdrawable(spec, state, index)
+    next_epoch(spec, state)
+    assert len(state.withdrawals_queue) == 2
+
+    payload = build_empty_execution_payload(spec, state)
+    w0, w1 = payload.withdrawals[0].copy(), payload.withdrawals[1].copy()
+    payload.withdrawals[0] = w1
+    payload.withdrawals[1] = w0
+
+    yield "pre", state
+    yield "execution_payload", payload
+    expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+    yield "post", None
+
+
+@with_capella_and_later
+@spec_state_test
+def test_withdraw_balance_enqueues_and_decrements(spec, state):
+    index = 0
+    pre_balance = int(state.balances[index])
+    amount = pre_balance // 4
+    pre_queue_len = len(state.withdrawals_queue)
+
+    spec.withdraw_balance(state, index, amount)
+
+    assert int(state.balances[index]) == pre_balance - amount
+    assert len(state.withdrawals_queue) == pre_queue_len + 1
+    entry = state.withdrawals_queue[-1]
+    assert int(entry.amount) == amount
+    assert int(entry.index) == pre_queue_len  # monotone withdrawal index
+    # recipient address comes from the eth1 withdrawal credentials tail
+    assert bytes(entry.address) == bytes(
+        state.validators[index].withdrawal_credentials[12:])
+    yield from ()
+
+
+@with_capella_and_later
+@spec_state_test
+def test_full_withdrawals_epoch_processing_skips_bls_credentialed(spec, state):
+    # BLS-prefixed credentials are NOT withdrawable in the early draft
+    index = 0
+    validator = state.validators[index]
+    validator.withdrawable_epoch = spec.get_current_epoch(state)
+    assert bytes(validator.withdrawal_credentials)[:1] == bytes(
+        spec.BLS_WITHDRAWAL_PREFIX)
+    assert not spec.is_fully_withdrawable_validator(
+        validator, spec.get_current_epoch(state))
+    pre_queue_len = len(state.withdrawals_queue)
+    next_epoch(spec, state)
+    assert len(state.withdrawals_queue) == pre_queue_len
+    yield from ()

@@ -206,8 +206,6 @@ def test_partially_overlapping_participants(spec, state):
 @with_all_phases
 @spec_state_test
 def test_already_exited_recent(spec, state):
-    from consensus_specs_tpu.testing.helpers.attestations import get_valid_attestation
-
     slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
     victims = get_indexed_attestation_participants(spec, slashing.attestation_1)
     # initiated exit, still within the slashable window

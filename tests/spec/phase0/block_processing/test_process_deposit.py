@@ -149,3 +149,82 @@ def test_invalid_bad_merkle_proof(spec, state):
     yield from run_deposit_processing(
         spec, state, deposit, validator_index, valid=False
     )
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_eth1_withdrawal_credentials(spec, state):
+    validator_index = len(state.validators)
+    creds = (bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+             + b"\x00" * 11  # specified padding
+             + b"\x59" * 20)  # a 20-byte eth1 address
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, spec.MAX_EFFECTIVE_BALANCE,
+        withdrawal_credentials=creds, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+    assert bytes(state.validators[validator_index].withdrawal_credentials) == creds
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_non_versioned_withdrawal_credentials(spec, state):
+    # process_deposit does NOT validate the credentials prefix
+    validator_index = len(state.validators)
+    creds = b"\xff" + b"\x02" * 31
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, spec.MAX_EFFECTIVE_BALANCE,
+        withdrawal_credentials=creds, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+    assert bytes(state.validators[validator_index].withdrawal_credentials) == creds
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_other_version(spec, state):
+    """A signature over the right message but the wrong domain fork version
+    is a no-op new deposit (not a failure)."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.testing.helpers.deposits import (
+        build_deposit,
+        default_withdrawal_credentials,
+    )
+    from consensus_specs_tpu.testing.helpers.keys import privkeys, pubkeys
+
+    validator_index = len(state.validators)
+    pubkey = pubkeys[validator_index]
+    creds = default_withdrawal_credentials(spec, pubkey)
+    deposit, root, _ = build_deposit(
+        spec, [], pubkey, privkeys[validator_index],
+        spec.MAX_EFFECTIVE_BALANCE, creds, signed=False)
+    # sign under a bogus fork version
+    message = spec.DepositMessage(
+        pubkey=pubkey, withdrawal_credentials=creds,
+        amount=spec.MAX_EFFECTIVE_BALANCE)
+    domain = spec.compute_domain(
+        spec.DOMAIN_DEPOSIT, fork_version=b"\xab\xcd\xef\xff")
+    deposit.data.signature = bls.Sign(
+        privkeys[validator_index], spec.compute_signing_root(message, domain))
+    # re-derive the proof for the mutated data
+    from consensus_specs_tpu.testing.helpers.deposits import deposit_from_context
+    deposit, root, _ = deposit_from_context(spec, [deposit.data], 0)
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = 1
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, effective=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_withdrawal_credentials_top_up(spec, state):
+    """Top-ups ignore the deposit's credentials entirely."""
+    validator_index = 0
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, spec.MAX_EFFECTIVE_BALANCE // 4,
+        withdrawal_credentials=b"\xff" * 32)
+    pre_creds = bytes(state.validators[validator_index].withdrawal_credentials)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+    assert bytes(
+        state.validators[validator_index].withdrawal_credentials) == pre_creds

@@ -128,3 +128,31 @@ def test_success_exit_queue_ordering(spec, state):
     assert state.validators[overflow_index].exit_epoch == (
         state.validators[first[0]].exit_epoch + 1
     )
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_index(spec, state):
+    _eligible_state(spec, state)
+    index = spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state))[0]
+    signed = _signed_exit(spec, state, index)
+    signed.message.validator_index = len(state.validators) + 100
+    yield from run_voluntary_exit_processing(spec, state, signed, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_default_exit_epoch_subsequent_exit(spec, state):
+    """A later exit inherits the furthest pending exit epoch, not the
+    computed activation-queue epoch."""
+    _eligible_state(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    indices = spec.get_active_validator_indices(state, current_epoch)
+
+    # park an earlier exit far in the future
+    state.validators[indices[0]].exit_epoch = current_epoch + 30
+
+    signed = _signed_exit(spec, state, indices[1])
+    yield from run_voluntary_exit_processing(spec, state, signed)
+    assert state.validators[indices[1]].exit_epoch == current_epoch + 30
