@@ -118,9 +118,93 @@ def FastAggregateVerify(pubkeys, message, signature):
 
     tracing.count("bls.fast_aggregate_verify")
     tracing.count("bls.fast_aggregate_verify.pubkeys", len(pubkeys))
+    if _deferred_stack:
+        _deferred_stack[-1].entries.append(
+            (tuple(bytes(p) for p in pubkeys), bytes(message), bytes(signature))
+        )
+        return True  # optimistic; settled at scope exit
     try:
         return bls.FastAggregateVerify(pubkeys, message, signature)
     except Exception:
+        return False
+
+
+# --- deferred (batched) verification ----------------------------------------
+# The sanctioned sundry-layer substitution for the block-processing hot path
+# (SURVEY §7; reference analogue setup.py:488-492): every FastAggregateVerify
+# issued inside the scope is collected and settled in ONE batched pairing
+# product with a single shared final exponentiation.
+
+_deferred_stack: list = []
+
+
+def _batch_verify(entries) -> bool:
+    """True iff every (pubkeys, message, signature) entry verifies."""
+    if not entries:
+        return True
+    backend_batch = getattr(bls, "BatchFastAggregateVerify", None)
+    if backend_batch is not None:
+        try:
+            return bool(backend_batch(entries))
+        except Exception:
+            return False
+    for pks, msg, sig in entries:  # backends without a batch API
+        try:
+            if not bls.FastAggregateVerify(pks, msg, sig):
+                return False
+        except Exception:
+            return False
+    return True
+
+
+def _first_invalid(entries):
+    """Index of the FIRST failing entry, or None if all verify.
+
+    Bisects with sub-batch calls: O(log n) batched verifications instead of
+    n sequential ones, and always lands on the leftmost failure so deferred
+    semantics report the same culprit the sequential path would have."""
+    if _batch_verify(entries):
+        return None
+    lo, hi = 0, len(entries)
+    # invariant: entries[:lo] all verify; at least one failure in [lo, hi)
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if _batch_verify(entries[lo:mid]):
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+class deferred_fast_aggregate_verify:
+    """Context manager: FastAggregateVerify calls inside the scope return
+    True optimistically and are settled as one batch on exit.
+
+    Failure semantics mirror the sequential path:
+      * all signatures valid -> scope exits cleanly (and any structural
+        exception raised inside propagates unchanged);
+      * some signature invalid -> AssertionError naming the first failing
+        check in call order — the same check the sequential path would have
+        tripped on — even if a later operation raised first while running
+        optimistically.
+    """
+
+    def __enter__(self):
+        self.entries = []
+        _deferred_stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        popped = _deferred_stack.pop()
+        assert popped is self, "deferred verification scopes must nest"
+        if not bls_active or not self.entries:
+            return False
+        first_bad = _first_invalid(self.entries)
+        if first_bad is not None:
+            raise AssertionError(
+                f"deferred signature verification failed: batch entry "
+                f"{first_bad} of {len(self.entries)} is invalid"
+            ) from exc
         return False
 
 

@@ -154,10 +154,22 @@ def _ntt_apply(x, schedule):
     return x
 
 
+# Lazy-carry magnitude bound: _ntt_apply accumulates e±t without per-stage
+# renormalization while mul drops its final carry, so worst-case entry
+# magnitudes grow ~2 canonical units per stage.  REDC stays exact for
+# inputs above -2^260; 2^14 stages of growth keeps the worst case inside
+# that window with margin (the eip4844/DAS sizes are <= 2^12, verified
+# bit-exact to 2^12 in tests).  Larger transforms would need renormalizing
+# lanes every few stages.
+MAX_NTT_SIZE = 1 << 14
+
+
 def ntt_device(values: Sequence[int], inv: bool = False) -> List[int]:
     """Single-device NTT over Fr, bit-exact vs crypto.fr.fft."""
     n = len(values)
     assert n & (n - 1) == 0
+    assert n <= MAX_NTT_SIZE, (
+        f"transform size {n} exceeds the lazy-carry bound {MAX_NTT_SIZE}")
     w = root_of_unity(n)
     if inv:
         w = pow(w, FR_MOD - 2, FR_MOD)
@@ -195,6 +207,8 @@ def sharded_ntt(values: Sequence[int], mesh, axis_name: str = None) -> List[int]
     n = len(values)
     d = mesh.devices.size
     assert n % d == 0 and n & (n - 1) == 0
+    assert n <= MAX_NTT_SIZE, (
+        f"transform size {n} exceeds the lazy-carry bound {MAX_NTT_SIZE}")
     m = n // d
     w_n = root_of_unity(n)
     w_d = pow(w_n, m, FR_MOD)

@@ -118,9 +118,14 @@ class ResidentPackedU64List:
         epoch-kernel-output case) never leaves the device; a scalar ships
         only its two u32 halves; a numpy vector is the one case that pays
         an upload."""
+        assert self._lo is not None, "upload() before apply_add()"
         dlo = jnp.zeros_like(self._lo)
         dhi = jnp.zeros_like(self._hi)
         if isinstance(delta, jnp.ndarray):
+            # >> 32 must be an arithmetic shift so negative deltas carry a
+            # sign-extended high half; only int64 guarantees that here
+            assert delta.dtype == jnp.int64, (
+                f"jnp delta must be int64, got {delta.dtype}")
             dlo = dlo.at[: self.length].set(delta.astype(jnp.uint32))
             dhi = dhi.at[: self.length].set((delta >> 32).astype(jnp.uint32))
         elif np.isscalar(delta):
@@ -138,6 +143,7 @@ class ResidentPackedU64List:
 
     def contents_subtree_root(self) -> bytes:
         """Root of the real-data subtree (padded to its power of two)."""
+        assert self._lo is not None, "upload() before reading roots"
         out = np.asarray(_jit_reduce(self._lo, self._hi))
         return out.astype(">u4").tobytes()
 

@@ -333,6 +333,32 @@ def _install_optimizations(g: Dict[str, Any]) -> None:
         _install_phase0_epoch_kernel(g)
     else:
         _install_altair_epoch_kernel(g)
+    _install_deferred_block_verification(g)
+
+
+def _install_deferred_block_verification(g: Dict[str, Any]) -> None:
+    """Batch a block's aggregate-signature checks into one pairing product.
+
+    ``process_block`` runs under ``bls.deferred_fast_aggregate_verify``:
+    every FastAggregateVerify its operations issue (attestations via
+    is_valid_indexed_attestation, attester slashings, altair+ sync
+    aggregates) is collected and settled in a single batched verification
+    with one shared final exponentiation — the sanctioned sundry-layer
+    substitution (SURVEY §7; reference analogue setup.py:488-492).  Failure
+    ordering is preserved by the context manager: the AssertionError names
+    the first failing check in sequential call order.  Differential tests:
+    tests/spec/phase0/test_batch_verification.py."""
+    from consensus_specs_tpu.crypto import bls as bls_mod
+
+    orig = g["process_block"]
+
+    def process_block(state, block):
+        with bls_mod.deferred_fast_aggregate_verify():
+            orig(state, block)
+
+    process_block.__doc__ = orig.__doc__
+    process_block.__wrapped__ = orig
+    g["process_block"] = process_block
 
 
 def _install_altair_epoch_kernel(g: Dict[str, Any]) -> None:

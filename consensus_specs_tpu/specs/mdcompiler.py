@@ -409,7 +409,10 @@ def fork_spec_object(fork: str, preset: Dict[str, int],
             path = reference_root / doc
             assert path.exists(), f"spec document missing: {path}"
             text = path.read_text()
-            if reference_root == REFERENCE_ROOT:
+            # resolve() both sides: a symlinked/equivalent spelling of the
+            # vendored path must not silently bypass the digest gate on
+            # markdown whose code fences get exec'd
+            if reference_root.resolve() == REFERENCE_ROOT.resolve():
                 _verify_pinned_digest(doc, text)
             if not text.strip():  # capella/p2p-interface.md is empty
                 continue

@@ -577,4 +577,4 @@ def process_custody_final_updates(state: BeaconState) -> None:
                 # Reset withdrawable epochs if challenge records are empty and all secrets are revealed
                 if validator.withdrawable_epoch == FAR_FUTURE_EPOCH:
                     validator.withdrawable_epoch = Epoch(validator.all_custody_secrets_revealed_epoch
-                                                         + MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+                                                         + config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)

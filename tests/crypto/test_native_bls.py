@@ -164,3 +164,142 @@ def test_cross_backend_verify():
     sk, msg = 31337, b"cross-check"
     assert py.Verify(py.SkToPk(sk), msg, native.Sign(sk, msg))
     assert native.Verify(native.SkToPk(sk), msg, py.Sign(sk, msg))
+
+
+def test_non_subgroup_g2_rejected():
+    """The psi-based fast subgroup test must reject curve points outside the
+    r-order subgroup exactly as the [r]P == inf test did."""
+    import random
+
+    from consensus_specs_tpu.crypto.bls.curve import Point
+    from consensus_specs_tpu.crypto.bls.fields import Fq2, P
+
+    rng = random.Random(99)
+    b2 = Fq2(4, 4)
+    found = 0
+    while found < 3:
+        x = Fq2(rng.randrange(P), rng.randrange(P))
+        y = (x.square() * x + b2).sqrt()
+        if y is None:
+            continue
+        pt = Point(x, y, Fq2.one(), b2)
+        if pt.in_subgroup():  # astronomically unlikely
+            continue
+        found += 1
+        encoded = g2_to_bytes(pt)
+        pk = native.SkToPk(5)
+        # used as a signature: load_signature must reject -> False, not crash
+        assert not native.Verify(pk, b"msg", encoded)
+        assert not native.FastAggregateVerify([pk], b"msg", encoded)
+        assert not native.BatchFastAggregateVerify(
+            [([pk], b"msg", encoded)], seed=b"\x07" * 32)
+
+
+def test_batch_fast_aggregate_verify_matches_sequential():
+    """Differential: for random valid/invalid mixes, the batch answer equals
+    the AND of the individual FastAggregateVerify answers."""
+    import random
+
+    from consensus_specs_tpu.crypto.bls.curve import R
+
+    rng = random.Random(4242)
+    sks = [rng.randrange(1, R) for _ in range(12)]
+    pks = [native.SkToPk(sk) for sk in sks]
+
+    def item(members, msg, good=True):
+        agg_sk = sum(sks[m] for m in members) % R
+        sig = native.Sign(agg_sk, msg if good else msg + b"!")
+        return ([pks[m] for m in members], msg, sig)
+
+    for trial in range(6):
+        items = []
+        expected = True
+        for i in range(rng.randrange(1, 6)):
+            members = rng.sample(range(12), rng.randrange(1, 6))
+            good = rng.random() < 0.7
+            items.append(item(members, b"msg%d-%d" % (trial, i), good))
+            expected = expected and good
+        seed = bytes([trial]) * 32
+        assert native.BatchFastAggregateVerify(items, seed=seed) == expected
+        seq = all(native.FastAggregateVerify(*it) for it in items)
+        assert seq == expected
+
+
+def test_batch_empty_and_invalid_shapes():
+    assert native.BatchFastAggregateVerify([])
+    msg = b"m"
+    sig = native.Sign(7, msg)
+    # zero pubkeys in an item -> that item invalid -> batch False
+    assert not native.BatchFastAggregateVerify([([], msg, sig)])
+    # malformed signature length
+    assert not native.BatchFastAggregateVerify([([native.SkToPk(7)], msg, sig[:-1])])
+    # malformed pubkey -> invalid item
+    assert not native.BatchFastAggregateVerify([([b"\x00" * 48], msg, sig)])
+
+
+def test_batch_deterministic_seed():
+    """Same seed -> same RLC scalars -> identical (deterministic) outcome."""
+    msg = b"det"
+    sks = SKS[:3]
+    pks = [native.SkToPk(sk) for sk in sks]
+    agg = native.Aggregate([native.Sign(sk, msg) for sk in sks])
+    items = [(pks, msg, agg)] * 4
+    for seed in (b"\x00" * 32, b"\xff" * 32):
+        assert native.BatchFastAggregateVerify(items, seed=seed)
+        assert native.BatchFastAggregateVerify(items, seed=seed)
+
+
+def test_deferred_scope_bisects_to_first_culprit():
+    """Selector-level deferred scope: the AssertionError names the FIRST
+    failing entry in sequential call order (bisection over sub-batches)."""
+    from consensus_specs_tpu.crypto import bls
+
+    bls.use_native()
+    try:
+        msg = b"deferred"
+        sks = SKS[:3]
+        pks = [native.SkToPk(sk) for sk in sks]
+        good = native.Aggregate([native.Sign(sk, msg) for sk in sks])
+        bad = native.Sign(999, msg)
+
+        # all good -> clean exit
+        with bls.deferred_fast_aggregate_verify():
+            for _ in range(5):
+                assert bls.FastAggregateVerify(pks, msg, good)
+
+        # failures at 2 and 4 -> reported culprit is 2 (the first)
+        with pytest.raises(AssertionError, match=r"batch entry 2 of 6"):
+            with bls.deferred_fast_aggregate_verify():
+                for i in range(6):
+                    sig = bad if i in (2, 4) else good
+                    assert bls.FastAggregateVerify(pks, msg, sig)
+
+        # structural exception with a PRIOR bad signature: signature wins
+        # (sequential order: the bad signature was checked first)
+        with pytest.raises(AssertionError, match=r"batch entry 0 of 1"):
+            with bls.deferred_fast_aggregate_verify():
+                bls.FastAggregateVerify(pks, msg, bad)
+                raise IndexError("later structural failure")
+
+        # structural exception with all prior signatures good: propagates
+        with pytest.raises(IndexError):
+            with bls.deferred_fast_aggregate_verify():
+                bls.FastAggregateVerify(pks, msg, good)
+                raise IndexError("real structural failure")
+    finally:
+        bls.use_python()
+
+
+def test_deferred_scope_inactive_when_bls_off():
+    from consensus_specs_tpu.crypto import bls
+
+    bls.use_native()
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        with bls.deferred_fast_aggregate_verify() as scope:
+            assert bls.FastAggregateVerify([b"\x00" * 48], b"m", b"\x00" * 96)
+            assert scope.entries == []  # only_with_bls short-circuits first
+    finally:
+        bls.bls_active = was
+        bls.use_python()

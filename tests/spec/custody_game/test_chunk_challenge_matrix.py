@@ -1,0 +1,217 @@
+"""Chunk-challenge scenario matrix (reference suite:
+test/custody_game/block_processing/test_process_chunk_challenge.py —
+appended/replaced/duplicate/second/multi-epoch/off-chain/response
+variants), built on this repo's mock-genesis custody state and
+merkle_minimal proof machinery."""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.ssz.merkle_minimal import (
+    calc_merkle_tree_from_leaves,
+    get_merkle_proof,
+)
+from consensus_specs_tpu.testing.helpers.attestations import get_valid_attestation
+from consensus_specs_tpu.testing.helpers.state import next_slots
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    # structure under test; attestations are unsigned
+    old = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = old
+
+
+CHUNK_COUNT = 2
+
+
+def _chunked_transition(spec, fill: bytes):
+    """(shard_transition, chunks, tree, length_leaf) with a data root the
+    response proofs can open into."""
+    depth = int(spec.CUSTODY_RESPONSE_DEPTH)
+    chunk = spec.ByteVector[spec.BYTES_PER_CUSTODY_CHUNK](
+        fill * int(spec.BYTES_PER_CUSTODY_CHUNK))
+    leaves = [bytes(chunk.hash_tree_root())] * CHUNK_COUNT
+    tree = calc_merkle_tree_from_leaves(leaves, depth)
+    length_leaf = CHUNK_COUNT.to_bytes(32, "little")
+    data_root = spec.hash(tree[-1][0] + length_leaf)
+    shard_transition = spec.ShardTransition(
+        start_slot=1,
+        shard_block_lengths=[int(spec.BYTES_PER_CUSTODY_CHUNK) * CHUNK_COUNT],
+        shard_data_roots=[data_root],
+    )
+    return shard_transition, chunk, tree, length_leaf
+
+
+def _attested_challenge(spec, state, chunk_index=0, fill=b"\x07"):
+    """A fully consistent (attestation, challenge, chunk, tree, length_leaf)
+    bundle for the current state."""
+    shard_transition, chunk, tree, length_leaf = _chunked_transition(spec, fill)
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.shard_transition_root = spec.hash_tree_root(shard_transition)
+    responder = int(min(spec.get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits)))
+    challenge = spec.CustodyChunkChallenge(
+        responder_index=responder,
+        shard_transition=shard_transition,
+        attestation=attestation,
+        data_index=0,
+        chunk_index=chunk_index,
+    )
+    return challenge, chunk, tree, length_leaf
+
+
+def _response(spec, challenge_index, chunk_index, chunk, tree, length_leaf):
+    branch = get_merkle_proof(tree, chunk_index,
+                              int(spec.CUSTODY_RESPONSE_DEPTH)) + [length_leaf]
+    return spec.CustodyChunkResponse(
+        challenge_index=challenge_index,
+        chunk_index=chunk_index,
+        chunk=chunk,
+        branch=branch,
+    )
+
+
+def _ready(spec, state, extra_slots=0):
+    next_slots(spec, state,
+               int(spec.MIN_ATTESTATION_INCLUSION_DELAY) + 1 + extra_slots)
+
+
+def test_challenge_appended(spec, state):
+    _ready(spec, state)
+    challenge, *_ = _attested_challenge(spec, state)
+    spec.process_chunk_challenge(state, challenge)
+    record = state.custody_chunk_challenge_records[0]
+    assert int(record.responder_index) == int(challenge.responder_index)
+    assert int(record.chunk_index) == 0
+    assert int(state.custody_chunk_challenge_index) == 1
+
+
+def test_challenge_empty_element_replaced(spec, state):
+    """A cleared (all-default) record slot is reused before the list grows."""
+    _ready(spec, state)
+    state.custody_chunk_challenge_records.append(
+        spec.CustodyChunkChallengeRecord())  # an empty slot
+    challenge, *_ = _attested_challenge(spec, state)
+    spec.process_chunk_challenge(state, challenge)
+    assert len(state.custody_chunk_challenge_records) == 1  # replaced, not appended
+    assert int(state.custody_chunk_challenge_records[0].responder_index) == \
+        int(challenge.responder_index)
+
+
+def test_duplicate_challenge_rejected(spec, state):
+    _ready(spec, state)
+    challenge, *_ = _attested_challenge(spec, state)
+    spec.process_chunk_challenge(state, challenge)
+    with pytest.raises(AssertionError):
+        spec.process_chunk_challenge(state, challenge)
+
+
+def test_second_challenge_different_chunk(spec, state):
+    """Same attestation, different chunk index: both records must coexist."""
+    _ready(spec, state)
+    challenge0, *_ = _attested_challenge(spec, state, chunk_index=0)
+    spec.process_chunk_challenge(state, challenge0)
+    challenge1 = spec.CustodyChunkChallenge(
+        responder_index=challenge0.responder_index,
+        shard_transition=challenge0.shard_transition,
+        attestation=challenge0.attestation,
+        data_index=0,
+        chunk_index=1,
+    )
+    spec.process_chunk_challenge(state, challenge1)
+    records = state.custody_chunk_challenge_records
+    assert len(records) == 2
+    assert {int(r.chunk_index) for r in records} == {0, 1}
+    assert int(records[1].challenge_index) == 1
+
+
+def test_challenge_multiple_epochs_custody(spec, state):
+    """An attestation a few epochs old is still challengeable (the custody
+    window spans EPOCHS_PER_CUSTODY_PERIOD)."""
+    _ready(spec, state)
+    challenge, *_ = _attested_challenge(spec, state)
+    next_slots(spec, state, 3 * int(spec.SLOTS_PER_EPOCH))
+    spec.process_chunk_challenge(state, challenge)
+    assert int(state.custody_chunk_challenge_index) == 1
+
+
+def test_challenge_stale_attestation_rejected(spec, state):
+    """Beyond target.epoch + MAX_CHUNK_CHALLENGE_DELAY the attestation is
+    too old to challenge.  The clock is set directly (as the epoch suites
+    do) — transitioning through that many custody epochs would cascade the
+    reveal-deadline sweep first."""
+    _ready(spec, state)
+    challenge, *_ = _attested_challenge(spec, state)
+    horizon = int(spec.MAX_CHUNK_CHALLENGE_DELAY) + 2
+    state.slot = spec.Slot(horizon * int(spec.SLOTS_PER_EPOCH))
+    with pytest.raises(AssertionError):
+        spec.process_chunk_challenge(state, challenge)
+
+
+def test_off_chain_attestation_challengeable(spec, state):
+    """The challenge carries its own attestation — it need not have been
+    included in any block, only validate against the state."""
+    _ready(spec, state)
+    # never run process_attestation; straight to the challenge
+    challenge, *_ = _attested_challenge(spec, state)
+    spec.process_chunk_challenge(state, challenge)
+    assert int(state.custody_chunk_challenge_index) == 1
+
+
+def test_custody_response_chunk_index_0(spec, state):
+    """Response opening chunk 0 (the existing suite covers index 1)."""
+    _ready(spec, state)
+    challenge, chunk, tree, length_leaf = _attested_challenge(
+        spec, state, chunk_index=0, fill=b"\x09")
+    spec.process_chunk_challenge(state, challenge)
+    record = state.custody_chunk_challenge_records[0]
+    response = _response(spec, int(record.challenge_index), 0, chunk, tree,
+                         length_leaf)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    pre = int(state.balances[proposer])
+    spec.process_chunk_challenge_response(state, response)
+    assert int(state.balances[proposer]) > pre
+    assert bytes(state.custody_chunk_challenge_records[0].data_root) == b"\x00" * 32
+
+
+def test_custody_response_wrong_chunk_rejected(spec, state):
+    _ready(spec, state)
+    challenge, chunk, tree, length_leaf = _attested_challenge(spec, state)
+    spec.process_chunk_challenge(state, challenge)
+    record = state.custody_chunk_challenge_records[0]
+    bad_chunk = spec.ByteVector[spec.BYTES_PER_CUSTODY_CHUNK](
+        b"\x55" * int(spec.BYTES_PER_CUSTODY_CHUNK))
+    response = _response(spec, int(record.challenge_index), 0, bad_chunk,
+                         tree, length_leaf)
+    with pytest.raises(AssertionError):
+        spec.process_chunk_challenge_response(state, response)
+
+
+def test_custody_response_wrong_branch_rejected(spec, state):
+    _ready(spec, state)
+    challenge, chunk, tree, length_leaf = _attested_challenge(spec, state)
+    spec.process_chunk_challenge(state, challenge)
+    record = state.custody_chunk_challenge_records[0]
+    response = _response(spec, int(record.challenge_index), 0, chunk, tree,
+                         length_leaf)
+    tampered = list(response.branch)
+    tampered[0] = b"\xde" * 32
+    response.branch = tampered
+    with pytest.raises(AssertionError):
+        spec.process_chunk_challenge_response(state, response)
+
+
+def test_custody_response_multiple_epochs_later(spec, state):
+    """A response landing several epochs after the challenge, still before
+    the deadline, clears the record."""
+    _ready(spec, state)
+    challenge, chunk, tree, length_leaf = _attested_challenge(spec, state)
+    spec.process_chunk_challenge(state, challenge)
+    record = state.custody_chunk_challenge_records[0]
+    next_slots(spec, state, 2 * int(spec.SLOTS_PER_EPOCH))
+    response = _response(spec, int(record.challenge_index), 0, chunk, tree,
+                         length_leaf)
+    spec.process_chunk_challenge_response(state, response)
+    assert bytes(state.custody_chunk_challenge_records[0].data_root) == b"\x00" * 32

@@ -73,3 +73,66 @@ def test_final_updates_releases_fully_revealed(spec, state):
     spec.process_custody_final_updates(state)
     # no challenge records, all secrets revealed: withdrawal stands
     assert int(state.validators[6].withdrawable_epoch) == current + 7
+
+
+def test_final_updates_suspends_withdrawal_under_open_challenge(spec, state):
+    """An exited responder with an OPEN chunk-challenge record must have its
+    withdrawal suspended (reference scenario:
+    test_validator_withdrawal_suspend_after_chunk_challenge)."""
+    current = int(spec.get_current_epoch(state))
+    responder = 3
+    validator = state.validators[responder]
+    validator.exit_epoch = spec.Epoch(current)
+    validator.withdrawable_epoch = spec.Epoch(current + 4)
+    validator.all_custody_secrets_revealed_epoch = spec.Epoch(current)
+    spec.replace_empty_or_append(
+        state.custody_chunk_challenge_records,
+        spec.CustodyChunkChallengeRecord(
+            challenge_index=7,
+            challenger_index=1,
+            responder_index=responder,
+            inclusion_epoch=spec.Epoch(current),
+            data_root=b"\x42" * 32,
+            chunk_index=0,
+        ))
+    spec.process_custody_final_updates(state)
+    assert int(state.validators[responder].withdrawable_epoch) == \
+        int(spec.FAR_FUTURE_EPOCH)
+
+
+def test_final_updates_resume_after_challenge_response(spec, state):
+    """Once the record is cleared (answered) and all secrets are revealed,
+    the next sweep re-enables withdrawal at revealed_epoch + delay
+    (reference scenario:
+    test_validator_withdrawal_resume_after_chunk_challenge_response)."""
+    current = int(spec.get_current_epoch(state))
+    responder = 3
+    validator = state.validators[responder]
+    validator.exit_epoch = spec.Epoch(current)
+    validator.all_custody_secrets_revealed_epoch = spec.Epoch(current)
+    validator.withdrawable_epoch = spec.FAR_FUTURE_EPOCH  # suspended earlier
+    # an empty (cleared) record only
+    spec.replace_empty_or_append(
+        state.custody_chunk_challenge_records,
+        spec.CustodyChunkChallengeRecord())
+    spec.process_custody_final_updates(state)
+    assert int(state.validators[responder].withdrawable_epoch) == \
+        current + int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+
+def test_final_updates_reenable_after_custody_reveal(spec, state):
+    """A withdrawal delayed for unrevealed secrets resumes once
+    all_custody_secrets_revealed_epoch is set (reference scenario:
+    test_validator_withdrawal_reenable_after_custody_reveal)."""
+    current = int(spec.get_current_epoch(state))
+    validator = state.validators[5]
+    validator.exit_epoch = spec.Epoch(current)
+    validator.withdrawable_epoch = spec.FAR_FUTURE_EPOCH
+    spec.process_custody_final_updates(state)  # still unrevealed: stays FAR
+    assert int(state.validators[5].withdrawable_epoch) == \
+        int(spec.FAR_FUTURE_EPOCH)
+    validator = state.validators[5]
+    validator.all_custody_secrets_revealed_epoch = spec.Epoch(current)
+    spec.process_custody_final_updates(state)
+    assert int(state.validators[5].withdrawable_epoch) == \
+        current + int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)

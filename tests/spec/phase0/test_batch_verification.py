@@ -1,0 +1,100 @@
+"""Differential tests for the deferred (batched) block signature path.
+
+The sanctioned substitution wraps ``process_block`` in
+``bls.deferred_fast_aggregate_verify`` (specs/builder.py), collapsing a
+block's aggregate checks into one RLC pairing product with a single final
+exponentiation.  These tests pin the substitution to the sequential spec
+path: identical post-states on valid blocks, identical rejection (with the
+first failing check attributed) on invalid ones.  Reference analogue for
+the substitution pattern: setup.py:488-492.
+"""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testing.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from consensus_specs_tpu.testing.helpers.attestations import get_valid_attestation
+from consensus_specs_tpu.testing.helpers.block import build_empty_block
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+
+
+def _block_with_attestations(spec, state, n_atts=2, tamper_index=None):
+    next_epoch(spec, state)
+    block = build_empty_block(
+        spec, state, state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    for i in range(n_atts):
+        att = get_valid_attestation(spec, state, index=i, signed=True)
+        if tamper_index is not None and i == tamper_index:
+            att.signature = spec.BLSSignature(b"\x11" + bytes(att.signature)[1:])
+        block.body.attestations.append(att)
+    return block
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_batched_block_equals_sequential(spec, state):
+    """Valid attestation-bearing block: the deferred path and the sequential
+    (__wrapped__) path must produce byte-identical post-states."""
+    seq_state = state.copy()
+
+    block = _block_with_attestations(spec, state, n_atts=2)
+    seq_block = block.copy()
+
+    signed = state_transition_and_sign_block(spec, state, block)
+
+    # replay through the unwrapped sequential process_block
+    batched = spec.process_block
+    assert hasattr(batched, "__wrapped__"), "substitution must be installed"
+    spec.process_block = batched.__wrapped__
+    try:
+        seq_signed = state_transition_and_sign_block(spec, seq_state, seq_block)
+    finally:
+        spec.process_block = batched
+
+    assert signed.hash_tree_root() == seq_signed.hash_tree_root()
+    assert state.hash_tree_root() == seq_state.hash_tree_root()
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_batched_block_rejects_bad_signature(spec, state):
+    """One tampered attestation signature: state_transition must reject the
+    block (AssertionError) through the deferred path."""
+    block = _block_with_attestations(spec, state, n_atts=2, tamper_index=1)
+    with pytest.raises(AssertionError):
+        state_transition_and_sign_block(spec, state, block)
+    yield "post", None
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@always_bls
+def test_deferred_scope_collects_block_checks(spec, state):
+    """The substitution actually engages: FastAggregateVerify calls made
+    during process_block are deferred, verified once as a batch."""
+    calls = []
+    orig_batch = bls._batch_verify
+
+    def counting_batch(entries):
+        calls.append(len(entries))
+        return orig_batch(entries)
+
+    block = _block_with_attestations(spec, state, n_atts=2)
+    bls._batch_verify = counting_batch
+    try:
+        state_transition_and_sign_block(spec, state, block)
+    finally:
+        bls._batch_verify = orig_batch
+
+    assert calls == [2], f"expected one batch of 2 attestation checks, got {calls}"
+    yield "post", state

@@ -1,0 +1,210 @@
+"""Shard blob header / proposer-slashing processing sanity (reference
+capability: the sharding fork's operation surface, sharding/beacon-chain.md
+process_shard_header + process_shard_proposer_slashing).  Uses an
+empty-commitment blob (samples_count=0), whose degree proof is the setup's
+own first G1 point — so the full signature + fee + pending-list pipeline
+runs with real BLS but no polynomial work."""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.crypto.bls.curve import g1_to_bytes
+from consensus_specs_tpu.specs.builder import get_spec
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testing.helpers.keys import privkeys, pubkeys
+from consensus_specs_tpu.testing.helpers.state import next_slots
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("sharding", "minimal")
+
+
+def _seed_pending(spec, state, slot, shard):
+    """Install the PENDING shard-work entry with the dummy empty header, the
+    way reset_pending_shard_work initializes a committee-backed shard."""
+    buffer_index = int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)
+    active = int(spec.get_active_shard_count(
+        state, spec.compute_epoch_at_slot(spec.Slot(slot))))
+    row = state.shard_buffer[buffer_index]
+    while len(row) < active:
+        row.append(spec.ShardWork())
+    index = spec.compute_committee_index_from_shard(
+        state, spec.Slot(slot), spec.Shard(shard))
+    committee_length = len(spec.get_beacon_committee(
+        state, spec.Slot(slot), index))
+    state.shard_buffer[buffer_index][shard].status.change(
+        selector=spec.SHARD_WORK_PENDING,
+        value=spec.List[spec.PendingShardHeader,
+                        spec.MAX_SHARD_HEADERS_PER_SHARD]([
+            spec.PendingShardHeader(
+                attested=spec.AttestedDataCommitment(),
+                votes=spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](
+                    [0] * committee_length),
+                weight=0,
+                update_slot=slot,
+            )
+        ]),
+    )
+
+
+@pytest.fixture()
+def state(spec):
+    old = bls.bls_active
+    bls.bls_active = False
+    st = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 32, spec.MAX_EFFECTIVE_BALANCE)
+    # one funded blob builder (key index 40 — outside the validator range)
+    st.blob_builders.append(spec.Builder(pubkey=pubkeys[40]))
+    st.blob_builder_balances.append(spec.Gwei(10**9))
+    st.shard_sample_price = 8
+    next_slots(spec, st, 1)
+    # the committee-backed shard for the current slot is the start shard
+    _seed_pending(spec, st, int(st.slot),
+                  int(spec.get_start_shard(st, st.slot)))
+    bls.bls_active = old
+    return st
+
+
+BUILDER_SK_INDEX = 40
+
+
+def _empty_commitment_header(spec, state, slot=None, shard=None):
+    """A SignedShardBlobHeader over an empty blob, co-signed builder+proposer."""
+    g1_setup, _ = spec._kzg_setups()
+    slot = int(state.slot) if slot is None else slot
+    if shard is None:
+        shard = int(spec.get_start_shard(state, spec.Slot(slot)))
+    proposer = int(spec.get_shard_proposer_index(
+        state, spec.Slot(slot), spec.Shard(shard)))
+    header = spec.ShardBlobHeader(
+        slot=slot,
+        shard=shard,
+        body_summary=spec.ShardBlobBodySummary(
+            commitment=spec.DataCommitment(
+                point=g1_to_bytes(g1_setup[0]), samples_count=0),
+            degree_proof=g1_to_bytes(g1_setup[0]),
+            max_fee_per_sample=16,
+            max_priority_fee_per_sample=2,
+        ),
+        proposer_index=proposer,
+        builder_index=0,
+    )
+    root = spec.compute_signing_root(
+        header, spec.get_domain(state, spec.DOMAIN_SHARD_BLOB))
+    sig = bls.Aggregate([
+        bls.Sign(privkeys[BUILDER_SK_INDEX], root),
+        bls.Sign(privkeys[proposer], root),
+    ])
+    return spec.SignedShardBlobHeader(message=header, signature=sig)
+
+
+@pytest.fixture(autouse=True)
+def _bls_on():
+    old = bls.bls_active
+    bls.bls_active = True
+    yield
+    bls.bls_active = old
+
+
+def test_shard_header_accepted_and_pending(spec, state):
+    signed = _empty_commitment_header(spec, state)
+    header = signed.message
+    pre_builder = int(state.blob_builder_balances[0])
+    spec.process_shard_header(state, signed)
+    # empty blob: zero samples, zero fees charged
+    assert int(state.blob_builder_balances[0]) == pre_builder
+    work = state.shard_buffer[
+        int(header.slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)][int(header.shard)]
+    pending = work.status.value
+    # the dummy "empty" header from initialization plus the new one
+    assert len(pending) == 2
+    assert bytes(pending[1].attested.root) == bytes(spec.hash_tree_root(header))
+    assert int(pending[1].weight) == 0
+
+
+def test_shard_header_duplicate_rejected(spec, state):
+    signed = _empty_commitment_header(spec, state)
+    spec.process_shard_header(state, signed)
+    with pytest.raises(AssertionError):
+        spec.process_shard_header(state, signed)
+
+
+def test_shard_header_wrong_proposer_rejected(spec, state):
+    signed = _empty_commitment_header(spec, state)
+    wrong = (int(signed.message.proposer_index) + 1) % 32
+    signed.message.proposer_index = wrong
+    with pytest.raises(AssertionError):
+        spec.process_shard_header(state, signed)
+
+
+def test_shard_header_bad_signature_rejected(spec, state):
+    signed = _empty_commitment_header(spec, state)
+    signed.signature = spec.BLSSignature(
+        b"\x11" + bytes(signed.signature)[1:])
+    with pytest.raises(AssertionError):
+        spec.process_shard_header(state, signed)
+
+
+def test_shard_header_future_slot_rejected(spec, state):
+    signed = _empty_commitment_header(spec, state, slot=int(state.slot) + 1)
+    with pytest.raises(AssertionError):
+        spec.process_shard_header(state, signed)
+
+
+def test_shard_header_invalid_shard_rejected(spec, state):
+    active = int(spec.get_active_shard_count(
+        state, spec.get_current_epoch(state)))
+    signed = _empty_commitment_header(spec, state)
+    signed.message.shard = active  # out of range
+    with pytest.raises(AssertionError):
+        spec.process_shard_header(state, signed)
+
+
+def _proposer_slashing(spec, state, same_reference=False):
+    slot = spec.Slot(int(state.slot))
+    shard = spec.Shard(0)
+    proposer = int(spec.get_shard_proposer_index(state, slot, shard))
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SHARD_PROPOSER, spec.compute_epoch_at_slot(slot))
+
+    def signed_ref(body_root):
+        ref = spec.ShardBlobReference(
+            slot=slot, shard=shard, proposer_index=proposer,
+            builder_index=0, body_root=body_root)
+        root = spec.compute_signing_root(ref, domain)
+        return bls.Aggregate([
+            bls.Sign(privkeys[BUILDER_SK_INDEX], root),
+            bls.Sign(privkeys[proposer], root),
+        ])
+
+    root_1 = b"\x01" * 32
+    root_2 = root_1 if same_reference else b"\x02" * 32
+    return spec.ShardProposerSlashing(
+        slot=slot, shard=shard, proposer_index=proposer,
+        builder_index_1=0, builder_index_2=0,
+        body_root_1=root_1, body_root_2=root_2,
+        signature_1=signed_ref(root_1),
+        signature_2=signed_ref(root_2),
+    )
+
+
+def test_shard_proposer_slashing(spec, state):
+    slashing = _proposer_slashing(spec, state)
+    proposer = int(slashing.proposer_index)
+    assert not state.validators[proposer].slashed
+    spec.process_shard_proposer_slashing(state, slashing)
+    assert state.validators[proposer].slashed
+
+
+def test_shard_proposer_slashing_same_reference_rejected(spec, state):
+    slashing = _proposer_slashing(spec, state, same_reference=True)
+    with pytest.raises(AssertionError):
+        spec.process_shard_proposer_slashing(state, slashing)
+
+
+def test_shard_proposer_slashing_bad_signature_rejected(spec, state):
+    slashing = _proposer_slashing(spec, state)
+    slashing.signature_2 = spec.BLSSignature(
+        b"\x11" + bytes(slashing.signature_2)[1:])
+    with pytest.raises(AssertionError):
+        spec.process_shard_proposer_slashing(state, slashing)

@@ -1,0 +1,79 @@
+"""Start-shard / committee-index algebra unittests (reference suite:
+test/sharding/unittests/test_get_start_shard.py; this spec snapshot's
+``get_start_shard`` is the closed-form committee_count*slot formula from
+the vendored sharding/beacon-chain.md, so the scenarios cover the same
+surface — current/next/previous slot and far epochs — against it)."""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.specs.builder import get_spec
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("sharding", "minimal")
+
+
+@pytest.fixture()
+def state(spec):
+    old = bls.bls_active
+    bls.bls_active = False
+    st = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 32, spec.MAX_EFFECTIVE_BALANCE)
+    bls.bls_active = old
+    return st
+
+
+def _expected(spec, state, slot):
+    epoch = spec.compute_epoch_at_slot(spec.Slot(slot))
+    committees = int(spec.get_committee_count_per_slot(state, epoch))
+    active = int(spec.get_active_shard_count(state, epoch))
+    return committees * slot % active
+
+
+def test_start_shard_current_slot(spec, state):
+    slot = int(state.slot)
+    got = int(spec.get_start_shard(state, spec.Slot(slot)))
+    assert got == _expected(spec, state, slot)
+    assert got < int(spec.get_active_shard_count(
+        state, spec.get_current_epoch(state)))
+
+
+def test_start_shard_next_and_previous_slot(spec, state):
+    state.slot = spec.Slot(int(spec.SLOTS_PER_EPOCH) * 3)
+    for delta in (-1, 0, 1):
+        slot = int(state.slot) + delta
+        assert int(spec.get_start_shard(state, spec.Slot(slot))) == \
+            _expected(spec, state, slot)
+
+
+def test_start_shard_far_future_epoch_slot(spec, state):
+    slot = int(spec.SLOTS_PER_EPOCH) * 128 + 3
+    assert int(spec.get_start_shard(state, spec.Slot(slot))) == \
+        _expected(spec, state, slot)
+
+
+def test_shard_from_committee_index_consistent_with_start_shard(spec, state):
+    state.slot = spec.Slot(int(spec.SLOTS_PER_EPOCH) * 2 + 5)
+    slot = spec.Slot(int(state.slot))
+    epoch = spec.compute_epoch_at_slot(slot)
+    active = int(spec.get_active_shard_count(state, epoch))
+    start = int(spec.get_start_shard(state, slot))
+    committees = int(spec.get_committee_count_per_slot(state, epoch))
+    for index in range(committees):
+        shard = int(spec.compute_shard_from_committee_index(
+            state, slot, spec.CommitteeIndex(index)))
+        assert shard == (index + start) % active
+        back = int(spec.compute_committee_index_from_shard(
+            state, slot, spec.Shard(shard)))
+        assert back == index
+
+
+def test_shard_index_out_of_range_rejected(spec, state):
+    slot = spec.Slot(int(state.slot))
+    epoch = spec.compute_epoch_at_slot(slot)
+    active = int(spec.get_active_shard_count(state, epoch))
+    with pytest.raises(AssertionError):
+        spec.compute_shard_from_committee_index(
+            state, slot, spec.CommitteeIndex(active))

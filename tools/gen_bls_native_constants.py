@@ -105,6 +105,72 @@ for _ in range(4):
     assert frob_apply(f, FROB2, conj=False) == f.pow(P * P), "frob2 mismatch"
     assert frob_apply(f, FROB1, conj=True) == f.pow(P), "frob1 mismatch"
 
+# --- psi endomorphism (untwist-Frobenius-twist) on the G2 twist -------------
+# psi(x, y) = (PSI_CX * conj(x), PSI_CY * conj(y)); psi2(x, y) = (PSI2_CX*x, -y).
+# Used for fast cofactor clearing (RFC 9380 G.3: equivalent to [h_eff]) and
+# the Scott subgroup test psi(P) == [x]P (p ≡ x mod r for BLS curves).
+PSI_CX = XI.pow((P - 1) // 3).inv()
+PSI_CY = XI.pow((P - 1) // 2).inv()
+PSI2_CX = PSI_CX * PSI_CX.conjugate()
+PSI2_CY = PSI_CY * PSI_CY.conjugate()
+assert PSI2_CX.c1 == 0, "psi^2 x-coefficient must be in Fq"
+assert PSI2_CY == Fq2(P - 1, 0), "psi^2 y-coefficient must be -1"
+
+# validate psi against the oracle curve: fast cofactor clearing == [h_eff],
+# and the eigenvalue relation psi(Q) == [x]Q on the r-order subgroup
+from consensus_specs_tpu.crypto.bls.curve import Point, g2_generator  # noqa: E402
+
+_B2 = Fq2(4, 4)
+
+
+def _psi_affine(pt: Point):
+    aff = pt.to_affine()
+    x, y = aff
+    return Point(PSI_CX * x.conjugate(), PSI_CY * y.conjugate(), Fq2.one(), _B2)
+
+
+def _psi2_affine(pt: Point):
+    aff = pt.to_affine()
+    x, y = aff
+    return Point(PSI2_CX * x, -y, Fq2.one(), _B2)
+
+
+def _smul(pt: Point, k: int) -> Point:
+    return -pt.mul(-k) if k < 0 else pt.mul(k)
+
+
+def _random_g2_curve_point(rng) -> Point:
+    """Random point on E2 (full curve, overwhelmingly NOT in the r-subgroup)."""
+    while True:
+        x = Fq2(rng.randrange(P), rng.randrange(P))
+        y2 = x.square() * x + _B2
+        y = y2.sqrt()
+        if y is not None:
+            return Point(x, y, Fq2.one(), _B2)
+
+
+for _ in range(2):
+    W = _random_g2_curve_point(rng)
+    # Budroni-Pintore fast clearing: (x^2-x-1)P + (x-1)psi(P) + psi2(2P)
+    fast = (
+        _smul(W, X_PARAM * X_PARAM - X_PARAM - 1)
+        + _smul(_psi_affine(W), X_PARAM - 1)
+        + _psi2_affine(W.double())
+    )
+    assert fast == W.mul(H_EFF_G2), "psi cofactor clearing != [h_eff]"
+    assert _psi_affine(W) != _smul(W, X_PARAM % R), "subgroup test must reject"
+
+Q = g2_generator().mul(rng.randrange(1, R))
+assert _psi_affine(Q) == _smul(Q, X_PARAM % R), "psi eigenvalue != x on G2"
+
+# fast final exponentiation identity (Hayashida-Hayasaka-Teruya):
+# the cheap cyclotomic chain computes m^(3*HARD_EXP); 3 is coprime to r so
+# f^(3d) == 1  <=>  f^d == 1, which is all verification needs.
+assert (
+    (X_PARAM - 1) ** 2 * (X_PARAM + P) * (X_PARAM**2 + P * P - 1) + 3
+    == 3 * HARD_EXP
+), "HHT hard-part decomposition identity failed"
+
 # --- SHA-256 round constants, derived integer-exactly and self-tested ------
 
 
@@ -218,6 +284,11 @@ for k in range(6):
     parts.append(c_limbs(f"FROB2_G{k}", FROB2[k].c0))
 for k in range(6):
     parts.append(c_fq2(f"FROB1_G{k}", FROB1[k]))
+parts.append("")
+
+parts.append(c_fq2("PSI_CX", PSI_CX))
+parts.append(c_fq2("PSI_CY", PSI_CY))
+parts.append(c_limbs("PSI2_CX", PSI2_CX.c0))
 parts.append("")
 
 parts.append(
