@@ -253,6 +253,10 @@ def BatchFastAggregateVerify(items, seed: bytes = None) -> bool:
         sigs.append(sig)
     if seed is None:
         seed = os.urandom(32)
+    elif len(seed) != 32:
+        # the C DRBG unconditionally reads 32 bytes; fail fast rather than
+        # hand it a short buffer
+        raise ValueError(f"seed must be exactly 32 bytes, got {len(seed)}")
     k = len(triples)
     return bool(_lib.bls_batch_fast_aggregate_verify_affine(
         k,

@@ -1,0 +1,181 @@
+"""MXU probe for device Fq multiplication: int8 limb products as matmuls.
+
+Round 3's LIMB_PROBE measured the VPU integer-emulation ceiling at ~78k
+Fq muls/s (26-bit limbs in int64 lanes) and named the MXU int8 route as
+"the only plausible route... not attempted".  This module is that attempt
+(round-4 VERDICT item 3).
+
+Design.  Radix 2^6, 64 limbs (384 bits >= 381): every normalized digit is
+0..63 and every REDC input digit stays < 2^7, so all matmul INPUTS fit
+signed int8 — the MXU's native integer format — while products accumulate
+in int32 (64 * 2^12 = 2^18 per diagonal, far inside int32).
+
+A Montgomery multiply t = a*b*R^-1 decomposes into three multiplies:
+
+  1. t   = a (*) b         — per-lane convolution; both sides vary per
+                             batch element, so the MXU's shared-operand
+                             shape does not apply.  Phrased as an im2col
+                             batched contraction einsum('ni,nik->nk').
+  2. m   = t_low * N0INV   — multiplication by a CONSTANT (the inverse of
+     (mod R)                 -p^-1 mod R): a fixed lower-triangular
+                             Toeplitz matrix.  TRUE MXU MATMUL
+                             [N,64] x [64,64] int8 -> int32.
+  3. t  += m * P           — multiplication by the CONSTANT modulus:
+                             fixed Toeplitz [N,64] x [64,129] int8 ->
+                             int32.  TRUE MXU MATMUL.
+
+So 2 of the 3 multiplies in REDC are perfectly MXU-shaped; the probe
+measures whether that + the unavoidable per-lane conv beats the 78k/s
+VPU ceiling.  Carry normalization between steps is lazy split-and-add
+(3 passes bound digits back under 2^7), vectorized across lanes.
+
+Correctness is pinned to python ints in tests/test_mxu_probe.py; the
+hardware race lives in tools/limb_probe_bench.py --mxu and lands in
+LIMB_PROBE.json next to the earlier radix measurements.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from consensus_specs_tpu import _jaxcache
+
+from .limbs import P_INT
+
+_jaxcache.configure()
+
+B = 6                      # bits per limb
+N = 64                     # limbs: 64 * 6 = 384 bits
+MASK = (1 << B) - 1
+R_BITS = N * B             # 384
+R_INT = (1 << R_BITS) % P_INT
+N0INV = (-pow(P_INT, -1, 1 << R_BITS)) % (1 << R_BITS)  # -p^-1 mod R
+
+
+def int_to_digits(x: int, n: int = N) -> np.ndarray:
+    assert 0 <= x < (1 << (B * n))
+    return np.array([(x >> (B * i)) & MASK for i in range(n)], dtype=np.int32)
+
+
+def digits_to_int(d) -> int:
+    arr = np.asarray(d)
+    return int(sum(int(arr[..., i]) << (B * i) for i in range(arr.shape[-1])))
+
+
+def _toeplitz_for_constant(c_digits: np.ndarray, out_limbs: int) -> np.ndarray:
+    """T with T[i, k] = c[k - i]: right-multiplying a digit row-vector by T
+    is multiplication by the constant, unnormalized digits out."""
+    n = len(c_digits)
+    T = np.zeros((n, out_limbs), dtype=np.int8)
+    for i in range(n):
+        for j in range(n):
+            if i + j < out_limbs:
+                T[i, i + j] = c_digits[j]
+    return T
+
+
+_P_DIGITS = int_to_digits(P_INT)
+_N0_DIGITS = int_to_digits(N0INV)
+# m*P spills one limb past 2N? m < R, P < R: m*P < R^2 -> 2N limbs.
+_T_P = jnp.asarray(_toeplitz_for_constant(_P_DIGITS, 2 * N), dtype=jnp.int8)
+# m = (t_low * n0inv) mod R: only the low N output limbs matter.
+_T_N0 = jnp.asarray(_toeplitz_for_constant(_N0_DIGITS, N), dtype=jnp.int8)
+
+
+def _normalize(d, passes: int = 3, width: int | None = None):
+    """Lazy carry normalization: split digits into (low, carry), add the
+    carry one limb up.  Each pass shrinks digit magnitude ~2^B; ``passes``
+    = 3 takes the conv-output bound 2^18 below 2^7 (int8-safe, possibly
+    denormal by one bit — fine for matmul inputs, exact for comparisons
+    after a full propagate)."""
+    for _ in range(passes):
+        lo = d & MASK
+        carry = d >> B
+        d = lo + jnp.pad(carry, [(0, 0)] * (d.ndim - 1) + [(1, 0)])[..., :d.shape[-1]]
+    if width is not None:
+        d = d[..., :width]
+    return d
+
+
+def _conv_ab(a, b):
+    """Per-lane limb convolution c[n,k] = sum_i a[n,i] b[n,k-i] via im2col:
+    gather shifted copies of b and contract over the limb axis.  The one
+    multiply the MXU's shared-operand shape cannot absorb."""
+    n = a.shape[-1]
+    out = 2 * n
+    idx_k = jnp.arange(out)[None, :]            # [1, out]
+    idx_i = jnp.arange(n)[:, None]              # [n, 1]
+    gather = idx_k - idx_i                      # [n, out]
+    valid = (gather >= 0) & (gather < n)
+    gather = jnp.where(valid, gather, 0)
+    # advanced indexing on the last axis: b[..., gather] -> [batch, n, out]
+    shifted = jnp.where(valid, b[..., gather], 0)
+    return jnp.einsum("ni,nik->nk", a.astype(jnp.int32),
+                      shifted.astype(jnp.int32))
+
+
+def _propagate_exact(d):
+    """Exact carry propagation over the limb axis (lax.scan): digits out
+    are canonical 0..63 plus a final carry limb.  One 2N-step scan per
+    multiply — the serial tail the MXU phrasing cannot remove."""
+    d_t = jnp.moveaxis(d, -1, 0)                 # [limbs, batch...]
+
+    def step(carry, limb):
+        v = limb + carry
+        return v >> B, v & MASK
+
+    final, digits = jax.lax.scan(step, jnp.zeros_like(d_t[0]), d_t)
+    out = jnp.moveaxis(digits, 0, -1)
+    return out, final
+
+
+def mxu_mont_mul(a, b):
+    """Montgomery multiply over [..., 64] 6-bit digit arrays: returns
+    canonical-digit a*b*R^-1 (value < 2p — same lazy convention as the
+    other probe radices; canonicalized on download)."""
+    a = a.astype(jnp.int8)
+    b = b.astype(jnp.int8)
+    # 1. per-lane product (im2col conv), normalize into int8 range
+    t = _conv_ab(a, b)                           # [..., 128] int32
+    t_norm = _normalize(t, passes=3)
+    t_low = t_norm[..., :N].astype(jnp.int8)
+    # 2. m = t_low * N0INV mod R — FIXED matmul on the MXU.
+    # NOTE t_low's lazy digits may exceed canonical 0..63 by the deferred
+    # carries; that is fine: m only needs to be ≡ t*n0inv mod R given the
+    # digits PRESENTED, and step 3 uses the same presented digits.
+    m = jax.lax.dot_general(
+        t_low, _T_N0, (((t_low.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    m_digits, _ = _propagate_exact(m)            # exact mod R: drop carry
+    m8 = m_digits.astype(jnp.int8)
+    # 3. t + m*P — FIXED matmul on the MXU
+    mp = jax.lax.dot_general(
+        m8, _T_P, (((m8.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    full = t_norm + mp
+    digits, _final = _propagate_exact(full)      # low half becomes zeros
+    # With canonical-digit inputs in the < 2p class and R = 2^384 > 4p,
+    # the result t/R < (4p^2 + Rp)/R < 2p < R: the scan's outgoing carry
+    # is provably zero and the < 2p class is closed under chaining.
+    return digits[..., N:]
+
+
+_jit_mxu_mul = jax.jit(mxu_mont_mul)
+
+
+def host_to_mont(x: int) -> np.ndarray:
+    return int_to_digits(x * R_INT % P_INT)
+
+
+def host_from_mont(d) -> int:
+    return digits_to_int(np.asarray(d)) * pow(R_INT, -1, P_INT) % P_INT
+
+
+def mxu_mul_ints(x: int, y: int) -> int:
+    """End-to-end x*y mod p through the device path (test hook)."""
+    a = jnp.asarray(host_to_mont(x)[None], dtype=jnp.int8)
+    b = jnp.asarray(host_to_mont(y)[None], dtype=jnp.int8)
+    out = np.asarray(_jit_mxu_mul(a, b))[0]
+    return host_from_mont(out) % P_INT

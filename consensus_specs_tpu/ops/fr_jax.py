@@ -257,10 +257,19 @@ def sharded_ntt(values: Sequence[int], mesh, axis_name: str = None) -> List[int]
         _shard_body, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
         out_specs=P(axis_name))
-    out = np.asarray(jax.jit(fn)(
+    out_arr = jax.jit(fn)(
         jax.device_put(jnp.asarray(rows), spec_sharded),
         jax.device_put(jnp.asarray(tw), spec_sharded),
-        jax.device_put(jnp.asarray(comb), spec_sharded)))
+        jax.device_put(jnp.asarray(comb), spec_sharded))
+    if jax.process_count() > 1:
+        # the sharded output spans processes (a DCN mesh): gather the
+        # small result rows instead of materializing non-addressable shards
+        from jax.experimental import multihost_utils
+
+        out = np.asarray(multihost_utils.process_allgather(
+            out_arr, tiled=True))
+    else:
+        out = np.asarray(out_arr)
 
     result = [0] * n
     for k2 in range(d):

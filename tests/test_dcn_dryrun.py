@@ -1,0 +1,34 @@
+"""CI hook for the 2-process jax.distributed dryrun (tools/dcn_dryrun.py):
+the sharded epoch/merkle/NTT programs over a mesh spanning two OS
+processes, cross-checked bit-for-bit (round-4 capability; design in
+docs/multihost.md)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_two_process_distributed_dryrun():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dcn_dryrun.py")],
+        capture_output=True, text=True, timeout=900,
+        env={k: v for k, v in os.environ.items()
+             if "xla_force_host_platform_device_count" not in v.lower()
+             or k != "XLA_FLAGS"},
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(
+        open(os.path.join(REPO, "DCN_DRYRUN.json")).read())
+    assert report["ok"]
+    assert report["n_processes"] == 2
+    assert report["checks"] == {
+        "epoch_step_bitexact": True,
+        "merkle_root_matches_ssz": True,
+        "das_ntt_matches_host_oracle": True,
+    }

@@ -1,0 +1,55 @@
+"""Correctness pin for the MXU int8 limb-mul probe (ops/bls_jax/mxu_probe):
+digit codecs, single multiplies, and chained multiplies against python
+ints.  The hardware race itself lives in tools/limb_probe_bench.py --mxu."""
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from consensus_specs_tpu.ops.bls_jax import mxu_probe as mp  # noqa: E402
+from consensus_specs_tpu.ops.bls_jax.limbs import P_INT  # noqa: E402
+
+rng = random.Random(777)
+
+
+def test_digit_codec_roundtrip():
+    for _ in range(20):
+        x = rng.randrange(P_INT)
+        assert mp.digits_to_int(mp.int_to_digits(x)) == x
+
+
+def test_single_muls_match_python():
+    cases = [(1, 1), (P_INT - 1, P_INT - 1), (2, P_INT - 1),
+             (0, 12345), (1 << 380, (1 << 379) + 17)]
+    cases += [(rng.randrange(P_INT), rng.randrange(P_INT)) for _ in range(10)]
+    for x, y in cases:
+        assert mp.mxu_mul_ints(x, y) == x * y % P_INT
+
+
+def test_batched_muls_match_python():
+    n = 64
+    xs = [rng.randrange(P_INT) for _ in range(n)]
+    ys = [rng.randrange(P_INT) for _ in range(n)]
+    a = jnp.asarray(np.stack([mp.host_to_mont(x) for x in xs]), dtype=jnp.int8)
+    b = jnp.asarray(np.stack([mp.host_to_mont(y) for y in ys]), dtype=jnp.int8)
+    out = np.asarray(mp._jit_mxu_mul(a, b))
+    for i in range(n):
+        assert mp.host_from_mont(out[i]) % P_INT == xs[i] * ys[i] % P_INT
+
+
+def test_chained_muls_stay_canonical():
+    """Chaining (the Miller-loop access pattern): outputs feed back as
+    inputs; digits must stay int8-canonical and values correct."""
+    x = rng.randrange(P_INT)
+    a = jnp.asarray(mp.host_to_mont(x)[None], dtype=jnp.int8)
+    acc = a
+    expect = x
+    for _ in range(6):
+        acc = mp._jit_mxu_mul(acc, a)
+        expect = expect * x % P_INT
+        arr = np.asarray(acc)
+        assert arr.max() <= mp.MASK, "digits left canonical range"
+        assert mp.host_from_mont(arr[0]) % P_INT == expect
