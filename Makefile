@@ -23,6 +23,16 @@ test-mainnet:
 bench:
 	python bench.py
 
+# race the device Fq-multiply radices (int64 VPU / int32 VPU / int8 MXU)
+# on the attached chip; writes LIMB_PROBE.json
+limb-probe:
+	python tools/limb_probe_bench.py
+
+# 2-process jax.distributed dryrun: sharded epoch/merkle/NTT over a mesh
+# spanning two OS processes, bit-exact cross-checks; writes DCN_DRYRUN.json
+dcn-dryrun:
+	python tools/dcn_dryrun.py
+
 lint:
 	python tools/lint.py
 
@@ -44,4 +54,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench lint consume mdspec gen-all $(addprefix gen-,$(GENERATORS))
+.PHONY: test test-par test-fast test-mainnet bench limb-probe dcn-dryrun lint consume mdspec gen-all $(addprefix gen-,$(GENERATORS))
