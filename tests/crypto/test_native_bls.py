@@ -254,6 +254,7 @@ def test_deferred_scope_bisects_to_first_culprit():
     failing entry in sequential call order (bisection over sub-batches)."""
     from consensus_specs_tpu.crypto import bls
 
+    prev = bls.backend_name()
     bls.use_native()
     try:
         msg = b"deferred"
@@ -287,12 +288,15 @@ def test_deferred_scope_bisects_to_first_culprit():
                 bls.FastAggregateVerify(pks, msg, good)
                 raise IndexError("real structural failure")
     finally:
-        bls.use_python()
+        # restore the PREVIOUS backend: leaving "python" active would make
+        # every later BLS-on spec test pay the pure-Python pairing (~10x)
+        bls.use_backend(prev)
 
 
 def test_deferred_scope_inactive_when_bls_off():
     from consensus_specs_tpu.crypto import bls
 
+    prev = bls.backend_name()
     bls.use_native()
     was = bls.bls_active
     bls.bls_active = False
@@ -302,4 +306,4 @@ def test_deferred_scope_inactive_when_bls_off():
             assert scope.entries == []  # only_with_bls short-circuits first
     finally:
         bls.bls_active = was
-        bls.use_python()
+        bls.use_backend(prev)

@@ -1,0 +1,193 @@
+"""Differential test: the VENDORED deposit-contract BYTECODE, executed by
+the from-scratch EVM, against the transcribed twin and the SSZ deposit
+list root (round-4 VERDICT item 6; reference analogue:
+solidity_deposit_contract/web3_tester/tests/test_deposit.py:1-194).
+
+This is the test that fails if bytecode and twin ever disagree: the same
+deposit sequence is pushed through both, and root/count/logs must match
+at every step.
+"""
+import json
+import os
+
+import pytest
+
+from consensus_specs_tpu.deposit_contract import DepositTree
+from consensus_specs_tpu.evm import EvmRevert, decode_abi, deploy, selector
+from consensus_specs_tpu.specs.builder import get_spec
+
+ART = os.path.join(os.path.dirname(__file__), "..", "consensus_specs_tpu",
+                   "vendor", "deposit_contract", "deposit_contract.json")
+
+GWEI = 10**9
+ETHER = 10**18
+
+DEPOSIT_SIG = "deposit(bytes,bytes,bytes,bytes32)"
+DEPOSIT_TYPES = ["bytes", "bytes", "bytes", "bytes32"]
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("phase0", "minimal")
+
+
+@pytest.fixture()
+def contract():
+    art = json.load(open(ART))
+    return deploy(bytes.fromhex(art["bytecode"][2:]))
+
+
+def _deposit_data(spec, i, amount_gwei):
+    return spec.DepositData(
+        pubkey=bytes([i + 1]) * 48,
+        withdrawal_credentials=bytes([i + 0x20]) * 32,
+        amount=amount_gwei,
+        signature=bytes([i + 0x60]) * 96,
+    )
+
+
+def _do_deposit(contract, spec, data):
+    return contract.call(
+        DEPOSIT_SIG, DEPOSIT_TYPES,
+        [bytes(data.pubkey), bytes(data.withdrawal_credentials),
+         bytes(data.signature), bytes(data.hash_tree_root())],
+        value=int(data.amount) * GWEI,
+    )
+
+
+def _evm_root(contract) -> bytes:
+    return contract.call("get_deposit_root()", [], [], static=True)
+
+
+def _evm_count(contract) -> int:
+    raw = decode_abi(["bytes"], contract.call(
+        "get_deposit_count()", [], [], static=True))[0]
+    return int.from_bytes(raw, "little")
+
+
+def test_empty_tree_root_matches_twin(contract):
+    assert _evm_root(contract) == DepositTree().get_root()
+    assert _evm_count(contract) == 0
+
+
+def test_deposit_sequence_bytecode_vs_twin_vs_ssz(contract, spec):
+    """The core differential: every step, bytecode root == twin root ==
+    SSZ List[DepositData] root path."""
+    twin = DepositTree()
+    datas = []
+    for i in range(5):
+        data = _deposit_data(spec, i, 32 * 10**9)  # 32 ETH in gwei
+        _do_deposit(contract, spec, data)
+        twin.push_leaf(bytes(data.hash_tree_root()))
+        datas.append(data)
+
+        assert _evm_root(contract) == twin.get_root(), f"diverged at {i}"
+        assert _evm_count(contract) == twin.deposit_count == i + 1
+
+    # and against the SSZ list root's deposit-tree form: the contract root
+    # mixes count into the 2^32-deep tree exactly like the SSZ hash tree
+    # root of List[DepositData, 2**32]
+    from consensus_specs_tpu.ssz.types import List as SSZList
+
+    lst = SSZList[spec.DepositData, 2**32](datas)
+    assert _evm_root(contract) == bytes(lst.hash_tree_root())
+
+
+def test_deposit_event_log_fields(contract, spec):
+    data = _deposit_data(spec, 7, 32 * 10**9)
+    _do_deposit(contract, spec, data)
+    assert len(contract.logs) == 1
+    log = contract.logs[0]
+    # DepositEvent(bytes,bytes,bytes,bytes,bytes) — ABI-decode the payload
+    pk, wc, amount_le, sig, index_le = decode_abi(
+        ["bytes"] * 5, log.data)
+    assert pk == bytes(data.pubkey)
+    assert wc == bytes(data.withdrawal_credentials)
+    assert int.from_bytes(amount_le, "little") == int(data.amount)
+    assert sig == bytes(data.signature)
+    assert int.from_bytes(index_le, "little") == 0
+
+
+def test_low_value_deposit_reverts(contract, spec):
+    data = _deposit_data(spec, 1, 10**8)  # 0.1 ETH < 1 ETH minimum
+    with pytest.raises(EvmRevert):
+        _do_deposit(contract, spec, data)
+    assert _evm_count(contract) == 0
+
+
+def test_non_gwei_multiple_reverts(contract, spec):
+    data = _deposit_data(spec, 1, 32 * 10**9)
+    with pytest.raises(EvmRevert):
+        contract.call(
+            DEPOSIT_SIG, DEPOSIT_TYPES,
+            [bytes(data.pubkey), bytes(data.withdrawal_credentials),
+             bytes(data.signature), bytes(data.hash_tree_root())],
+            value=int(data.amount) * GWEI + 1,  # not a gwei multiple
+        )
+
+
+def test_wrong_data_root_reverts(contract, spec):
+    data = _deposit_data(spec, 2, 32 * 10**9)
+    with pytest.raises(EvmRevert):
+        contract.call(
+            DEPOSIT_SIG, DEPOSIT_TYPES,
+            [bytes(data.pubkey), bytes(data.withdrawal_credentials),
+             bytes(data.signature), b"\xbe" * 32],  # tampered root
+            value=int(data.amount) * GWEI,
+        )
+
+
+def test_malformed_pubkey_length_reverts(contract, spec):
+    data = _deposit_data(spec, 3, 32 * 10**9)
+    with pytest.raises(EvmRevert):
+        contract.call(
+            DEPOSIT_SIG, DEPOSIT_TYPES,
+            [b"\x01" * 47, bytes(data.withdrawal_credentials),
+             bytes(data.signature), bytes(data.hash_tree_root())],
+            value=int(data.amount) * GWEI,
+        )
+
+
+def test_supports_interface(contract):
+    erc165 = selector("supportsInterface(bytes4)")
+    deposit_iface = selector(DEPOSIT_SIG)  # not the ERC-165 id; expect False
+    out = contract.call("supportsInterface(bytes4)", ["bytes4"],
+                        [bytes.fromhex("01ffc9a7")], static=True)
+    assert decode_abi(["bool"], out)[0] is True
+    out = contract.call("supportsInterface(bytes4)", ["bytes4"],
+                        [b"\xde\xad\xbe\xef"], static=True)
+    assert decode_abi(["bool"], out)[0] is False
+    assert erc165 != deposit_iface
+
+
+def test_reverted_call_discards_storage_effects(contract, spec):
+    """EVM revert semantics: a failed call leaves NO state behind, even if
+    the bytecode wrote storage before the failing require."""
+    data = _deposit_data(spec, 4, 32 * 10**9)
+    _do_deposit(contract, spec, data)  # one committed deposit
+    pre_storage = dict(contract.storage)
+    pre_root = _evm_root(contract)
+    with pytest.raises(EvmRevert):
+        contract.call(
+            DEPOSIT_SIG, DEPOSIT_TYPES,
+            [bytes(data.pubkey), bytes(data.withdrawal_credentials),
+             bytes(data.signature), b"\xaa" * 32],  # wrong root -> revert
+            value=int(data.amount) * GWEI,
+        )
+    assert contract.storage == pre_storage
+    assert _evm_root(contract) == pre_root
+    assert _evm_count(contract) == 1
+
+
+def test_static_call_cannot_mutate(contract, spec):
+    """deposit() through a static context must fail (SSTORE/LOG guarded by
+    explicit EvmRevert, not strippable asserts)."""
+    data = _deposit_data(spec, 5, 32 * 10**9)
+    with pytest.raises(EvmRevert):
+        contract.call(
+            DEPOSIT_SIG, DEPOSIT_TYPES,
+            [bytes(data.pubkey), bytes(data.withdrawal_credentials),
+             bytes(data.signature), bytes(data.hash_tree_root())],
+            value=int(data.amount) * GWEI, static=True,
+        )
+    assert _evm_count(contract) == 0
