@@ -40,9 +40,13 @@ GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing f
 
 gen-all: $(addprefix gen-,$(GENERATORS))
 
-gen-%:
+# FORCE, not .PHONY: make never applies pattern rules to .PHONY targets,
+# so listing gen-% there silently turned every generator into a no-op
+gen-%: FORCE
 	mkdir -p $(OUT)
 	python -m consensus_specs_tpu.gen.runners.$* -o $(OUT) $(if $(PRESETS),-l $(PRESETS),)
+
+FORCE:
 
 # replay a generated vector tree against fresh spec builds (the
 # client-side half of the format contract)
@@ -54,4 +58,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench limb-probe dcn-dryrun lint consume mdspec gen-all $(addprefix gen-,$(GENERATORS))
+.PHONY: test test-par test-fast test-mainnet bench limb-probe dcn-dryrun lint consume mdspec gen-all FORCE

@@ -1,0 +1,150 @@
+"""Optimistic-sync predicate suite (reference surface: sync/optimistic.md
+compiled into the bellatrix spec — OptimisticStore, is_optimistic,
+latest_verified_ancestor, is_optimistic_candidate_block — plus
+fork_choice/safe-block.md's get_safe_* helpers).  Round 3 pinned these
+AST-for-AST; this suite executes them, through the shared test DSL."""
+from consensus_specs_tpu.testing.context import (
+    spec_configured_state_test,
+    spec_state_test,
+    with_bellatrix_and_later,
+)
+
+
+def _chain(spec, n, with_payload=()):
+    """n linked blocks; indices in ``with_payload`` get a non-empty
+    execution payload (an 'execution block')."""
+    blocks = []
+    parent_root = spec.Root()
+    for i in range(n):
+        block = spec.BeaconBlock(slot=i + 1, parent_root=parent_root)
+        if i in with_payload:
+            block.body.execution_payload.block_hash = bytes([i + 1]) * 32
+            block.body.execution_payload.timestamp = 1  # non-default payload
+        blocks.append(block)
+        parent_root = spec.hash_tree_root(block)
+    return blocks
+
+
+def _opt_store(spec, blocks, optimistic_indices):
+    roots = [spec.hash_tree_root(b) for b in blocks]
+    return spec.OptimisticStore(
+        optimistic_roots=set(roots[i] for i in optimistic_indices),
+        head_block_root=roots[-1],
+        blocks={r: b for r, b in zip(roots, blocks)},
+        block_states={},
+    )
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_is_optimistic_membership(spec, state):
+    blocks = _chain(spec, 3)
+    opt = _opt_store(spec, blocks, {2})
+    assert spec.is_optimistic(opt, blocks[2])
+    assert not spec.is_optimistic(opt, blocks[0])
+    assert not spec.is_optimistic(opt, blocks[1])
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_latest_verified_ancestor_walks_optimistic_suffix(spec, state):
+    """Blocks 2..4 optimistic: the latest verified ancestor of the head is
+    block 1, regardless of where the walk starts in the suffix."""
+    blocks = _chain(spec, 5)
+    opt = _opt_store(spec, blocks, {2, 3, 4})
+    for start in (2, 3, 4):
+        got = spec.latest_verified_ancestor(opt, blocks[start])
+        assert spec.hash_tree_root(got) == spec.hash_tree_root(blocks[1])
+    # a fully verified block is its own answer
+    got = spec.latest_verified_ancestor(opt, blocks[1])
+    assert spec.hash_tree_root(got) == spec.hash_tree_root(blocks[1])
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_latest_verified_ancestor_stops_at_genesis_boundary(spec, state):
+    """Every block optimistic: the walk terminates at the chain's first
+    block (whose parent_root is the zero root)."""
+    blocks = _chain(spec, 3)
+    opt = _opt_store(spec, blocks, {0, 1, 2})
+    got = spec.latest_verified_ancestor(opt, blocks[2])
+    assert spec.hash_tree_root(got) == spec.hash_tree_root(blocks[0])
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_is_execution_block(spec, state):
+    blocks = _chain(spec, 2, with_payload={1})
+    assert not spec.is_execution_block(blocks[0])
+    assert spec.is_execution_block(blocks[1])
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_candidate_when_parent_is_execution_block(spec, state):
+    blocks = _chain(spec, 3, with_payload={0})
+    opt = _opt_store(spec, blocks, set())
+    # parent (block 0) carries a payload: optimistic import allowed NOW
+    assert spec.is_optimistic_candidate_block(
+        opt, current_slot=blocks[1].slot, block=blocks[1])
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_candidate_requires_safe_slot_distance_otherwise(spec, state):
+    blocks = _chain(spec, 3)  # no payloads anywhere
+    opt = _opt_store(spec, blocks, set())
+    safe = int(spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY)
+    block = blocks[1]
+    # too recent: not a candidate
+    assert not spec.is_optimistic_candidate_block(
+        opt, current_slot=spec.Slot(int(block.slot) + safe - 1), block=block)
+    # old enough: candidate
+    assert spec.is_optimistic_candidate_block(
+        opt, current_slot=spec.Slot(int(block.slot) + safe), block=block)
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_safe_block_root_is_justified_root(spec, state):
+    anchor = spec.BeaconBlock(state_root=state.hash_tree_root())
+    store = spec.get_forkchoice_store(state, anchor)
+    assert bytes(spec.get_safe_beacon_block_root(store)) == \
+        bytes(store.justified_checkpoint.root)
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_safe_execution_hash_zero_before_fork_epoch(spec, state):
+    """Default config: BELLATRIX_FORK_EPOCH is far-future, so the justified
+    block predates it and the safe execution hash must be Hash32()."""
+    anchor = spec.BeaconBlock(state_root=state.hash_tree_root())
+    store = spec.get_forkchoice_store(state, anchor)
+    assert int(spec.config.BELLATRIX_FORK_EPOCH) > 0
+    assert bytes(spec.get_safe_execution_payload_hash(store)) == b"\x00" * 32
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_configured_state_test({"BELLATRIX_FORK_EPOCH": 0})
+def test_safe_execution_hash_is_justified_payload_post_fork(spec, state):
+    """Fork at genesis: the justified block's epoch reaches
+    BELLATRIX_FORK_EPOCH, so the hash must be the justified block's OWN
+    payload hash — a non-zero value, so a branch inversion in
+    get_safe_execution_payload_hash cannot slip through."""
+    payload_hash = b"\x5a" * 32
+    anchor = spec.BeaconBlock(state_root=state.hash_tree_root())
+    anchor.body.execution_payload.block_hash = payload_hash
+    store = spec.get_forkchoice_store(state, anchor)
+    assert int(spec.compute_epoch_at_slot(
+        store.blocks[spec.get_safe_beacon_block_root(store)].slot)) >= \
+        int(spec.config.BELLATRIX_FORK_EPOCH)
+    assert bytes(spec.get_safe_execution_payload_hash(store)) == payload_hash
+    yield "post", None
