@@ -104,14 +104,27 @@ def build_table(details: dict) -> str:
             f"{_fmt(r.get('sequential_spec_scaled_s'))} s)",
             "altair_epoch"))
 
-    lines = [
-        BEGIN,
-        "",
+    lines = [BEGIN, ""]
+    if details.get("_device_fallback"):
+        lines += [
+            "> **DEGRADED RUN — device tunnel unreachable at bench time.**",
+            "> JAX was pinned to CPU with plugin discovery shadowed: every",
+            "> device-path row below reflects the CPU XLA backend, NOT the",
+            "> chip.  Host-path rows (BLS, `state_transition`) are unaffected.",
+            "",
+        ]
+    lines += [
         "| # | Benchmark config | This framework (measured) | JSON key |",
         "|---|---|---|---|",
     ]
     for num, config, measured, key in rows:
         lines.append(f"| {num} | {config} | {measured} | `{key}` |")
+    notes = [(key, details[key]["note"]) for _, _, _, key in rows
+             if isinstance(details.get(key), dict) and details[key].get("note")]
+    if notes:
+        lines.append("")
+        for key, note in notes:
+            lines.append(f"- `{key}`: {note}")
     ctx = details.get("_load_context", {})
     if ctx:
         lines.append("")
