@@ -582,6 +582,8 @@ def _ensure_live_jax():
     kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
             if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))]
     env["PYTHONPATH"] = os.pathsep.join([shim] + kept)
+    # the device plugin's sitecustomize gates its registration on this var
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["CSTPU_BENCH_JAX_PROBED"] = "1"
     env["CSTPU_BENCH_DEVICE_FALLBACK"] = "1"

@@ -174,6 +174,9 @@ def main() -> dict:
     flags.append(f"--xla_force_host_platform_device_count={DEV_PER_PROC}")
     env["XLA_FLAGS"] = " ".join(flags)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # CPU-only workers: keep the device plugin's sitecustomize (gated on
+    # this var) from blocking child startup when the TPU tunnel is down
+    env.pop("PALLAS_AXON_POOL_IPS", None)
 
     # pick a free coordinator port so concurrent runs on one host can't
     # collide or cross-join each other's cluster
