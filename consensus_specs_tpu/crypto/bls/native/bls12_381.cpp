@@ -547,6 +547,33 @@ struct Pt {
 
     Pt neg() const { return Pt{x, -y, z}; }
 
+    // Mixed addition with an affine point (implicit Z2 = 1), madd-2007-bl:
+    // 7M + 4S vs the 11M + 5S of the general add — the workhorse of the
+    // Pippenger bucket phase where every input point is affine.
+    Pt add_affine(const F &ox, const F &oy) const {
+        if (is_inf()) return Pt{ox, oy, F::one()};
+        F Z1Z1 = z.square();
+        F U2 = ox * Z1Z1;
+        F S2 = oy * z * Z1Z1;
+        if (x == U2) {
+            if (y == S2) return dbl();
+            return infinity();
+        }
+        F H = U2 - x;
+        F HH = H.square();
+        F I = HH + HH;
+        I = I + I;
+        F J = H * I;
+        F rr = S2 - y;
+        rr = rr + rr;
+        F V = x * I;
+        F X3 = rr.square() - J - V - V;
+        F YJ = y * J;
+        F Y3 = rr * (V - X3) - YJ - YJ;
+        F Z3 = (z + H).square() - Z1Z1 - HH;
+        return Pt{X3, Y3, Z3};
+    }
+
     Pt mul_be(const uint8_t *k, size_t n) const {
         Pt result = infinity();
         for (size_t i = 0; i < n; i++) {
@@ -1229,6 +1256,86 @@ static void bls_init_impl() {
 // ===========================================================================
 
 // deserialize + subgroup-check; rc: 0 ok, nonzero bad
+// ===========================================================================
+// Pippenger multi-scalar multiplication over G1
+// ===========================================================================
+// The KZG commitment core: blob_to_kzg is a FIELD_ELEMENTS_PER_BLOB-point
+// G1 MSM over the Lagrange trusted setup (reference capability:
+// specs/eip4844/beacon-chain.md:112-120 `g1_lincomb`).  Bucketed windows
+// with mixed affine additions; window width tuned for the n*(adds) +
+// windows*2^c aggregation tradeoff.
+
+static inline unsigned scalar_window(const uint8_t *s32, unsigned lo,
+                                     unsigned width) {
+    // bits [lo, lo+width) of a 256-bit big-endian scalar, LSB-first order
+    unsigned v = 0;
+    for (unsigned b = 0; b < width && lo + b < 256; b++) {
+        unsigned bit = lo + b;
+        v |= (unsigned)((s32[31 - bit / 8] >> (bit % 8)) & 1u) << b;
+    }
+    return v;
+}
+
+static G1 g1_msm_pippenger(const std::vector<Fp> &xs, const std::vector<Fp> &ys,
+                           const uint8_t *scalars32, size_t n) {
+    if (n == 0) return G1::infinity();
+    // argmin over window width of the field-mul count:
+    //   windows * (n mixed adds @ ~11M + 2*2^c bucket-agg adds @ ~16M)
+    unsigned c = 2;
+    double best = 1e300;
+    for (unsigned t = 2; t <= 16; t++) {
+        double cost = ((255 + t) / t) * (n * 11.0 + (double)(size_t(1) << t) * 32.0);
+        if (cost < best) { best = cost; c = t; }
+    }
+    unsigned n_windows = (255 + c) / c;
+    std::vector<G1> buckets(size_t(1) << c);
+    G1 acc = G1::infinity();
+    for (int w = (int)n_windows - 1; w >= 0; w--) {
+        if (w != (int)n_windows - 1)
+            for (unsigned d = 0; d < c; d++) acc = acc.dbl();
+        for (auto &b : buckets) b = G1::infinity();
+        unsigned lo = (unsigned)w * c;
+        for (size_t i = 0; i < n; i++) {
+            unsigned digit = scalar_window(scalars32 + 32 * i, lo, c);
+            if (digit) buckets[digit - 1] = buckets[digit - 1].add_affine(xs[i], ys[i]);
+        }
+        // sum_d (d+1)*buckets[d] via suffix running sums
+        G1 running = G1::infinity();
+        G1 window_sum = G1::infinity();
+        for (size_t d = buckets.size(); d-- > 0;) {
+            if (!buckets[d].is_inf()) running = running.add(buckets[d]);
+            window_sum = window_sum.add(running);
+        }
+        acc = acc.add(window_sum);
+    }
+    return acc;
+}
+
+// Fixed-base variant: KZG commits always against the SAME trusted setup, so
+// the per-point window shifts [2^(w*c)]P_i can be precomputed once.  The MSM
+// then becomes a single bucket pass over n*n_windows (point, digit) pairs —
+// no inter-window doublings of the accumulator at all.
+static const unsigned MSM_FIXED_C = 12;  // argmin of adds: 22*(n·11) + 2^13·16
+
+static void g1_batch_to_affine(const std::vector<G1> &pts,
+                               std::vector<Fp> &xs, std::vector<Fp> &ys) {
+    // Montgomery batch inversion of every z (callers guarantee no infinity)
+    size_t n = pts.size();
+    xs.resize(n);
+    ys.resize(n);
+    std::vector<Fp> prefix(n + 1);
+    prefix[0] = Fp::one();
+    for (size_t i = 0; i < n; i++) prefix[i + 1] = prefix[i] * pts[i].z;
+    Fp inv = prefix[n].inv();
+    for (size_t i = n; i-- > 0;) {
+        Fp zi = inv * prefix[i];
+        inv = inv * pts[i].z;
+        Fp zi2 = zi.square();
+        xs[i] = pts[i].x * zi2;
+        ys[i] = pts[i].y * zi2 * zi;
+    }
+}
+
 static int load_pubkey(G1 &out, const uint8_t pk[48]) {
     int rc = g1_deserialize(out, pk);
     if (rc) return rc;
@@ -1457,6 +1564,203 @@ int bls_batch_fast_aggregate_verify_affine(
     // batched slope inversions across the k+1 lanes
     Fp12 f = miller_loop_product(ps, qs);
     return pairing_product_is_one(f) ? 1 : 0;
+}
+
+// G1 MSM: n points as canonical affine x||y (96 bytes each, e.g. a KZG
+// trusted setup), n scalars as 32-byte big-endian integers (caller reduces
+// mod r).  out = compressed sum_i [s_i]P_i.  rc 1 on success; 0 when any
+// coordinate is non-canonical or any point is off-curve.  Subgroup
+// membership is the CALLER's invariant (setup points are multiples of the
+// generator by construction).
+int bls_g1_msm(const uint8_t *xys, const uint8_t *scalars32, size_t n,
+               uint8_t out[48]) {
+    bls_init();
+    std::vector<Fp> xs(n), ys(n);
+    for (size_t i = 0; i < n; i++) {
+        if (!fp_from_bytes48(xs[i], xys + 96 * i)) return 0;
+        if (!fp_from_bytes48(ys[i], xys + 96 * i + 48)) return 0;
+        if (!g1_on_curve(xs[i], ys[i])) return 0;
+    }
+    G1 r = g1_msm_pippenger(xs, ys, scalars32, n);
+    g1_serialize(out, r);
+    return 1;
+}
+
+// Fixed-base MSM precomputation: expand n affine points into the shifted
+// window table [[2^(w*c)]P_i for w in 0..n_windows), laid out window-major
+// (all points of window 0, then window 1, ...).  Entries are RAW MONTGOMERY
+// limb pairs (2 x 48 bytes, machine byte order) — the table is an opaque
+// in-process cache handed straight back to bls_g1_msm_fixed, so skipping
+// canonical encode/decode saves a to/from-Montgomery multiply per coordinate
+// per bucket add.  out_table must hold n * n_windows * 96 bytes.  rc =
+// n_windows on success, 0 on bad input.
+// Window count of the fixed-base layout — Python sizes the table buffer
+// from THIS export so the two sides can never drift.
+int bls_g1_msm_fixed_windows(void) { return (int)((255 + MSM_FIXED_C) / MSM_FIXED_C); }
+
+int bls_g1_msm_precompute(const uint8_t *xys, size_t n, uint8_t *out_table) {
+    bls_init();
+    const unsigned c = MSM_FIXED_C;
+    const unsigned n_windows = (255 + c) / c;
+    if (n == 0) return (int)n_windows;
+    std::vector<G1> shifted(n * n_windows);
+    for (size_t i = 0; i < n; i++) {
+        Fp x, y;
+        if (!fp_from_bytes48(x, xys + 96 * i)) return 0;
+        if (!fp_from_bytes48(y, xys + 96 * i + 48)) return 0;
+        if (!g1_on_curve(x, y)) return 0;
+        G1 p{x, y, Fp::one()};
+        for (unsigned w = 0; w < n_windows; w++) {
+            shifted[w * n + i] = p;
+            if (w + 1 < n_windows)
+                for (unsigned d = 0; d < c; d++) p = p.dbl();
+        }
+    }
+    std::vector<Fp> xs, ys;
+    g1_batch_to_affine(shifted, xs, ys);
+    for (size_t j = 0; j < shifted.size(); j++) {
+        memcpy(out_table + 96 * j, xs[j].v.l, 48);
+        memcpy(out_table + 96 * j + 48, ys[j].v.l, 48);
+    }
+    return (int)n_windows;
+}
+
+// Fixed-base MSM over a precomputed shifted-window table: ONE bucket pass —
+// digit d of scalar i's window w sends table entry (w, i) to bucket d, with
+// no accumulator doubling chain.  Bucket accumulation is fully batch-affine:
+// entries are grouped by digit (counting sort), then each group collapses by
+// pairwise tree reduction where every round's slope denominators share ONE
+// modular inversion (Montgomery batching) — an affine add costs ~6 field
+// muls against the ~11 of a mixed Jacobian add, and the tree shape keeps
+// same-bucket streaks (constant blobs) at log depth instead of a serial
+// chain.
+int bls_g1_msm_fixed(const uint8_t *table, size_t n, const uint8_t *scalars32,
+                     uint8_t out[48]) {
+    bls_init();
+    const unsigned c = MSM_FIXED_C;
+    const unsigned n_windows = (255 + c) / c;
+    const size_t n_groups = (size_t(1) << c) - 1;
+
+    // digit extraction + counting sort by bucket
+    std::vector<uint16_t> digits((size_t)n_windows * n);
+    std::vector<uint32_t> count(n_groups, 0);
+    for (unsigned w = 0; w < n_windows; w++)
+        for (size_t i = 0; i < n; i++) {
+            unsigned d = scalar_window(scalars32 + 32 * i, w * c, c);
+            digits[(size_t)w * n + i] = (uint16_t)d;
+            if (d) count[d - 1]++;
+        }
+    std::vector<size_t> start(n_groups + 1, 0);
+    for (size_t g = 0; g < n_groups; g++) start[g + 1] = start[g] + count[g];
+    std::vector<Fp> wx(start[n_groups]), wy(start[n_groups]);
+    {
+        std::vector<size_t> cursor(start.begin(), start.end() - 1);
+        for (unsigned w = 0; w < n_windows; w++) {
+            const uint8_t *win = table + (size_t)96 * w * n;
+            for (size_t i = 0; i < n; i++) {
+                unsigned d = digits[(size_t)w * n + i];
+                if (!d) continue;
+                size_t k = cursor[d - 1]++;
+                memcpy(wx[k].v.l, win + 96 * i, 48);
+                memcpy(wy[k].v.l, win + 96 * i + 48, 48);
+            }
+        }
+    }
+    std::vector<size_t> gsize(count.begin(), count.end());
+
+    // pairwise tree reduction, one shared inversion per round.  In-place is
+    // safe: op j of a group writes slot (start + write-cursor <= j) after
+    // every read of that slot (pair reads are at 2j, 2j+1 >= j+1 for later
+    // ops; processing is in-order).
+    struct Op {
+        size_t grp, a, b;
+        bool dbl, cancel;
+    };
+    std::vector<Op> ops;
+    std::vector<Fp> denoms, pref, dinv;
+    for (;;) {
+        ops.clear();
+        denoms.clear();
+        for (size_t g = 0; g < n_groups; g++) {
+            size_t s = gsize[g];
+            if (s < 2) continue;
+            for (size_t j = 0; j + 1 < s; j += 2) {
+                size_t a = start[g] + j, b = a + 1;
+                Op op{g, a, b, false, false};
+                if (wx[a] == wx[b]) {
+                    if (wy[a] == wy[b]) {
+                        op.dbl = true;
+                        denoms.push_back(wy[a] + wy[a]);  // y != 0: odd order
+                    } else {
+                        op.cancel = true;  // P + (-P): drop both
+                        denoms.push_back(Fp::one());
+                    }
+                } else {
+                    denoms.push_back(wx[b] - wx[a]);
+                }
+                ops.push_back(op);
+            }
+        }
+        if (ops.empty()) break;
+        size_t m = denoms.size();
+        pref.assign(m + 1, Fp::one());
+        for (size_t i = 0; i < m; i++) pref[i + 1] = pref[i] * denoms[i];
+        Fp inv = pref[m].inv();
+        dinv.resize(m);
+        for (size_t i = m; i-- > 0;) {
+            dinv[i] = inv * pref[i];
+            inv = inv * denoms[i];
+        }
+        size_t gi = 0, wcur = 0;
+        bool have_group = false;
+        auto close_group = [&](size_t g) {
+            // odd leftover slides down next to the written results
+            if (gsize[g] & 1) {
+                size_t last = start[g] + gsize[g] - 1;
+                size_t dst = start[g] + wcur;
+                if (dst != last) {
+                    wx[dst] = wx[last];
+                    wy[dst] = wy[last];
+                }
+                wcur++;
+            }
+            gsize[g] = wcur;
+        };
+        for (size_t i = 0; i < m; i++) {
+            const Op &op = ops[i];
+            if (!have_group || op.grp != gi) {
+                if (have_group) close_group(gi);
+                gi = op.grp;
+                wcur = 0;
+                have_group = true;
+            }
+            if (op.cancel) continue;
+            Fp ax = wx[op.a], ay = wy[op.a];
+            Fp l;
+            if (op.dbl) {
+                Fp x2 = ax.square();
+                l = (x2 + x2 + x2) * dinv[i];
+            } else {
+                l = (wy[op.b] - ay) * dinv[i];
+            }
+            Fp x3 = l.square() - ax - wx[op.b];
+            Fp y3 = l * (ax - x3) - ay;
+            size_t dst = start[gi] + wcur++;
+            wx[dst] = x3;
+            wy[dst] = y3;
+        }
+        if (have_group) close_group(gi);
+    }
+
+    // suffix running sums: acc = sum_d (d+1) * bucket_d
+    G1 running = G1::infinity();
+    G1 acc = G1::infinity();
+    for (size_t d = n_groups; d-- > 0;) {
+        if (gsize[d]) running = running.add_affine(wx[start[d]], wy[start[d]]);
+        acc = acc.add(running);
+    }
+    g1_serialize(out, acc);
+    return 1;
 }
 
 // test/diagnostic exports ---------------------------------------------------

@@ -96,3 +96,57 @@ def test_pippenger_matches_naive_lincomb():
     naive = kzg.g1_lincomb(points, scalars)
     fast = kzg.g1_msm_pippenger(points, scalars)
     assert fast == naive
+
+
+# --- native C++ MSM (bls_g1_msm / bls_g1_msm_fixed) ------------------------
+
+def _native_available():
+    return kzg._native_mod() is not None
+
+
+@pytest.mark.skipif(not _native_available(), reason="native BLS backend absent")
+def test_native_msm_matches_python_pippenger():
+    g = g1_generator()
+    points = [g.mul(i + 2) for i in range(65)]
+    scalars = [rng.randrange(fr.R) for _ in range(63)] + [0, 1]
+    expected = g1_to_bytes(kzg.g1_msm_pippenger(points, scalars))
+    assert kzg.g1_msm_native(points, scalars) == expected
+    assert kzg.g1_msm_native(points, scalars, fixed_base=True) == expected
+
+
+@pytest.mark.skipif(not _native_available(), reason="native BLS backend absent")
+def test_native_fixed_msm_edge_digits():
+    # constant scalars exercise the deep single-bucket tree; the duplicate
+    # point pair exercises the batch-affine doubling branch; P + (-P) the
+    # cancellation branch (result: infinity)
+    from consensus_specs_tpu.crypto.bls.curve import Point
+
+    n = 128
+    setup = kzg.setup_lagrange(n)
+    for blob in ([4] * n, [0] * (n - 2) + [123, fr.R - 1]):
+        expected = g1_to_bytes(kzg.g1_lincomb(setup, blob))
+        assert kzg.g1_msm_native(setup, blob, fixed_base=True) == expected
+
+    g = g1_generator()
+    assert kzg.g1_msm_native([g, g], [5, 5], fixed_base=True) == \
+        g1_to_bytes(kzg.g1_lincomb([g, g], [5, 5]))
+    neg_g = Point(g.x, -g.y, g.z, g.b)
+    inf = bytes([0xC0]) + b"\x00" * 47
+    assert kzg.g1_msm_native([g, neg_g], [5, 5], fixed_base=True) == inf
+
+
+@pytest.mark.skipif(not _native_available(), reason="native BLS backend absent")
+def test_native_msm_rejects_off_curve_point():
+    from consensus_specs_tpu.crypto.bls import native
+
+    bad = (3).to_bytes(48, "big") + (5).to_bytes(48, "big")
+    with pytest.raises(ValueError):
+        native.G1MSM(bad, (1).to_bytes(32, "big"))
+
+
+def test_blob_to_kzg_native_and_python_paths_agree():
+    n = 128
+    setup = kzg.setup_lagrange(n)
+    blob = [rng.randrange(fr.R) for _ in range(n)]
+    via_blob = kzg.blob_to_kzg(blob, setup)  # native fixed-base when present
+    assert via_blob == g1_to_bytes(kzg.g1_msm_pippenger(setup, blob))
