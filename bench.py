@@ -208,7 +208,45 @@ def bench_epoch_e2e_bls(results):
                         build_st, spec.DOMAIN_BEACON_PROPOSER)))))
         return signed_blocks
 
-    t_build_blocks, signed_blocks = _timed(_build_blocks)
+    # -- corpus cache: the signed-block set is a pure function of the
+    # pre-epoch state (whose root covers N_VALIDATORS, pubkeys, balances)
+    # and the builder logic (versioned key).  A warm bench run skips the
+    # ~4 min rebuild; the measured phase is unaffected either way.
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_cache")
+    cache_key = (f"blocks_v2_{N_VALIDATORS}_{bytes(state.hash_tree_root()).hex()[:24]}")
+    cache_path = os.path.join(cache_dir, cache_key + ".ssz")
+
+    def _load_corpus():
+        with open(cache_path, "rb") as f:
+            raw = f.read()
+        blocks, off = [], 0
+        while off < len(raw):
+            ln = int.from_bytes(raw[off:off + 4], "little")
+            off += 4
+            blocks.append(spec.SignedBeaconBlock.decode_bytes(raw[off:off + ln]))
+            off += ln
+        return blocks
+
+    def _store_corpus(blocks):
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = cache_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for sb in blocks:
+                enc = sb.encode_bytes()
+                f.write(len(enc).to_bytes(4, "little"))
+                f.write(enc)
+        os.replace(tmp, cache_path)
+
+    corpus_cached = os.path.exists(cache_path)
+    if corpus_cached:
+        t_build_blocks, signed_blocks = _timed(_load_corpus)
+    else:
+        t_build_blocks, signed_blocks = _timed(_build_blocks)
+        try:
+            _store_corpus(signed_blocks)
+        except OSError:
+            pass  # read-only tree: cold path every run
     n_atts = sum(len(sb.message.body.attestations) for sb in signed_blocks)
 
     # -- measured phase: full verification + transition, BLS ON
@@ -222,15 +260,34 @@ def bench_epoch_e2e_bls(results):
     bls.bls_active = False
     assert int(state.slot) % int(spec.SLOTS_PER_EPOCH) == 0  # epoch boundary hit
 
+    # reference-shaped baseline (BASELINE.md:25): the pure-Python pairing
+    # oracle verifying the same 128-pubkey aggregate shape, measured once
+    # and scaled to the n_atts this run actually verified.  This mirrors
+    # how the BLS-free row scales its sequential twin.
+    from consensus_specs_tpu.testing.helpers.keys import pubkeys as _pk_table
+
+    oracle_msg = b"\x51" * 32
+    oracle_sks = [privkeys[i] for i in range(128)]
+    oracle_agg = _sign_suite.Aggregate(
+        [_sign_suite.Sign(sk, oracle_msg) for sk in oracle_sks])
+    t_oracle1, ok = _timed(
+        _sign_suite.FastAggregateVerify,
+        [_pk_table[i] for i in range(128)], oracle_msg, oracle_agg)
+    assert ok
+    t_oracle_scaled = t_oracle1 * n_atts
+
     results["epoch_e2e_bls"] = {
         "metric": f"mainnet_epoch_e2e_bls_on_{N_VALIDATORS}",
         "value": round(t_e2e, 3),
         "unit": "s",
+        "vs_baseline": round(t_oracle_scaled / t_e2e, 1),
         "blocks": len(signed_blocks),
         "aggregate_attestations_verified": n_atts,
         "per_block_s": round(t_e2e / len(signed_blocks), 3),
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
+        "block_corpus_cached": corpus_cached,
+        "python_oracle_scaled_s": round(t_oracle_scaled, 1),
         "bls_backend": bls.backend_name() if hasattr(bls, "backend_name") else "native",
     }
 
@@ -248,10 +305,54 @@ def bench_epoch(results):
 
     # best of three warm passes (O(1) state copies): the shared host's
     # scheduling noise would otherwise swing the recorded headline 2x
+    pristine = state.copy()
     warm = [_timed(spec.process_epoch, state.copy())[0] for _ in range(2)]
     t_last, _ = _timed(spec.process_epoch, state)
     t_epoch = min(warm + [t_last])
     t_root, _ = _timed(state.hash_tree_root)
+
+    # composed resident-merkle row: the SHIPPING process_rewards_and_penalties
+    # routed through the fused deltas+merkle device program (forced on) vs
+    # host path (forced off), epoch + post-root each, roots asserted equal.
+    # The 'auto' policy ships whichever the live backend wins.
+    resident = {}
+    try:
+        from consensus_specs_tpu.ops import merkle_resident
+
+        prev_env = os.environ.get("CSTPU_RESIDENT_MERKLE")
+        res_on, res_off = pristine.copy(), pristine.copy()
+        try:
+            os.environ["CSTPU_RESIDENT_MERKLE"] = "1"
+            n_before = merkle_resident.stats["fused_epoch_updates"]
+            _timed(spec.process_epoch, res_on.copy())  # cold: pays XLA compile
+            t_ep_on, _ = _timed(spec.process_epoch, res_on)
+            t_root_on, _ = _timed(res_on.hash_tree_root)
+            engaged = merkle_resident.stats["fused_epoch_updates"] > n_before
+            os.environ["CSTPU_RESIDENT_MERKLE"] = "0"
+            t_ep_off, _ = _timed(spec.process_epoch, res_off)
+            t_root_off, _ = _timed(res_off.hash_tree_root)
+            # what the auto policy decides on this backend — probed under
+            # 'auto', not under whatever the operator may have exported
+            os.environ["CSTPU_RESIDENT_MERKLE"] = "auto"
+            auto_device = merkle_resident.resident_device()
+        finally:
+            if prev_env is None:
+                os.environ.pop("CSTPU_RESIDENT_MERKLE", None)
+            else:
+                os.environ["CSTPU_RESIDENT_MERKLE"] = prev_env
+        assert bytes(res_on.hash_tree_root()) == bytes(res_off.hash_tree_root()), \
+            "resident-merkle state root diverged from host path"
+        resident = {
+            "fused_engaged": engaged,
+            "epoch_plus_root_fused_s": round(t_ep_on + t_root_on, 3),
+            "epoch_plus_root_host_s": round(t_ep_off + t_root_off, 3),
+            "post_root_fused_s": round(t_root_on, 3),
+            "post_root_host_s": round(t_root_off, 3),
+            "roots_identical": True,
+            "auto_policy_engages_on_this_backend": auto_device is not None,
+        }
+    except Exception as exc:  # pragma: no cover - bench resilience
+        resident = {"error": repr(exc)[:300]}
 
     # sequential baseline: fresh spec module with the kernel substitutions
     # bypassed, at BASELINE_N, scaled linearly (favorable to the baseline)
@@ -274,6 +375,7 @@ def bench_epoch(results):
         "sequential_spec_scaled_s": round(t_seq_scaled, 3),
         "vs_baseline": round(t_seq_scaled / t_epoch, 1),
         "target": "< 60 s",
+        "resident_merkle": resident,
     }
     return state, spec
 
@@ -577,10 +679,19 @@ def _ensure_live_jax():
     env = dict(os.environ)
     # the tunnel plugin rides in via a sitecustomize on the ambient
     # PYTHONPATH, so prepending the shim is not enough — but dropping
-    # PYTHONPATH wholesale could lose unrelated deps; filter out only
-    # entries that carry a sitecustomize (the plugin bootstrap), keep the rest
+    # PYTHONPATH wholesale could lose unrelated deps; drop only entries
+    # whose sitecustomize is actually the device-plugin bootstrap (marker
+    # scan), keeping any unrelated sitecustomize-bearing paths
+    def _is_device_bootstrap(p):
+        try:
+            with open(os.path.join(p, "sitecustomize.py")) as f:
+                head = f.read(8192)
+        except OSError:
+            return False
+        return "axon" in head.lower() or "pallas" in head.lower()
+
     kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))]
+            if p and not _is_device_bootstrap(p)]
     env["PYTHONPATH"] = os.pathsep.join([shim] + kept)
     # the device plugin's sitecustomize gates its registration on this var
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -645,7 +756,13 @@ def main():
     except Exception as exc:  # table sync must never kill the headline
         print(f"BASELINE.md regeneration failed: {exc!r}", file=sys.stderr)
 
-    ns = results["north_star_epoch"]
+    # the driver parses the LAST JSON line: that must be the north star —
+    # the BLS-ON end-to-end epoch (VERDICT r4 item 2).  The BLS-free
+    # kernel row is the fallback only when the e2e row was skipped (QUICK)
+    # or failed.
+    ns = results.get("epoch_e2e_bls", {})
+    if "value" not in ns:
+        ns = results["north_star_epoch"]
     print(json.dumps({
         "metric": ns["metric"],
         "value": ns["value"],

@@ -118,8 +118,9 @@ def FastAggregateVerify(pubkeys, message, signature):
 
     tracing.count("bls.fast_aggregate_verify")
     tracing.count("bls.fast_aggregate_verify.pubkeys", len(pubkeys))
-    if _deferred_stack:
-        _deferred_stack[-1].entries.append(
+    stack = _deferred_stack.get()
+    if stack:
+        stack[-1].entries.append(
             (tuple(bytes(p) for p in pubkeys), bytes(message), bytes(signature))
         )
         return True  # optimistic; settled at scope exit
@@ -135,7 +136,13 @@ def FastAggregateVerify(pubkeys, message, signature):
 # issued inside the scope is collected and settled in ONE batched pairing
 # product with a single shared final exponentiation.
 
-_deferred_stack: list = []
+# per-context scope stack: a ContextVar (not a module list) so concurrent
+# block processing in threads or asyncio tasks cannot interleave entries
+# across unrelated deferred scopes
+import contextvars as _contextvars
+
+_deferred_stack: "_contextvars.ContextVar[tuple]" = _contextvars.ContextVar(
+    "bls_deferred_stack", default=())
 
 
 def _batch_verify(entries) -> bool:
@@ -191,12 +198,13 @@ class deferred_fast_aggregate_verify:
 
     def __enter__(self):
         self.entries = []
-        _deferred_stack.append(self)
+        self._token = _deferred_stack.set(_deferred_stack.get() + (self,))
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        popped = _deferred_stack.pop()
-        assert popped is self, "deferred verification scopes must nest"
+        stack = _deferred_stack.get()
+        assert stack and stack[-1] is self, "deferred verification scopes must nest"
+        _deferred_stack.reset(self._token)
         if not bls_active or not self.entries:
             return False
         first_bad = _first_invalid(self.entries)

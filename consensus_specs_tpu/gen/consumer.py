@@ -500,6 +500,17 @@ def run_ssz_generic_case(handler: str, suite: str, case_dir: Path) -> None:
     roots = _yaml.safe_load((case_dir / "roots.yaml").read_text())
     if "0x" + hash_tree_root(value).hex() != roots["root"]:
         raise VectorFailure(f"ssz_generic/{handler}/{case_dir.name}: root mismatch")
+    # the third artifact of the format contract: the human-readable
+    # value.yaml must describe the same value the bytes decode to
+    value_path = case_dir / "value.yaml"
+    if value_path.exists():
+        from consensus_specs_tpu.debug.encode import encode
+
+        want = _yaml.safe_load(value_path.read_text())
+        got = _yaml.safe_load(_yaml.safe_dump(encode(value)))  # normalize
+        if got != want:
+            raise VectorFailure(
+                f"ssz_generic/{handler}/{case_dir.name}: value.yaml mismatch")
 
 
 def run_fork_case(fork: str, case_dir: Path, meta, preset: str,

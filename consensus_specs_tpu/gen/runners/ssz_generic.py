@@ -92,8 +92,9 @@ _CONTAINER_REGISTRY = {
 
 _UINTS = {8: uint8, 16: uint16, 32: uint32, 64: uint64, 128: uint128, 256: uint256}
 
-_VEC_ELEMS = {"uint8": uint8, "uint16": uint16, "uint64": uint64,
-              "uint128": uint128, "bool": boolean}
+_VEC_ELEMS = {"uint8": uint8, "uint16": uint16, "uint32": uint32,
+              "uint64": uint64, "uint128": uint128, "uint256": uint256,
+              "bool": boolean}
 
 
 def resolve_case_type(handler: str, case_name: str):

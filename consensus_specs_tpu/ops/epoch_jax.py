@@ -159,11 +159,24 @@ def extract_delta_inputs(spec, state) -> DeltaInputs:
     )
 
 
+def delta_scalars(inp: DeltaInputs) -> np.ndarray:
+    """THE scalar vector layout _deltas_kernel unpacks positionally —
+    single definition so every caller (attestation_deltas, the fused
+    merkle-resident program, the graft entry) stays in lockstep."""
+    return np.array([
+        inp.total_balance, inp.sqrt_total, inp.finality_delay,
+        inp.base_reward_factor, inp.base_rewards_per_epoch,
+        inp.proposer_reward_quotient, inp.inactivity_penalty_quotient,
+        inp.min_epochs_to_inactivity_penalty,
+        inp.effective_balance_increment,
+    ], dtype=np.int64)
+
+
 def _deltas_kernel(eff, eligible, source_part, target_part, head_part,
                    incl_delay, incl_proposer, scalars):
-    """Pure-JAX deltas. ``scalars`` is an int64 vector:
-    [total_balance, sqrt_total, finality_delay, BRF, BRPE, PRQ, IPQ,
-     MIN_EPOCHS_LEAK, EBI]."""
+    """Pure-JAX deltas. ``scalars`` is an int64 vector in the
+    delta_scalars() order: [total_balance, sqrt_total, finality_delay,
+    BRF, BRPE, PRQ, IPQ, MIN_EPOCHS_LEAK, EBI]."""
     (total_balance, sqrt_total, finality_delay, brf, brpe, prq, ipq,
      min_leak, ebi) = [scalars[i] for i in range(9)]
 
@@ -251,12 +264,7 @@ def attestation_deltas(inp: DeltaInputs):
             return a
         return np.concatenate([a, np.full(n_pad - n, fill, dtype=a.dtype)])
 
-    scalars = np.array([
-        inp.total_balance, inp.sqrt_total, inp.finality_delay,
-        inp.base_reward_factor, inp.base_rewards_per_epoch,
-        inp.proposer_reward_quotient, inp.inactivity_penalty_quotient,
-        inp.min_epochs_to_inactivity_penalty, inp.effective_balance_increment,
-    ], dtype=np.int64)
+    scalars = delta_scalars(inp)
 
     dev = _kernel_device()
     put = (lambda a: jax.device_put(a, dev)) if dev is not None else jnp.asarray

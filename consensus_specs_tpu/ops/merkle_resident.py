@@ -165,6 +165,125 @@ class ResidentPackedU64List:
         return merkle_root(self.as_backing_node())
 
 
+# ---------------------------------------------------------------------------
+# Shipping-path integration: "residency composes"
+# ---------------------------------------------------------------------------
+# The epoch transition's process_rewards_and_penalties rewrites the WHOLE
+# balances vector.  The fused program below runs the deltas kernel, the
+# clipped balance update AND the full merkle reduction of the new vector as
+# ONE jit dispatch — the kernel's output is consumed by the hasher on
+# device, never shipped back up for hashing.  The spec substitution
+# (specs/builder.py _install_phase0_epoch_kernel) then memoizes the
+# device-computed subtree root into the freshly written host backing via
+# memoize_packed_u64_contents_root(), so the next hash_tree_root(state) —
+# the per-slot state-root cache of process_slots included — skips the
+# balances subtree entirely.  Reference seam unchanged:
+# eth2spec/utils/ssz/ssz_impl.py:8-13.
+
+RESIDENT_MIN = 16_384  # below this, host hashing of the subtree is trivial
+
+
+def resident_device():
+    """Device for the fused epoch+merkle program, or None to stay on the
+    host path.  Policy (CSTPU_RESIDENT_MERKLE): '0' = off, '1' = force on
+    the default backend, 'auto' (default) = engage only when the default
+    JAX backend is an accelerator.  Measured basis for 'auto'
+    (BENCH_DETAILS hash_tree_root_state): the XLA SHA-256 reduction beats
+    hashlib on the TPU but loses ~4x on the host CPU backend."""
+    import os
+
+    mode = os.environ.get("CSTPU_RESIDENT_MERKLE", "auto")
+    if mode == "0":
+        return None
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return None
+    if mode == "1":
+        return dev
+    return dev if dev.platform != "cpu" else None
+
+
+def _fused_epoch_balances(balances, eff, eligible, source_part, target_part,
+                          head_part, incl_delay, incl_proposer, scalars):
+    from .epoch_jax import _deltas_kernel
+
+    rewards, penalties = _deltas_kernel(
+        eff, eligible, source_part, target_part, head_part,
+        incl_delay, incl_proposer, scalars)
+    increased = balances + rewards
+    new_bal = jnp.where(penalties > increased, 0, increased - penalties)
+    # padded lanes carry balance 0 and zero deltas, so the zero-padded
+    # chunk tail the SSZ merkleization demands is preserved
+    lo = new_bal.astype(jnp.uint32)
+    hi = (new_bal >> 32).astype(jnp.uint32)
+    return new_bal, _reduce_to_root(lo, hi)
+
+
+_jit_fused = jax.jit(_fused_epoch_balances)
+
+
+def fused_epoch_balance_update(inp, balances: np.ndarray, device):
+    """DeltaInputs + current balances -> (new balances [n] int64 numpy,
+    padded-subtree root bytes).  One device program; the root reduction
+    reads the kernel's output vector in place."""
+    n = balances.shape[0]
+    n_pad = max(4, 1 << (n - 1).bit_length() if n > 1 else 1)
+
+    def pad(a, fill=0):
+        if n_pad == n:
+            return a
+        return np.concatenate([a, np.full(n_pad - n, fill, dtype=a.dtype)])
+
+    from .epoch_jax import delta_scalars
+
+    scalars = delta_scalars(inp)
+
+    put = lambda a: jax.device_put(a, device)  # noqa: E731
+    new_bal, root_words = _jit_fused(
+        put(pad(balances.astype(np.int64))),
+        put(pad(inp.effective_balance)),
+        put(pad(inp.eligible.astype(bool))),
+        put(pad(inp.source_part.astype(bool))),
+        put(pad(inp.target_part.astype(bool))),
+        put(pad(inp.head_part.astype(bool))),
+        put(pad(inp.incl_delay, fill=1)),
+        put(pad(inp.incl_proposer)),
+        put(scalars),
+    )
+    stats["fused_epoch_updates"] += 1
+    return (np.asarray(new_bal)[:n],
+            np.asarray(root_words).astype(">u4").tobytes())
+
+
+def memoize_packed_u64_contents_root(view, padded_root: bytes) -> None:
+    """Install a device-computed subtree root into a packed uint64 List
+    view freshly rewritten by bulk.set_packed_uint64_from_numpy: fold the
+    padded-power-of-two root up to the list's virtual contents depth with
+    shared zero hashes (a handful of host hashes) and memoize it on the
+    still-unhashed contents node.  hash_tree_root output is bit-identical
+    to the host path — pinned by tests/test_merkle_resident.py."""
+    import hashlib
+
+    cls = type(view)
+    backing = view.get_backing()
+    contents = backing.left
+    if contents._root is not None:
+        return  # already hashed (nothing to save)
+    n = len(view)
+    n_chunks = max((n + 3) // 4, 1)
+    n_chunks_pad = 1 << (n_chunks - 1).bit_length() if n_chunks > 1 else 1
+    root = padded_root
+    for d in range((n_chunks_pad - 1).bit_length(), cls.contents_depth()):
+        root = hashlib.sha256(root + ZERO_HASHES[d]).digest()
+    contents._root = root
+    stats["roots_memoized"] += 1
+
+
+# engagement counters (bench/tests introspection)
+stats = {"fused_epoch_updates": 0, "roots_memoized": 0}
+
+
 def replace_field_subtree(backing: Node, field_index: int, depth: int,
                           new_node: Node) -> Node:
     """Rebuild the spine of a container backing with one field's subtree

@@ -347,7 +347,16 @@ def _install_deferred_block_verification(g: Dict[str, Any]) -> None:
     substitution (SURVEY §7; reference analogue setup.py:488-492).  Failure
     ordering is preserved by the context manager: the AssertionError names
     the first failing check in sequential call order.  Differential tests:
-    tests/spec/phase0/test_batch_verification.py."""
+    tests/spec/phase0/test_batch_verification.py.
+
+    CONTRACT — state mutation on failure: execution is optimistic, so an
+    operation whose aggregate signature is invalid has already mutated
+    ``state`` (e.g. an attester slashing applied) by the time the deferred
+    settlement raises at scope exit.  The sequential reference path asserts
+    BEFORE applying.  Callers must therefore treat ``state`` as poisoned
+    whenever process_block raises — exactly what every in-repo caller
+    (state_transition wrappers, the test harness, gen runners) already
+    does by discarding the failed state object."""
     from consensus_specs_tpu.crypto import bls as bls_mod
 
     orig = g["process_block"]
@@ -448,7 +457,7 @@ def _install_phase0_epoch_kernel(g: Dict[str, Any]) -> None:
     vectorized JAX deltas kernel + bulk balance write (SURVEY §7 step 7;
     sanctioned-substitution pattern of reference setup.py:65-68).
     Differential test: tests/spec/phase0/test_epoch_kernel.py."""
-    from consensus_specs_tpu.ops import epoch_jax
+    from consensus_specs_tpu.ops import epoch_jax, merkle_resident
     from consensus_specs_tpu.ssz import bulk
 
     proxy = _LiveSpecProxy(g)
@@ -470,8 +479,22 @@ def _install_phase0_epoch_kernel(g: Dict[str, Any]) -> None:
     def process_rewards_and_penalties(state):
         if g["get_current_epoch"](state) == g["GENESIS_EPOCH"]:
             return
-        rewards, penalties = epoch_jax.attestation_deltas_for_state(proxy, state)
+        inp = epoch_jax.extract_delta_inputs(proxy, state)
         balances = bulk.packed_uint64_to_numpy(state.balances)
+        device = (merkle_resident.resident_device()
+                  if len(balances) >= merkle_resident.RESIDENT_MIN else None)
+        if device is not None:
+            # residency composes: deltas kernel + balance update + merkle
+            # reduction in ONE device program; the device-computed subtree
+            # root is memoized into the fresh backing so the next state
+            # root never hashes the balances subtree on host
+            new_balances, padded_root = merkle_resident.fused_epoch_balance_update(
+                inp, balances, device)
+            bulk.set_packed_uint64_from_numpy(state.balances, new_balances)
+            merkle_resident.memoize_packed_u64_contents_root(
+                state.balances, padded_root)
+            return
+        rewards, penalties = epoch_jax.attestation_deltas(inp)
         increased = balances + rewards
         new_balances = np.where(penalties > increased, 0, increased - penalties)
         bulk.set_packed_uint64_from_numpy(state.balances, new_balances)
