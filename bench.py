@@ -744,6 +744,14 @@ def main():
         pass
 
     repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        # achieved-vs-peak accounting on every chip-measured device row
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        import mfu
+
+        mfu.annotate(results)
+    except Exception as exc:  # accounting must never kill the headline
+        print(f"MFU annotation failed: {exc!r}", file=sys.stderr)
     with open(os.path.join(repo, "BENCH_DETAILS.json"), "w") as f:
         json.dump(results, f, indent=2)
 

@@ -139,19 +139,20 @@ def _check_pairs_batch(
 # batch APIs ------------------------------------------------------------------
 
 
-def batch_fast_aggregate_verify(
+def marshal_fast_aggregate_items(
     pubkeys_lists: Sequence[Sequence[bytes]],
     messages: Sequence[bytes],
     signatures: Sequence[bytes],
-) -> List[bool]:
-    """One device call deciding FastAggregateVerify for B items.
-
-    Malformed/out-of-subgroup inputs, infinity pubkeys, and empty pubkey
-    lists yield False for that item (never an exception), mirroring the
-    selector's Verify-family contract (crypto/bls/__init__.py)."""
+) -> Tuple[List[bool], List[Tuple[int, List[Tuple[Point, Point]]]]]:
+    """Host-side per-item marshalling shared by the single-device and
+    mesh-sharded (parallel/bls_sharded.py) batch verifiers: signature and
+    pubkey decompression + subgroup checks (cached), pubkey aggregation,
+    hash-to-curve.  Returns ``(results, todo)``: the B-long verdict list
+    prefilled False (malformed/empty items stay False) and the pairing
+    pairs for every structurally valid item."""
     B = len(pubkeys_lists)
     assert len(messages) == len(signatures) == B
-    results = np.zeros(B, dtype=bool)
+    results: List[bool] = [False] * B
     todo: List[Tuple[int, List[Tuple[Point, Point]]]] = []
     for b in range(B):
         try:
@@ -172,6 +173,21 @@ def batch_fast_aggregate_verify(
             todo.append((b, [(agg, h), (_NEG_G1_GEN, sig)]))
         except (DeserializationError, ValueError):
             continue
+    return results, todo
+
+
+def batch_fast_aggregate_verify(
+    pubkeys_lists: Sequence[Sequence[bytes]],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> List[bool]:
+    """One device call deciding FastAggregateVerify for B items.
+
+    Malformed/out-of-subgroup inputs, infinity pubkeys, and empty pubkey
+    lists yield False for that item (never an exception), mirroring the
+    selector's Verify-family contract (crypto/bls/__init__.py)."""
+    results, todo = marshal_fast_aggregate_items(
+        pubkeys_lists, messages, signatures)
     if todo:
         # pad to a power-of-two bucket (min 2): bounded set of compiled
         # batch shapes, shared across callers.  Pad with an infinity-free

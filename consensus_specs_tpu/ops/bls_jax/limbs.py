@@ -254,7 +254,9 @@ def inv(a):
         acc = jnp.where(bit > 0, mul(acc, a), acc)
         return acc, None
 
-    init = jnp.broadcast_to(jnp.asarray(MONT_ONE_LIMBS), a.shape)
+    # a * 0 (not a broadcast constant) so the carry inherits the input's
+    # varying-axes type under shard_map; XLA folds the zero-add
+    init = a * 0 + jnp.asarray(MONT_ONE_LIMBS)
     out, _ = jax.lax.scan(body, init, _P_MINUS_2_BITS)
     return out
 

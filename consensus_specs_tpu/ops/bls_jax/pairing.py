@@ -167,12 +167,13 @@ def _miller_product(px, py, qx, qy):
     px, py: [K, B, 16] Fq (Montgomery); qx, qy: [K, B, 2, 16] Fq2.
     Returns f: [B, 6, 2, 16].
     """
-    Bn = px.shape[1]
     X, Y = qx, qy
-    Z = jnp.broadcast_to(jnp.asarray(_MONT_ONE_FQ2), qx.shape)
-    f = jnp.asarray(
-        np.broadcast_to(tower.FQ12_ONE_LIMBS,
-                        (Bn,) + tower.FQ12_ONE_LIMBS.shape))
+    # derive the loop carries from the inputs (qx * 0, not broadcast
+    # constants): under shard_map the fori_loop carries must share the
+    # inputs' varying-axes type; XLA folds the zero-adds either way
+    Z = qx * 0 + jnp.asarray(_MONT_ONE_FQ2)
+    vzero = (qx * 0)[0, :, 0, :]  # [B, 16] varying zeros
+    f = vzero[:, None, None, :] + jnp.asarray(tower.FQ12_ONE_LIMBS)
     for n_dbl, has_add in _SEGMENTS:
         f, X, Y, Z = _dbl_run(f, X, Y, Z, px, py, n_dbl)
         if has_add:
@@ -196,8 +197,10 @@ def _exp_x(g):
     return _conj12(_exp_abs_x(g))
 
 
-def final_exp_is_one(f):
-    """final_exponentiation(f) == 1, via f^(3*(p^12-1)/r) == 1."""
+def final_exp_is_one_traced(f):
+    """final_exponentiation(f) == 1 as a traced jnp bool array — usable
+    inside jit/shard_map (the sharded verification lane in
+    parallel/bls_sharded.py shards the batch axis of this whole pipeline)."""
     # easy part: f^((p^6-1)(p^2+1)) — lands in the cyclotomic subgroup
     easy = _mul12(_conj12(f), _inv12(f))
     easy = _mul12(_frob2_12(easy), easy)
@@ -208,7 +211,12 @@ def final_exp_is_one(f):
     c = _exp_abs_x(_exp_abs_x(b))                       # b^(x^2)
     d = _mul12(_mul12(c, _frob2_12(b)), _conj12(b))     # ^(x^2+p^2-1)
     f3 = _mul12(_mul12(_sq_run(easy, 1), easy), d)      # * f^3
-    return np.asarray(_is_one(f3))
+    return _is_one(f3)
+
+
+def final_exp_is_one(f):
+    """final_exponentiation(f) == 1, via f^(3*(p^12-1)/r) == 1."""
+    return np.asarray(final_exp_is_one_traced(f))
 
 
 def pairs_product_is_one(px, py, qx, qy) -> np.ndarray:

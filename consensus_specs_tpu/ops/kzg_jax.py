@@ -118,10 +118,11 @@ def _msm_lanes(px, py, bits):
 
     px, py: [N, 16] affine Montgomery limbs; bits: [255, N] int32
     (MSB-first).  Returns jacobian [N, 16] triples."""
-    N = px.shape[0]
-    X = jnp.zeros((N, limbs.N_LIMBS), dtype=jnp.int64)
-    Y = jnp.broadcast_to(jnp.asarray(limbs.MONT_ONE_LIMBS), (N, limbs.N_LIMBS))
-    Z = jnp.zeros((N, limbs.N_LIMBS), dtype=jnp.int64)  # infinity
+    # derive the carry from the inputs (px * 0, not jnp.zeros): under
+    # shard_map the scan carry must share the inputs' varying-axes type
+    X = px * 0
+    Y = px * 0 + jnp.asarray(limbs.MONT_ONE_LIMBS)
+    Z = px * 0  # infinity
 
     def step(carry, bit_row):
         X, Y, Z = carry
@@ -153,19 +154,14 @@ def _points_to_limbs(points: Sequence[Point]) -> tuple:
     return px, py
 
 
-def batch_scalar_mul(points: Sequence[Point], scalars: Sequence[int]) -> List[Point]:
-    """[k_i * P_i] for all lanes in one device dispatch."""
+def _limbs_to_points(X: np.ndarray, Y: np.ndarray, Z: np.ndarray) -> List[Point]:
+    """Jacobian Montgomery limb triples -> host curve points (shared by the
+    single-device and mesh-sharded lanes)."""
     from consensus_specs_tpu.crypto.bls.curve import B_G1
     from consensus_specs_tpu.crypto.bls.fields import Fq
 
-    assert len(points) == len(scalars)
-    px, py = _points_to_limbs(points)
-    bits = _to_bits(scalars)
-    dev = _msm_device()
-    put = (lambda a: jax.device_put(a, dev)) if dev is not None else jnp.asarray
-    X, Y, Z = (np.asarray(a) for a in _msm_lanes(put(px), put(py), put(bits)))
     out = []
-    for i in range(len(points)):
+    for i in range(X.shape[0]):
         z = limbs.host_from_mont(Z[i])
         if z == 0:
             out.append(g1_infinity())
@@ -179,9 +175,73 @@ def batch_scalar_mul(points: Sequence[Point], scalars: Sequence[int]) -> List[Po
     return out
 
 
+def batch_scalar_mul(points: Sequence[Point], scalars: Sequence[int]) -> List[Point]:
+    """[k_i * P_i] for all lanes in one device dispatch."""
+    assert len(points) == len(scalars)
+    px, py = _points_to_limbs(points)
+    bits = _to_bits(scalars)
+    dev = _msm_device()
+    put = (lambda a: jax.device_put(a, dev)) if dev is not None else jnp.asarray
+    X, Y, Z = (np.asarray(a) for a in _msm_lanes(put(px), put(py), put(bits)))
+    return _limbs_to_points(X, Y, Z)
+
+
 def msm(points: Sequence[Point], scalars: Sequence[int]) -> Point:
     """sum_i k_i * P_i: device per-lane products, host tail sum."""
     acc = g1_infinity()
     for p in batch_scalar_mul(points, scalars):
+        acc = acc + p
+    return acc
+
+
+# --- mesh-sharded lane (the TP axis of SURVEY §2.7: one large MSM split
+# over cores) ----------------------------------------------------------------
+
+
+# jitted shard_map wrappers cached per (mesh, axis): jit keys on callable
+# identity, so rebuilding the wrapper per call would recompile the 255-step
+# scan every time
+_SHARDED_MSM_CACHE: dict = {}
+
+
+def _sharded_msm_fn(mesh, axis: str):
+    key = (mesh, axis)
+    fn = _SHARDED_MSM_CACHE.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec as P
+
+        fn = jax.jit(jax.shard_map(
+            _msm_lanes,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(None, axis)),
+            out_specs=(P(axis), P(axis), P(axis)),
+        ))
+        _SHARDED_MSM_CACHE[key] = fn
+    return fn
+
+
+def sharded_batch_scalar_mul(mesh, points: Sequence[Point],
+                             scalars: Sequence[int],
+                             axis: str = "v") -> List[Point]:
+    """[k_i * P_i] with the lane axis sharded over a device mesh.
+
+    The scan body is purely elementwise over lanes, so the shard_map needs
+    no collectives — each device runs its lanes' double-and-add chains;
+    the host gathers and tail-sums.  Lane count must divide by the mesh
+    size.  Bit-exact vs batch_scalar_mul/host (tests/test_sharded_lanes.py;
+    executed in the driver's multichip dryrun)."""
+    assert len(points) == len(scalars)
+    D = int(np.prod(mesh.devices.shape))
+    assert len(points) % D == 0, f"{len(points)} lanes over {D} devices"
+    px, py = _points_to_limbs(points)
+    bits = _to_bits(scalars)
+    X, Y, Z = (np.asarray(a) for a in _sharded_msm_fn(mesh, axis)(px, py, bits))
+    return _limbs_to_points(X, Y, Z)
+
+
+def sharded_msm(mesh, points: Sequence[Point], scalars: Sequence[int]) -> Point:
+    """Mesh-sharded MSM: per-device lane products + host tail sum."""
+    acc = g1_infinity()
+    for p in sharded_batch_scalar_mul(mesh, points, scalars):
         acc = acc + p
     return acc

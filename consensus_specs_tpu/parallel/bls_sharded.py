@@ -1,0 +1,110 @@
+"""Sharded BLS batch verification: the pairing-product check split over a
+device mesh along the batch axis.
+
+The block-processing workload is B independent aggregate checks (SURVEY
+§2.7: "#1 TPU target"; reference workload phase0/beacon-chain.md:1807-1833
+— one FastAggregateVerify per attestation).  Each item's Miller loop +
+final exponentiation is a self-contained limb program with NO cross-item
+data flow, so the scale-out seam is pure data parallelism: shard the [K,
+B, ...] limb tensors on B, run the whole pipeline per shard, gather the
+[B] verdict bits.  The only collective is the implicit output gather —
+exactly the shape that rides ICI for free.
+
+Bit-exactness vs the host oracle is pinned by tests/test_sharded_lanes.py
+and executed in the driver's multichip dryrun (__graft_entry__).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from consensus_specs_tpu.ops.bls_jax import pairing
+
+# compiled per (mesh, axis): jit keys on callable identity, so a fresh
+# wrapper per call would recompile the Miller-loop pipeline every time
+_SHARDED_CHECK_CACHE: dict = {}
+
+
+def make_sharded_pairs_check(mesh: Mesh, axis: str = "v"):
+    """Compile prod_k e(P_k, Q_k) == 1 per item, batch axis sharded.
+
+    Returns fn(px, py, qx, qy) -> bool [B]; px, py are [K, B, 16] and
+    qx, qy [K, B, 2, 16] Montgomery limb tensors (bls_jax marshalling),
+    B divisible by the mesh size.
+    """
+    key = (mesh, axis)
+    fn = _SHARDED_CHECK_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def body(px, py, qx, qy):
+        f = pairing._miller_product(px, py, qx, qy)
+        return pairing.final_exp_is_one_traced(f)
+
+    fn = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(None, axis), P(None, axis),
+                      P(None, axis), P(None, axis)),
+            out_specs=P(axis),
+        )
+    )
+    _SHARDED_CHECK_CACHE[key] = fn
+    return fn
+
+
+def sharded_batch_fast_aggregate_verify(
+    mesh: Mesh,
+    pubkeys_lists: Sequence[Sequence[bytes]],
+    messages: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> List[bool]:
+    """FastAggregateVerify for B items with the pairing batch sharded over
+    the mesh.  Host marshalling is the SAME code path as the single-device
+    backend (bls_jax.marshal_fast_aggregate_items); infinity-carrying
+    items (no affine limb form) drop to the host oracle per the bls_jax
+    policy; the rest are padded with a copy of the first item up to a
+    mesh-size multiple and decided in one sharded device program."""
+    from consensus_specs_tpu.crypto.bls.pairing import pairings_are_identity
+    from consensus_specs_tpu.ops.bls_jax import (
+        _g1_coords,
+        _g2_coords,
+        limbs,
+        marshal_fast_aggregate_items,
+    )
+
+    results, todo = marshal_fast_aggregate_items(
+        pubkeys_lists, messages, signatures)
+    clean = []
+    for b, pairs in todo:
+        if any(p.is_infinity() or q.is_infinity() for p, q in pairs):
+            results[b] = bool(pairings_are_identity(pairs))
+        else:
+            clean.append((b, pairs))
+    if not clean:
+        return results
+
+    D = int(np.prod(mesh.devices.shape))
+    n = len(clean)
+    padded = [pairs for _, pairs in clean]
+    while len(padded) % D:
+        padded.append(padded[0])
+    K, Bp = 2, len(padded)
+    px = np.zeros((K, Bp, limbs.N_LIMBS), dtype=np.int64)
+    py = np.zeros_like(px)
+    qx = np.zeros((K, Bp, 2, limbs.N_LIMBS), dtype=np.int64)
+    qy = np.zeros_like(qx)
+    for b, ps in enumerate(padded):
+        for k, (p, q) in enumerate(ps):
+            px[k, b], py[k, b] = _g1_coords(p)
+            qx[k, b], qy[k, b] = _g2_coords(q)
+    check = make_sharded_pairs_check(mesh)
+    verdicts = np.asarray(check(px, py, qx, qy))
+    for (b, _), v in zip(clean, verdicts[:n]):
+        results[b] = bool(v)
+    return results

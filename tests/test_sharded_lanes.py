@@ -1,0 +1,81 @@
+"""Mesh-sharded scale-out seams: KZG MSM lane split and BLS pairing-batch
+split, bit-exact vs their host oracles (parallel/bls_sharded.py,
+ops/kzg_jax.sharded_msm; executed at driver time by __graft_entry__'s
+multichip dryrun).  Runs on the 8-virtual-device CPU mesh the conftest
+pins."""
+import numpy as np
+import pytest
+
+import jax
+
+from consensus_specs_tpu.parallel import build_mesh
+
+
+def _mesh(n=4):
+    return build_mesh(n, devices=jax.devices()[:n])
+
+
+def test_sharded_kzg_msm_matches_host():
+    from consensus_specs_tpu.crypto import fr, kzg
+    from consensus_specs_tpu.ops.kzg_jax import sharded_msm
+
+    mesh = _mesh(4)
+    pts = kzg.setup_monomial(8)
+    scalars = [1, 0, fr.R - 1, 12345, 7, 2**200 % fr.R, 3, fr.R - 2]
+    assert sharded_msm(mesh, pts, scalars) == kzg.g1_lincomb(pts, scalars)
+
+
+def test_sharded_batch_scalar_mul_matches_pointwise():
+    from consensus_specs_tpu.crypto import fr, kzg
+    from consensus_specs_tpu.ops.kzg_jax import sharded_batch_scalar_mul
+
+    mesh = _mesh(4)
+    pts = kzg.setup_monomial(4)
+    scalars = [5, 0, fr.R - 1, 99]
+    got = sharded_batch_scalar_mul(mesh, pts, scalars)
+    for p, s, o in zip(pts, scalars, got):
+        assert o == p.mul(s % fr.R)
+
+
+def test_sharded_bls_batch_verify_matches_oracle():
+    from consensus_specs_tpu.crypto.bls import ciphersuite as cs
+    from consensus_specs_tpu.parallel.bls_sharded import (
+        sharded_batch_fast_aggregate_verify,
+    )
+
+    mesh = _mesh(4)
+    pk_lists, msgs, sigs = [], [], []
+    for b in range(4):
+        sk1, sk2 = 300 + 2 * b, 301 + 2 * b
+        msg = bytes([0x40 + b]) * 32
+        pk_lists.append([cs.SkToPk(sk1), cs.SkToPk(sk2)])
+        sig = cs.Aggregate([cs.Sign(sk1, msg), cs.Sign(sk2, msg)])
+        if b == 2:
+            msg = b"\xAA" * 32  # wrong message: must fail
+        msgs.append(msg)
+        sigs.append(sig)
+    got = sharded_batch_fast_aggregate_verify(mesh, pk_lists, msgs, sigs)
+    assert got == [True, True, False, True]
+    assert all(isinstance(v, bool) for v in got)
+
+
+def test_sharded_bls_rejects_malformed_and_empty():
+    from consensus_specs_tpu.crypto.bls import ciphersuite as cs
+    from consensus_specs_tpu.parallel.bls_sharded import (
+        sharded_batch_fast_aggregate_verify,
+    )
+
+    mesh = _mesh(2)
+    msg = b"\x01" * 32
+    pk = cs.SkToPk(11)
+    sig = cs.Sign(11, msg)
+    got = sharded_batch_fast_aggregate_verify(
+        mesh,
+        [[], [b"\x00" * 48], [pk], [pk]],
+        [msg, msg, msg, msg],
+        [sig, sig, sig, b"\x01" * 96],
+    )
+    assert got[0] is False          # empty pubkey list
+    assert got[1] is False          # malformed pubkey
+    assert got[2] is True           # valid
+    assert got[3] is False          # malformed signature

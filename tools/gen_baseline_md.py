@@ -125,6 +125,27 @@ def build_table(details: dict) -> str:
         lines.append("")
         for key, note in notes:
             lines.append(f"- `{key}`: {note}")
+    # achieved-vs-peak column (tools/mfu.py): one sentence per device row
+    mfu_rows = []
+    for _, _, _, key in rows:
+        row = details.get(key)
+        if isinstance(row, dict) and isinstance(row.get("mfu"), dict):
+            m = row["mfu"]
+            if "skipped" in m:
+                mfu_rows.append((key, f"MFU skipped — {m['skipped']}"))
+            else:
+                pct = (m.get("achieved_fraction") or 0) * 100
+                mfu_rows.append((key, (
+                    f"achieved {_fmt(m.get('achieved_ops_s'))} ops/s = "
+                    f"**{pct:.4g}%** of {m.get('peak_basis')} peak "
+                    f"({_fmt(m.get('peak_ops_s'))}); "
+                    f"bound: {m.get('binding_limit', 'unstated')}")))
+    if mfu_rows:
+        lines.append("")
+        lines.append("**Achieved vs peak (utilization, tools/mfu.py):**")
+        lines.append("")
+        for key, txt in mfu_rows:
+            lines.append(f"- `{key}`: {txt}")
     ctx = details.get("_load_context", {})
     if ctx:
         lines.append("")

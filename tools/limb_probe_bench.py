@@ -128,6 +128,14 @@ def main() -> None:
     except Exception as exc:  # probe resilience: record, don't lose the rest
         report["mxu_error"] = repr(exc)[:300]
 
+    try:
+        # achieved-vs-peak accounting regenerates with every probe run
+        import mfu
+
+        mfu.annotate_limb_probe(report)
+    except Exception as exc:
+        report["mxu_mfu_error"] = repr(exc)[:200]
+
     with open("LIMB_PROBE.json", "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report, indent=1))
