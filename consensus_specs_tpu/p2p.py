@@ -169,3 +169,151 @@ def blobs_sidecar_request_bounds(current_epoch: int, genesis_epoch: int = 0):
     low = max(genesis_epoch,
               current_epoch - MIN_EPOCHS_FOR_BLOBS_SIDECARS_REQUESTS)
     return low, current_epoch
+
+
+# -- sharding shard-blob gossip layer (sharding/p2p-interface.md) -----------
+#
+# The reference never compiles this document (prose-only WIP); here the
+# constants, topic names, subnet mapping, and the statically-checkable
+# subset of the gossip validation rules are executable against the
+# compiled sharding spec module.
+
+SHARD_BLOB_SUBNET_COUNT = 64       # sharding/p2p-interface.md:38
+SHARD_TX_PROPAGATION_GRACE_SLOTS = 4    # :39
+SHARD_TX_PROPAGATION_BUFFER_SLOTS = 8   # :40
+
+
+def shard_blob_subnet_topic(fork_digest: bytes, subnet_id: int) -> str:
+    """`shard_blob_{subnet_id}` — SignedShardBlob (sharding/p2p:51)."""
+    return gossip_topic(fork_digest, f"shard_blob_{subnet_id}")
+
+
+def shard_blob_header_topic(fork_digest: bytes) -> str:
+    """Global `shard_blob_header` — SignedShardBlobHeader (:52)."""
+    return gossip_topic(fork_digest, "shard_blob_header")
+
+
+def shard_blob_tx_topic(fork_digest: bytes) -> str:
+    """Global `shard_blob_tx` — builder-signed SignedShardBlobHeader (:53)."""
+    return gossip_topic(fork_digest, "shard_blob_tx")
+
+
+def shard_proposer_slashing_topic(fork_digest: bytes) -> str:
+    """Global `shard_proposer_slashing` — ShardProposerSlashing (:54)."""
+    return gossip_topic(fork_digest, "shard_proposer_slashing")
+
+
+def compute_subnet_for_shard_blob(spec, state, slot, shard) -> int:
+    """Subnet for a shard-blob publication (sharding/p2p-interface.md:67-77
+    — mimics compute_subnet_for_attestation)."""
+    committee_index = int(spec.compute_committee_index_from_shard(
+        state, slot, shard))
+    committees_per_slot = int(spec.get_committee_count_per_slot(
+        state, spec.compute_epoch_at_slot(slot)))
+    slots_since_epoch_start = int(slot) % int(spec.SLOTS_PER_EPOCH)
+    committees_since_epoch_start = committees_per_slot * slots_since_epoch_start
+    return (committees_since_epoch_start + committee_index) \
+        % SHARD_BLOB_SUBNET_COUNT
+
+
+def validate_shard_blob_gossip(spec, state, signed_blob, current_slot: int,
+                               subnet_id: int) -> str:
+    """The statically-checkable subset of the `shard_blob_{subnet_id}`
+    validation rules (sharding/p2p-interface.md:80-104).  Returns
+    'accept', 'ignore', or 'reject'.  Signature/fee/first-seen rules need
+    node-local context (peer store, dedup cache) and stay with the caller."""
+    blob = signed_blob.message
+    if int(blob.slot) > current_slot + 1:
+        return "ignore"  # published >1 slot early
+    if int(spec.compute_epoch_at_slot(blob.slot)) < \
+            int(spec.get_previous_epoch(state)):
+        return "ignore"  # too old to process
+    epoch = spec.compute_epoch_at_slot(blob.slot)
+    if int(blob.shard) >= int(spec.get_active_shard_count(state, epoch)):
+        return "reject"  # inactive shard
+    try:
+        spec.compute_committee_index_from_shard(state, blob.slot, blob.shard)
+    except AssertionError:
+        return "reject"  # no committee for this shard at this slot
+    if compute_subnet_for_shard_blob(
+            spec, state, blob.slot, blob.shard) != subnet_id:
+        return "reject"  # wrong subnet
+    if any(int(p) >= spec.MODULUS for p in blob.body.data):
+        return "reject"  # non-canonical field point
+    return "accept"
+
+
+def validate_shard_blob_tx_window(current_slot: int, header_slot: int) -> str:
+    """The `shard_blob_tx` propagation window (sharding/p2p:148-151)."""
+    if header_slot > current_slot + SHARD_TX_PROPAGATION_BUFFER_SLOTS:
+        return "ignore"  # too early
+    if header_slot + SHARD_TX_PROPAGATION_GRACE_SLOTS < current_slot:
+        return "ignore"  # too late
+    return "accept"
+
+
+# -- DAS sample transport (das/p2p-interface.md) ----------------------------
+#
+# Push: vertical `das_sample_{subnet_index}` gossip subnets; horizontal
+# reuse of the shard-blob subnets for fan-out reconstruction.  Pull:
+# DASQuery under the dedicated `/eth2/das/req` protocol prefix.
+
+DAS_SUBNET_COUNT = 256  # vertical subnets; the reference doc sizes this
+#                         only as "many tiny samples" — fixed here so the
+#                         mapping below is executable
+
+DAS_QUERY_PROTOCOL_ID = "/eth2/das/req/query/1/"  # das/p2p-interface.md:203
+
+
+class DASQueryRequest(Container):
+    """DASQuery request content (das/p2p-interface.md:205-210)."""
+    sample_index: uint64
+
+
+def das_sample_subnet_topic(fork_digest: bytes, subnet_index: int) -> str:
+    """`das_sample_{subnet_index}` — DASSample (das/p2p:147-149)."""
+    return gossip_topic(fork_digest, f"das_sample_{subnet_index}")
+
+
+def compute_subnet_for_das_sample(shard: int, slot: int, sample_index: int,
+                                  subnet_count: int = DAS_SUBNET_COUNT) -> int:
+    """(shard, slot, sample_index) -> vertical subnet index.
+
+    The reference leaves this hash function an explicit TODO
+    (das/p2p-interface.md:111-114: "a simple hash function ... defines
+    which samples go where ... to evenly distribute samples").  This
+    framework's concrete choice: SHA-256 over the little-endian key
+    triple, reduced mod the subnet count — uniform, stateless, and
+    trivially portable."""
+    key = (int(shard).to_bytes(8, "little")
+           + int(slot).to_bytes(8, "little")
+           + int(sample_index).to_bytes(8, "little"))
+    return int.from_bytes(hashlib.sha256(key).digest()[:8], "little") \
+        % subnet_count
+
+
+def validate_das_sample_gossip(spec, state, sample, sample_count: int,
+                               commitment, current_slot: int,
+                               subnet_index: int) -> str:
+    """The statically-checkable subset of the `das_sample_{subnet_index}`
+    validation rules (das/p2p-interface.md:172-185).  Returns 'accept',
+    'ignore', or 'reject'; first-seen/commitment-known bookkeeping stays
+    with the caller."""
+    if compute_subnet_for_das_sample(
+            int(sample.shard), int(sample.slot),
+            int(sample.index)) != subnet_index:
+        return "reject"  # wrong vertical subnet
+    epoch = spec.compute_epoch_at_slot(sample.slot)
+    if int(sample.shard) >= int(spec.get_active_shard_count(state, epoch)):
+        return "reject"  # shard out of range
+    if int(sample.index) >= sample_count:
+        return "reject"  # sample index out of range
+    if int(sample.slot) > current_slot:
+        return "ignore"  # future slot (MAY queue)
+    if any(int(p) >= spec.MODULUS for p in sample.data):
+        return "reject"  # non-canonical field point
+    try:
+        spec.verify_sample(sample, sample_count, commitment)
+    except AssertionError:
+        return "reject"  # KZG proof invalid
+    return "accept"

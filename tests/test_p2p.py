@@ -153,3 +153,170 @@ def test_signed_blobs_sidecar_container_round_trip():
                                 beacon_block_slot=3)
     signed = spec.SignedBlobsSidecar(message=sidecar, signature=b"\x09" * 96)
     assert type(signed).decode_bytes(serialize(signed)) == signed
+
+
+# -- sharding shard-blob gossip layer (sharding/p2p-interface.md) -----------
+
+
+def _sharding_state():
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+    spec = get_spec("sharding", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    return spec, state
+
+
+def test_shard_blob_topics_and_subnet_mapping():
+    from consensus_specs_tpu import p2p
+
+    digest = b"\x01\x02\x03\x04"
+    assert p2p.shard_blob_subnet_topic(digest, 9) == \
+        "/eth2/01020304/shard_blob_9/ssz_snappy"
+    assert p2p.shard_blob_header_topic(digest).endswith(
+        "/shard_blob_header/ssz_snappy")
+    assert p2p.shard_blob_tx_topic(digest).endswith(
+        "/shard_blob_tx/ssz_snappy")
+    assert p2p.shard_proposer_slashing_topic(digest).endswith(
+        "/shard_proposer_slashing/ssz_snappy")
+
+    spec, state = _sharding_state()
+    slot = spec.Slot(3)
+    count = int(spec.get_committee_count_per_slot(
+        state, spec.compute_epoch_at_slot(slot)))
+    seen = set()
+    for index in range(count):
+        shard = spec.compute_shard_from_committee_index(
+            state, slot, spec.CommitteeIndex(index))
+        sub = p2p.compute_subnet_for_shard_blob(spec, state, slot, shard)
+        assert 0 <= sub < p2p.SHARD_BLOB_SUBNET_COUNT
+        seen.add(sub)
+    assert len(seen) == count  # distinct committees -> distinct subnets here
+
+
+def test_shard_blob_gossip_validation_matrix():
+    from consensus_specs_tpu import p2p
+
+    spec, state = _sharding_state()
+    slot = spec.Slot(3)
+    shard = spec.compute_shard_from_committee_index(
+        state, slot, spec.CommitteeIndex(0))
+    subnet = p2p.compute_subnet_for_shard_blob(spec, state, slot, shard)
+
+    def blob(slot=slot, shard=shard, data=(1, 2, 3)):
+        return spec.SignedShardBlob(message=spec.ShardBlob(
+            slot=slot, shard=shard,
+            body=spec.ShardBlobBody(data=list(data))))
+
+    current = int(slot)
+    assert p2p.validate_shard_blob_gossip(
+        spec, state, blob(), current, subnet) == "accept"
+    # >1 slot early -> ignore
+    assert p2p.validate_shard_blob_gossip(
+        spec, state, blob(slot=spec.Slot(current + 2)), current, subnet) \
+        == "ignore"
+    # inactive shard -> reject
+    bad_shard = int(spec.get_active_shard_count(
+        state, spec.compute_epoch_at_slot(slot)))
+    assert p2p.validate_shard_blob_gossip(
+        spec, state, blob(shard=spec.Shard(bad_shard)), current, subnet) \
+        == "reject"
+    # wrong subnet -> reject
+    assert p2p.validate_shard_blob_gossip(
+        spec, state, blob(), current,
+        (subnet + 1) % p2p.SHARD_BLOB_SUBNET_COUNT) == "reject"
+    # non-canonical field point -> reject
+    assert p2p.validate_shard_blob_gossip(
+        spec, state, blob(data=(spec.MODULUS,)), current, subnet) == "reject"
+
+    # tx propagation window (buffer 8 ahead, grace 4 behind)
+    assert p2p.validate_shard_blob_tx_window(100, 108) == "accept"
+    assert p2p.validate_shard_blob_tx_window(100, 109) == "ignore"
+    assert p2p.validate_shard_blob_tx_window(100, 96) == "accept"
+    assert p2p.validate_shard_blob_tx_window(100, 95) == "ignore"
+
+
+# -- DAS sample transport (das/p2p-interface.md) ----------------------------
+
+
+def test_das_sample_subnet_mapping_uniform_and_deterministic():
+    from consensus_specs_tpu import p2p
+
+    subs = [p2p.compute_subnet_for_das_sample(s, 5, i)
+            for s in range(4) for i in range(64)]
+    assert all(0 <= x < p2p.DAS_SUBNET_COUNT for x in subs)
+    assert subs == [p2p.compute_subnet_for_das_sample(s, 5, i)
+                    for s in range(4) for i in range(64)]
+    assert len(set(subs)) > 100  # spreads over many subnets
+
+    assert p2p.DAS_QUERY_PROTOCOL_ID == "/eth2/das/req/query/1/"
+    from consensus_specs_tpu.ssz.impl import serialize
+
+    req = p2p.DASQueryRequest(sample_index=77)
+    assert type(req).decode_bytes(serialize(req)) == req
+
+
+def test_das_sample_gossip_validation_with_real_samples():
+    from consensus_specs_tpu import p2p
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+    spec = get_spec("das", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+
+    from consensus_specs_tpu.crypto import kzg as _kzg
+    from consensus_specs_tpu.crypto.bls.curve import g1_to_bytes
+
+    data = [i + 1 for i in range(int(spec.POINTS_PER_SAMPLE) * 2)]
+    extended = spec.extend_data(data)
+    slot, shard = spec.Slot(2), spec.Shard(0)
+    samples = spec.sample_data(slot, shard, extended)
+    sample_count = len(samples)
+    # commitment the way the das sanity suite builds it: monomial-basis
+    # commitment to the low-degree interpolant of the extended data
+    poly = spec.inverse_fft(
+        spec.reverse_bit_order_list([int(v) for v in extended]))
+    commitment_pt = spec.BLSCommitment(g1_to_bytes(
+        _kzg.g1_lincomb(_kzg.setup_monomial(len(poly)), poly)))
+
+    sample = samples[0]
+    subnet = p2p.compute_subnet_for_das_sample(
+        int(sample.shard), int(sample.slot), int(sample.index))
+    assert p2p.validate_das_sample_gossip(
+        spec, state, sample, sample_count, commitment_pt,
+        current_slot=int(slot), subnet_index=subnet) == "accept"
+    # tampered data -> reject (KZG proof check)
+    tampered = sample.copy()
+    tampered.data[0] = int(tampered.data[0]) ^ 1
+    assert p2p.validate_das_sample_gossip(
+        spec, state, tampered, sample_count, commitment_pt,
+        current_slot=int(slot), subnet_index=subnet) == "reject"
+
+    # wrong subnet -> reject
+    assert p2p.validate_das_sample_gossip(
+        spec, state, sample, sample_count, commitment_pt,
+        current_slot=int(slot),
+        subnet_index=(subnet + 1) % p2p.DAS_SUBNET_COUNT) == "reject"
+    # future slot -> ignore
+    assert p2p.validate_das_sample_gossip(
+        spec, state, sample, sample_count, commitment_pt,
+        current_slot=int(slot) - 1, subnet_index=subnet) == "ignore"
+    # out-of-range index -> reject
+    bad = spec.DASSample(slot=sample.slot, shard=sample.shard,
+                         index=sample_count + 7, proof=sample.proof,
+                         data=sample.data)
+    bad_subnet = p2p.compute_subnet_for_das_sample(
+        int(bad.shard), int(bad.slot), int(bad.index))
+    assert p2p.validate_das_sample_gossip(
+        spec, state, bad, sample_count, commitment_pt,
+        current_slot=int(slot), subnet_index=bad_subnet) == "reject"
