@@ -104,6 +104,17 @@ def build_table(details: dict) -> str:
             f"{_fmt(r.get('sequential_spec_scaled_s'))} s)",
             "altair_epoch"))
 
+    r = details.get("epoch_scale_1m", {})
+    if "value" in r:
+        ratio = r.get("scaling_vs_400k")
+        ratio_txt = (f"; {_fmt(ratio)}× the linear-scaling expectation "
+                     f"vs 400k" if ratio else "")
+        rows.append((
+            "7", "scale probe: epoch transition at 2^20 = 1,048,576 validators",
+            f"**{_fmt(r['value'])} s** warm ({_fmt(r.get('post_root_s'))} s "
+            f"post-root, peak RSS {_fmt(r.get('peak_rss_mb'))} MB{ratio_txt})",
+            "epoch_scale_1m"))
+
     lines = [BEGIN, ""]
     if details.get("_device_fallback"):
         lines += [
