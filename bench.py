@@ -618,32 +618,48 @@ def bench_bls_batches(results):
 
 
 def bench_kzg_msm(results):
-    """BASELINE config 5: blob KZG commitment (G1 MSM) — device per-lane
-    scalar products + host tail vs the pure-host oracle (measured on a
-    subset and scaled; the oracle is naive double-and-add)."""
+    """BASELINE config 5: blob KZG commitment (G1 MSM).  ``value`` is the
+    SHIPPING path — ``blob_to_kzg`` through the native C++ fixed-base
+    Pippenger (r5) — with the Python bucket MSM and the scaled naive
+    oracle as sub-keys."""
     from consensus_specs_tpu.crypto import fr, kzg
-    from consensus_specs_tpu.ops import kzg_jax
+    from consensus_specs_tpu.crypto.bls.curve import g1_to_bytes
 
     n = 4096  # mainnet FIELD_ELEMENTS_PER_BLOB
-    setup = kzg.setup_monomial(n)
+    lagrange = kzg.setup_lagrange(n)
     coeffs = [((i * 0x9E3779B97F4A7C15) ^ 0x5DEECE66D) % fr.R for i in range(n)]
 
-    t_pip, _ = _timed(kzg.g1_msm_pippenger, setup, coeffs)
+    # shipping path: cold pays the one-time table build, warm is the shape
+    # every subsequent blob sees
+    t_ship_cold, c_ship = _timed(kzg.blob_to_kzg, coeffs, lagrange)
+    t_ship, c2 = _timed(kzg.blob_to_kzg, coeffs, lagrange)
+    assert c_ship == c2
+
+    t_pip, c_pip = _timed(
+        lambda: g1_to_bytes(kzg.g1_msm_pippenger(lagrange, coeffs)))
+    assert c_pip == c_ship, "native commitment diverged from python Pippenger"
 
     sub = 128
-    t_naive_sub, _ = _timed(kzg.g1_lincomb, setup[:sub], coeffs[:sub])
+    t_naive_sub, _ = _timed(kzg.g1_lincomb, lagrange[:sub], coeffs[:sub])
     t_naive = t_naive_sub * (n / sub)
 
     results["kzg_blob_commitment"] = {
         "metric": "kzg_blob_commitment_g1_msm_4096",
-        "value": round(1.0 / t_pip, 2),
+        "value": round(1.0 / t_ship, 2),
         "unit": "commitments/s",
-        "pippenger_s_per_blob": round(t_pip, 3),
+        "shipping_s_per_blob": round(t_ship, 4),
+        "shipping_cold_s": round(t_ship_cold, 3),
+        "python_pippenger_s_per_blob": round(t_pip, 3),
         "naive_oracle_scaled_s_per_blob": round(t_naive, 3),
-        "vs_naive_oracle": round(t_naive / t_pip, 1),
-        "note": "device lane-parallel MSM (ops/kzg_jax) exists and is "
-                "differentially tested; int64 limb emulation makes it "
-                "uncompetitive on this chip (CSTPU_KZG_BACKEND=tpu to try)",
+        "vs_python_pippenger": round(t_pip / t_ship, 1),
+        "vs_naive_oracle": round(t_naive / t_ship, 1),
+        "verified_vs_python_pippenger": True,
+        "note": "shipping = native C++ fixed-base Pippenger (one bucket "
+                "pass over precomputed shifted-window tables, batch-affine "
+                "tree reduction); device lane-parallel MSM (ops/kzg_jax) "
+                "exists and is differentially tested; int64 limb emulation "
+                "makes it uncompetitive on this chip "
+                "(CSTPU_KZG_BACKEND=tpu to try)",
     }
 
 

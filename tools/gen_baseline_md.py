@@ -67,9 +67,13 @@ def build_table(details: dict) -> str:
 
     r = details.get("kzg_blob_commitment", {})
     if "value" in r:
+        vs_pip = r.get("vs_python_pippenger")
+        detail = (f"{_fmt(vs_pip)}× python Pippenger, "
+                  if vs_pip else "Pippenger host, ")
         rows.append((
             "5", "KZG blob commitment (4096-point G1 MSM)",
-            f"**{_fmt(r['value'])} commitments/s** (Pippenger host, "
+            f"**{_fmt(r['value'])} commitments/s** "
+            f"({'native fixed-base, ' if vs_pip else ''}{detail}"
             f"{_fmt(r.get('vs_naive_oracle'))}× naive oracle)",
             "kzg_blob_commitment"))
 
