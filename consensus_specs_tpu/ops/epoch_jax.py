@@ -36,7 +36,7 @@ _jaxcache.configure()
 # comparison against FAR_FUTURE therefore tests >= _SAT
 _SAT = 2**63 - 1
 
-_COLS_CACHE: dict = {}
+_COLS_CACHE = None  # RootKeyedCache(4), built lazily (bulk imports jax-free)
 
 
 def registry_columns(state):
@@ -44,14 +44,10 @@ def registry_columns(state):
     registry's tree root (mutation -> new root -> automatic refresh)."""
     from consensus_specs_tpu.ssz import bulk
 
-    root = bytes(state.validators.hash_tree_root())
-    cols = _COLS_CACHE.get(root)
-    if cols is None:
-        if len(_COLS_CACHE) >= 4:
-            _COLS_CACHE.pop(next(iter(_COLS_CACHE)))
-        cols = bulk.validator_columns(state.validators)
-        _COLS_CACHE[root] = cols
-    return cols
+    global _COLS_CACHE
+    if _COLS_CACHE is None:
+        _COLS_CACHE = bulk.RootKeyedCache(4)
+    return _COLS_CACHE.get(state.validators, bulk.validator_columns)
 
 
 def active_mask(cols, epoch: int) -> np.ndarray:

@@ -329,11 +329,39 @@ def _install_optimizations(g: Dict[str, Any]) -> None:
     g["compute_committee"] = compute_committee
 
     _install_registry_vectorization(g)
+    _install_attestation_pubkey_column(g)
     if g["fork"] == "phase0":
         _install_phase0_epoch_kernel(g)
     else:
         _install_altair_epoch_kernel(g)
     _install_deferred_block_verification(g)
+
+
+def _install_attestation_pubkey_column(g: Dict[str, Any]) -> None:
+    """Swap the per-index pubkey gather in is_valid_indexed_attestation
+    (``[state.validators[i].pubkey for i in indices]`` — a tree descent +
+    view materialization per member, ~25k reads per mainnet block) for a
+    registry-root-cached pubkey column read (ssz/bulk.py, one walk per
+    registry version).  Semantics preserved exactly: same emptiness /
+    sorted-unique gate, same IndexError on out-of-range indices, same
+    verification call.  Differential test:
+    tests/spec/phase0/test_pubkey_column.py."""
+    from consensus_specs_tpu.ssz import bulk
+
+    def is_valid_indexed_attestation(state, indexed_attestation):
+        indices = indexed_attestation.attesting_indices
+        if len(indices) == 0 or not indices == sorted(set(indices)):
+            return False
+        column = bulk.cached_validator_pubkeys(state.validators)
+        pubkeys = [column[int(i)] for i in indices]
+        domain = g["get_domain"](state, g["DOMAIN_BEACON_ATTESTER"],
+                                 indexed_attestation.data.target.epoch)
+        signing_root = g["compute_signing_root"](
+            indexed_attestation.data, domain)
+        return g["bls"].FastAggregateVerify(
+            pubkeys, signing_root, indexed_attestation.signature)
+
+    _swap(g, "is_valid_indexed_attestation", is_valid_indexed_attestation)
 
 
 def _install_deferred_block_verification(g: Dict[str, Any]) -> None:

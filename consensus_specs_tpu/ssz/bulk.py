@@ -120,6 +120,51 @@ _V_FIELDS_U64 = (
 )
 
 
+def validator_pubkeys(validators) -> list:
+    """One walk over the registry subtrees -> every validator's pubkey as
+    raw 48-byte strings.  The per-index view path
+    (``state.validators[i].pubkey``) costs a tree descent + view
+    materialization per read; attestation verification reads ~25k pubkeys
+    per block, which makes this column the cheap representation."""
+    et = type(validators).ELEM_TYPE
+    path = _field_path(et._field_index["pubkey"], et._depth)
+    out = []
+    for sub in composite_subtrees(validators):
+        node = _walk(sub, path)
+        # Bytes48 backing: Branch(chunk0, chunk1) with 16 zero tail bytes
+        out.append(node.left._root + node.right._root[:16])
+    return out
+
+
+class RootKeyedCache:
+    """FIFO cache keyed by a view's tree root: any mutation produces a new
+    root, so invalidation is automatic.  THE shared memoizer for derived
+    registry representations (pubkey column here, numeric columns in
+    ops/epoch_jax.registry_columns)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._store: Dict[bytes, object] = {}
+
+    def get(self, view, build):
+        root = bytes(view.hash_tree_root())
+        hit = self._store.get(root)
+        if hit is None:
+            if len(self._store) >= self.capacity:
+                self._store.pop(next(iter(self._store)))
+            hit = build(view)
+            self._store[root] = hit
+        return hit
+
+
+# 2 entries cover the pre/post-epoch registries a transition touches
+_PUBKEY_CACHE = RootKeyedCache(2)
+
+
+def cached_validator_pubkeys(validators) -> list:
+    return _PUBKEY_CACHE.get(validators, validator_pubkeys)
+
+
 def validator_columns(validators) -> Dict[str, np.ndarray]:
     """One walk over the registry subtrees -> all epoch-processing columns.
 

@@ -1,0 +1,101 @@
+"""Differential: the pubkey-column substitution of
+is_valid_indexed_attestation (specs/builder.py
+_install_attestation_pubkey_column) must be behaviorally identical to the
+sequential spec path, including failure semantics."""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.specs.builder import get_spec
+from consensus_specs_tpu.ssz import bulk
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+
+@pytest.fixture(scope="module")
+def env():
+    spec = get_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    spec.process_slots(state, state.slot + 2)
+    att = get_valid_attestation(spec, state, signed=True)
+    spec.process_slots(
+        state, att.data.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    return spec, state, spec.get_indexed_attestation(state, att)
+
+
+def _both(spec, state, indexed):
+    new = spec.is_valid_indexed_attestation(state, indexed)
+    old = spec.is_valid_indexed_attestation.__wrapped__(state, indexed)
+    assert new == old, f"substitution diverged: {new} vs {old}"
+    return new
+
+
+def test_valid_attestation_accepted_by_both(env):
+    spec, state, indexed = env
+    was = bls.bls_active
+    bls.bls_active = True
+    try:
+        assert _both(spec, state, indexed) is True
+    finally:
+        bls.bls_active = was
+
+
+def test_bad_signature_rejected_by_both(env):
+    spec, state, indexed = env
+    bad = indexed.copy()
+    bad.signature = spec.BLSSignature(b"\x01" * 96)
+    was = bls.bls_active
+    bls.bls_active = True
+    try:
+        assert _both(spec, state, bad) is False
+    finally:
+        bls.bls_active = was
+
+
+def test_structural_gates_match(env):
+    spec, state, indexed = env
+    empty = indexed.copy()
+    empty.attesting_indices = []
+    assert _both(spec, state, empty) is False
+
+    if len(indexed.attesting_indices) >= 2:
+        unsorted = indexed.copy()
+        ids = [int(i) for i in indexed.attesting_indices]
+        unsorted.attesting_indices = [ids[1], ids[0]] + ids[2:]
+        assert _both(spec, state, unsorted) is False
+
+    dup = indexed.copy()
+    first = int(indexed.attesting_indices[0])
+    dup.attesting_indices = [first, first]
+    assert _both(spec, state, dup) is False
+
+
+def test_out_of_range_index_raises_in_both(env):
+    spec, state, indexed = env
+    bad = indexed.copy()
+    bad.attesting_indices = [len(state.validators) + 5]
+    with pytest.raises(IndexError):
+        spec.is_valid_indexed_attestation(state, bad)
+    with pytest.raises(IndexError):
+        spec.is_valid_indexed_attestation.__wrapped__(state, bad)
+
+
+def test_column_matches_view_reads_and_tracks_mutation(env):
+    spec, state, _ = env
+    column = bulk.cached_validator_pubkeys(state.validators)
+    assert len(column) == len(state.validators)
+    for i in (0, 1, len(column) - 1):
+        assert column[i] == bytes(state.validators[i].pubkey)
+    # registry mutation -> new root -> fresh column (same pubkeys)
+    st2 = state.copy()
+    st2.validators[0].effective_balance = int(
+        st2.validators[0].effective_balance) - 10**9
+    column2 = bulk.cached_validator_pubkeys(st2.validators)
+    assert column2[0] == column[0]
+    assert len(column2) == len(column)
