@@ -167,6 +167,41 @@ _FIXED_TABLES: dict = {}
 _FIXED_TABLES_MAX = 2
 
 
+def _load_or_build_fixed_table(nat, flat: bytes) -> bytes:
+    """Disk-cached shifted-window table: the ~1-5 s expansion of a blob
+    setup otherwise recurs in every process.  Keyed by (native source
+    digest, points digest) — the entries are raw Montgomery limbs, valid
+    only for the exact library build — with a trailing SHA-256 guarding
+    against torn/corrupted files."""
+    import hashlib
+    import os
+
+    here = os.path.join(os.path.dirname(os.path.abspath(nat.__file__)),
+                        "native")
+    key = (nat._source_digest()[:8] + "_"
+           + hashlib.sha256(flat).hexdigest()[:16])
+    path = os.path.join(here, f"_msmtab_{key}.bin")
+    expect = 96 * (len(flat) // 96) * nat._MSM_FIXED_WINDOWS
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        if (len(data) == expect + 32
+                and hashlib.sha256(data[:-32]).digest() == data[-32:]):
+            return data[:-32]
+    except OSError:
+        pass
+    table = nat.G1MSMPrecompute(flat)
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(table)
+            f.write(hashlib.sha256(table).digest())
+        os.replace(tmp, path)
+    except OSError:
+        pass  # read-only tree: rebuild per process
+    return table
+
+
 def g1_msm_native(points: Sequence[Point], scalars: Sequence[int],
                   fixed_base: bool = False):
     """Compressed-MSM fast path through the C++ Pippenger (bls_g1_msm) —
@@ -185,7 +220,8 @@ def g1_msm_native(points: Sequence[Point], scalars: Sequence[int],
         key = id(points)
         hit = _FIXED_TABLES.get(key)
         if hit is None or hit[0] is not points:
-            table = nat.G1MSMPrecompute(_points_affine_bytes(points))
+            table = _load_or_build_fixed_table(
+                nat, _points_affine_bytes(points))
             if len(_FIXED_TABLES) >= _FIXED_TABLES_MAX:
                 _FIXED_TABLES.clear()
             _FIXED_TABLES[key] = (points, table)

@@ -3,9 +3,6 @@ split, bit-exact vs their host oracles (parallel/bls_sharded.py,
 ops/kzg_jax.sharded_msm; executed at driver time by __graft_entry__'s
 multichip dryrun).  Runs on the 8-virtual-device CPU mesh the conftest
 pins."""
-import numpy as np
-import pytest
-
 import jax
 
 from consensus_specs_tpu.parallel import build_mesh
