@@ -61,7 +61,18 @@ def build_state(spec, n):
     )
 
     if "previous_epoch_attestations" not in type(state)._field_names:
-        return state  # altair+: participation flags instead of attestations
+        # altair+: participation flags instead of attestations; size the
+        # per-validator lists to the registry
+        if hasattr(state, "previous_epoch_participation"):
+            zeros8 = np.zeros(n, dtype=np.uint8)
+            bulk.set_packed_uint8_from_numpy(
+                state.previous_epoch_participation, zeros8)
+            bulk.set_packed_uint8_from_numpy(
+                state.current_epoch_participation, zeros8)
+        if hasattr(state, "inactivity_scores"):
+            bulk.set_packed_uint64_from_numpy(
+                state.inactivity_scores, np.zeros(n, dtype=np.int64))
+        return state
     prev_epoch = spec.get_previous_epoch(state)
     start_slot = spec.compute_start_slot_at_epoch(prev_epoch)
     committees_per_slot = int(spec.get_committee_count_per_slot(state, prev_epoch))
@@ -122,99 +133,16 @@ def _install_real_pubkeys(spec, state, n):
         BranchNode(contents, uint_to_leaf(n)))
 
 
-def bench_epoch_e2e_bls(results):
-    """Permanent metric ``mainnet_epoch_e2e_bls_on_<N>``: one full epoch of
-    32 signed mainnet blocks — each carrying 128 aggregate attestations
-    (the two preceding slots' 64 committees) — through ``state_transition``
-    with BLS verification ON, ending in the epoch transition (SURVEY §3.2
-    end-to-end; reference: phase0/beacon-chain.md:1241-1253, 1807-1833)."""
-    from consensus_specs_tpu.crypto import bls
-    from consensus_specs_tpu.crypto.bls import ciphersuite as _sign_suite
-    from consensus_specs_tpu.crypto.bls.curve import R as CURVE_ORDER
-    from consensus_specs_tpu.specs.builder import get_spec
-    from consensus_specs_tpu.testing.helpers.keys import NUM_KEYS, privkeys
-
-    spec = get_spec("phase0", "mainnet")
-    bls.use_fastest()
-
-    t_build_state, state = _timed(build_state, spec, N_VALIDATORS)
-    _install_real_pubkeys(spec, state, N_VALIDATORS)
-
-    def _sk(index):
-        return privkeys[int(index) % NUM_KEYS]
-
-    def _aggregate_sign(members, signing_root):
-        agg_sk = sum(_sk(m) for m in members) % CURVE_ORDER
-        return _sign_suite.Sign(agg_sk, signing_root)
-
-    def _attestations_for(st, block_slot):
-        """128 aggregates: every committee of the two preceding slots."""
-        atts = []
-        epoch = spec.get_current_epoch(st)
-        epoch_start = int(spec.compute_start_slot_at_epoch(epoch))
-        for prev_slot in (block_slot - 1, block_slot - 2):
-            if prev_slot < epoch_start:
-                continue
-            committees = int(spec.get_committee_count_per_slot(st, epoch))
-            for index in range(committees):
-                committee = spec.get_beacon_committee(st, prev_slot, index)
-                data = spec.AttestationData(
-                    slot=prev_slot,
-                    index=index,
-                    beacon_block_root=spec.get_block_root_at_slot(st, prev_slot),
-                    source=st.current_justified_checkpoint,
-                    target=spec.Checkpoint(
-                        epoch=epoch, root=spec.get_block_root(st, epoch)),
-                )
-                root = spec.compute_signing_root(
-                    data, spec.get_domain(st, spec.DOMAIN_BEACON_ATTESTER, epoch))
-                atts.append(spec.Attestation(
-                    aggregation_bits=[True] * len(committee),
-                    data=data,
-                    signature=_aggregate_sign(committee, root),
-                ))
-        return atts
-
-    # -- build phase (untimed): construct + sign the whole epoch of blocks
-    def _build_blocks():
-        bls.bls_active = False  # no verification while constructing
-        build_st = state.copy()
-        signed_blocks = []
-        for _ in range(int(spec.SLOTS_PER_EPOCH)):
-            slot = int(build_st.slot) + 1
-            stub = build_st.copy()
-            spec.process_slots(stub, slot)
-            proposer = spec.get_beacon_proposer_index(stub)
-
-            block = spec.BeaconBlock(slot=slot, proposer_index=proposer)
-            header = build_st.latest_block_header.copy()
-            if header.state_root == spec.Root():
-                header.state_root = build_st.hash_tree_root()
-            block.parent_root = header.hash_tree_root()
-            epoch = spec.compute_epoch_at_slot(slot)
-            block.body.randao_reveal = _sign_suite.Sign(
-                _sk(proposer), spec.compute_signing_root(
-                    epoch, spec.get_domain(build_st, spec.DOMAIN_RANDAO, epoch)))
-            for att in _attestations_for(stub, slot):
-                block.body.attestations.append(att)
-
-            spec.process_slots(build_st, slot)
-            spec.process_block(build_st, block)
-            block.state_root = build_st.hash_tree_root()
-            signed_blocks.append(spec.SignedBeaconBlock(
-                message=block,
-                signature=_sign_suite.Sign(_sk(proposer), spec.compute_signing_root(
-                    block, spec.get_domain(
-                        build_st, spec.DOMAIN_BEACON_PROPOSER)))))
-        return signed_blocks
-
-    # -- corpus cache: the signed-block set is a pure function of the
-    # pre-epoch state (whose root covers N_VALIDATORS, pubkeys, balances)
-    # and the builder logic (versioned key).  A warm bench run skips the
-    # ~4 min rebuild; the measured phase is unaffected either way.
+def _corpus_through_cache(spec, state, build_fn):
+    """Signed-block corpus cache: the set is a pure function of the
+    pre-epoch state (whose root covers validator count, fork, pubkeys,
+    balances) and the builder logic (versioned key).  A warm bench run
+    skips the ~4 min rebuild; the measured phase is unaffected either
+    way.  Returns (cache_hit, build_or_load_seconds, blocks)."""
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".bench_cache")
-    cache_key = (f"blocks_v2_{N_VALIDATORS}_{bytes(state.hash_tree_root()).hex()[:24]}")
+    cache_key = (f"blocks_v2_{N_VALIDATORS}_"
+                 f"{bytes(state.hash_tree_root()).hex()[:24]}")
     cache_path = os.path.join(cache_dir, cache_key + ".ssz")
 
     def _load_corpus():
@@ -238,15 +166,137 @@ def bench_epoch_e2e_bls(results):
                 f.write(enc)
         os.replace(tmp, cache_path)
 
-    corpus_cached = os.path.exists(cache_path)
-    if corpus_cached:
-        t_build_blocks, signed_blocks = _timed(_load_corpus)
-    else:
-        t_build_blocks, signed_blocks = _timed(_build_blocks)
-        try:
-            _store_corpus(signed_blocks)
-        except OSError:
-            pass  # read-only tree: cold path every run
+    if os.path.exists(cache_path):
+        t, blocks = _timed(_load_corpus)
+        return True, t, blocks
+    t, blocks = _timed(build_fn)
+    try:
+        _store_corpus(blocks)
+    except OSError:
+        pass  # read-only tree: cold path every run
+    return False, t, blocks
+
+
+def _sk_for(index):
+    from consensus_specs_tpu.testing.helpers.keys import NUM_KEYS, privkeys
+
+    return privkeys[int(index) % NUM_KEYS]
+
+
+def _aggregate_sign(members_sks, signing_root):
+    """Aggregate signature over ONE message == signature by the sum of the
+    member secret keys (used for corpus building only)."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite as _sign_suite
+    from consensus_specs_tpu.crypto.bls.curve import R as CURVE_ORDER
+
+    return _sign_suite.Sign(sum(members_sks) % CURVE_ORDER, signing_root)
+
+
+def _attestations_for(spec, st, block_slot):
+    """128 aggregates: every committee of the two preceding slots."""
+    atts = []
+    epoch = spec.get_current_epoch(st)
+    epoch_start = int(spec.compute_start_slot_at_epoch(epoch))
+    for prev_slot in (block_slot - 1, block_slot - 2):
+        if prev_slot < epoch_start:
+            continue
+        committees = int(spec.get_committee_count_per_slot(st, epoch))
+        for index in range(committees):
+            committee = spec.get_beacon_committee(st, prev_slot, index)
+            data = spec.AttestationData(
+                slot=prev_slot,
+                index=index,
+                beacon_block_root=spec.get_block_root_at_slot(st, prev_slot),
+                source=st.current_justified_checkpoint,
+                target=spec.Checkpoint(
+                    epoch=epoch, root=spec.get_block_root(st, epoch)),
+            )
+            root = spec.compute_signing_root(
+                data, spec.get_domain(st, spec.DOMAIN_BEACON_ATTESTER, epoch))
+            atts.append(spec.Attestation(
+                aggregation_bits=[True] * len(committee),
+                data=data,
+                signature=_aggregate_sign(
+                    [_sk_for(m) for m in committee], root),
+            ))
+    return atts
+
+
+def _build_epoch_blocks(spec, state, with_sync=False):
+    """Construct + sign one epoch of full blocks (untimed build phase).
+    ``with_sync`` adds a fully-participating sync aggregate per block
+    (altair+)."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.crypto.bls import ciphersuite as _sign_suite
+    from consensus_specs_tpu.testing.helpers.keys import pubkey_to_privkey
+
+    bls.bls_active = False  # no verification while constructing
+    build_st = state.copy()
+    signed_blocks = []
+    sync_sks = None
+    if with_sync:
+        sync_sks = [pubkey_to_privkey[bytes(pk)]
+                    for pk in state.current_sync_committee.pubkeys]
+    for _ in range(int(spec.SLOTS_PER_EPOCH)):
+        slot = int(build_st.slot) + 1
+        stub = build_st.copy()
+        spec.process_slots(stub, slot)
+        proposer = spec.get_beacon_proposer_index(stub)
+
+        block = spec.BeaconBlock(slot=slot, proposer_index=proposer)
+        header = build_st.latest_block_header.copy()
+        if header.state_root == spec.Root():
+            header.state_root = build_st.hash_tree_root()
+        block.parent_root = header.hash_tree_root()
+        epoch = spec.compute_epoch_at_slot(slot)
+        block.body.randao_reveal = _sign_suite.Sign(
+            _sk_for(proposer), spec.compute_signing_root(
+                epoch, spec.get_domain(build_st, spec.DOMAIN_RANDAO, epoch)))
+        for att in _attestations_for(spec, stub, slot):
+            block.body.attestations.append(att)
+        if with_sync:
+            # process_sync_aggregate verifies over the previous slot's
+            # block root (altair/beacon-chain.md:536-543) = parent_root
+            prev_slot = slot - 1
+            domain = spec.get_domain(
+                build_st, spec.DOMAIN_SYNC_COMMITTEE,
+                spec.compute_epoch_at_slot(prev_slot))
+            root = spec.compute_signing_root(
+                spec.Root(block.parent_root), domain)
+            block.body.sync_aggregate = spec.SyncAggregate(
+                sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
+                sync_committee_signature=_aggregate_sign(sync_sks, root),
+            )
+
+        spec.process_slots(build_st, slot)
+        spec.process_block(build_st, block)
+        block.state_root = build_st.hash_tree_root()
+        signed_blocks.append(spec.SignedBeaconBlock(
+            message=block,
+            signature=_sign_suite.Sign(
+                _sk_for(proposer), spec.compute_signing_root(
+                    block, spec.get_domain(
+                        build_st, spec.DOMAIN_BEACON_PROPOSER)))))
+    return signed_blocks
+
+
+def bench_epoch_e2e_bls(results):
+    """Permanent metric ``mainnet_epoch_e2e_bls_on_<N>``: one full epoch of
+    32 signed mainnet blocks — each carrying 128 aggregate attestations
+    (the two preceding slots' 64 committees) — through ``state_transition``
+    with BLS verification ON, ending in the epoch transition (SURVEY §3.2
+    end-to-end; reference: phase0/beacon-chain.md:1241-1253, 1807-1833)."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.specs.builder import get_spec
+
+    spec = get_spec("phase0", "mainnet")
+    bls.use_fastest()
+
+    t_build_state, state = _timed(build_state, spec, N_VALIDATORS)
+    _install_real_pubkeys(spec, state, N_VALIDATORS)
+
+    corpus_cached, t_build_blocks, signed_blocks = _corpus_through_cache(
+        spec, state, lambda: _build_epoch_blocks(spec, state))
     n_atts = sum(len(sb.message.body.attestations) for sb in signed_blocks)
 
     # -- measured phase: full verification + transition, BLS ON
@@ -260,21 +310,7 @@ def bench_epoch_e2e_bls(results):
     bls.bls_active = False
     assert int(state.slot) % int(spec.SLOTS_PER_EPOCH) == 0  # epoch boundary hit
 
-    # reference-shaped baseline (BASELINE.md:25): the pure-Python pairing
-    # oracle verifying the same 128-pubkey aggregate shape, measured once
-    # and scaled to the n_atts this run actually verified.  This mirrors
-    # how the BLS-free row scales its sequential twin.
-    from consensus_specs_tpu.testing.helpers.keys import pubkeys as _pk_table
-
-    oracle_msg = b"\x51" * 32
-    oracle_sks = [privkeys[i] for i in range(128)]
-    oracle_agg = _sign_suite.Aggregate(
-        [_sign_suite.Sign(sk, oracle_msg) for sk in oracle_sks])
-    t_oracle1, ok = _timed(
-        _sign_suite.FastAggregateVerify,
-        [_pk_table[i] for i in range(128)], oracle_msg, oracle_agg)
-    assert ok
-    t_oracle_scaled = t_oracle1 * n_atts
+    t_oracle_scaled = _oracle_verify_time(128) * n_atts
 
     results["epoch_e2e_bls"] = {
         "metric": f"mainnet_epoch_e2e_bls_on_{N_VALIDATORS}",
@@ -283,6 +319,83 @@ def bench_epoch_e2e_bls(results):
         "vs_baseline": round(t_oracle_scaled / t_e2e, 1),
         "blocks": len(signed_blocks),
         "aggregate_attestations_verified": n_atts,
+        "per_block_s": round(t_e2e / len(signed_blocks), 3),
+        "state_build_s": round(t_build_state, 3),
+        "block_build_s": round(t_build_blocks, 3),
+        "block_corpus_cached": corpus_cached,
+        "python_oracle_scaled_s": round(t_oracle_scaled, 1),
+        "bls_backend": bls.backend_name() if hasattr(bls, "backend_name") else "native",
+    }
+
+
+def _oracle_verify_time(n_keys: int) -> float:
+    """Reference-shaped baseline unit (BASELINE.md:25): the pure-Python
+    pairing oracle verifying ONE n_keys-pubkey aggregate, measured in-run.
+    Rows scale this by their actual aggregate counts — the same scaling
+    the BLS-free row applies to its sequential twin."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite as _sign_suite
+    from consensus_specs_tpu.testing.helpers.keys import privkeys, pubkeys
+
+    oracle_msg = b"\x51" * 32
+    oracle_sks = [privkeys[i] for i in range(n_keys)]
+    oracle_agg = _sign_suite.Aggregate(
+        [_sign_suite.Sign(sk, oracle_msg) for sk in oracle_sks])
+    t_oracle1, ok = _timed(
+        _sign_suite.FastAggregateVerify,
+        [pubkeys[i] for i in range(n_keys)], oracle_msg, oracle_agg)
+    assert ok
+    return t_oracle1
+
+
+def bench_epoch_e2e_bls_altair(results):
+    """Modern-fork twin of the north star: one epoch of 32 signed altair
+    mainnet blocks — 128 aggregate attestations each PLUS a fully
+    participating 512-member sync aggregate — through ``state_transition``
+    with BLS ON (altair/beacon-chain.md:487-494 process_sync_aggregate;
+    p2p sync duty surface).  Same corpus-cache/measurement rules as the
+    phase0 row."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.specs.builder import get_spec
+    spec = get_spec("altair", "mainnet")
+    bls.use_fastest()
+
+    t_build_state, state = _timed(build_state, spec, N_VALIDATORS)
+    # (this also populates pubkey_to_privkey for the sync signing below)
+    _install_real_pubkeys(spec, state, N_VALIDATORS)
+    # real sync committees derived from the (real-pubkey) registry, the
+    # way upgrade_to_altair seeds them (altair/fork.md)
+    committee = spec.get_next_sync_committee(state)
+    state.current_sync_committee = committee
+    state.next_sync_committee = spec.get_next_sync_committee(state)
+
+    corpus_cached, t_build_blocks, signed_blocks = _corpus_through_cache(
+        spec, state, lambda: _build_epoch_blocks(spec, state, with_sync=True))
+    n_atts = sum(len(sb.message.body.attestations) for sb in signed_blocks)
+    n_syncs = len(signed_blocks)
+
+    bls.bls_active = True
+
+    def _replay():
+        for sb in signed_blocks:
+            spec.state_transition(state, sb, True)
+
+    t_e2e, _ = _timed(_replay)
+    bls.bls_active = False
+    assert int(state.slot) % int(spec.SLOTS_PER_EPOCH) == 0
+
+    # both aggregate shapes measured directly (the oracle is
+    # pairing-dominated, so the 512-key shape costs only a little more)
+    t_oracle_scaled = (_oracle_verify_time(128) * n_atts
+                       + _oracle_verify_time(512) * n_syncs)
+
+    results["epoch_e2e_bls_altair"] = {
+        "metric": f"altair_mainnet_epoch_e2e_bls_on_{N_VALIDATORS}",
+        "value": round(t_e2e, 3),
+        "unit": "s",
+        "vs_baseline": round(t_oracle_scaled / t_e2e, 1),
+        "blocks": len(signed_blocks),
+        "aggregate_attestations_verified": n_atts,
+        "sync_aggregates_verified": n_syncs,
         "per_block_s": round(t_e2e / len(signed_blocks), 3),
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
@@ -779,6 +892,10 @@ def main():
             bench_epoch_e2e_bls(results)
         except Exception as exc:
             results["epoch_e2e_bls"] = {"error": repr(exc)[:300]}
+        try:
+            bench_epoch_e2e_bls_altair(results)
+        except Exception as exc:
+            results["epoch_e2e_bls_altair"] = {"error": repr(exc)[:300]}
         try:
             bench_bls_batches(results)
         except Exception as exc:

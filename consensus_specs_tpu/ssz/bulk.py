@@ -165,6 +165,23 @@ def cached_validator_pubkeys(validators) -> list:
     return _PUBKEY_CACHE.get(validators, validator_pubkeys)
 
 
+_PUBKEY_INDEX_CACHE = RootKeyedCache(2)
+
+
+def cached_pubkey_index(validators) -> Dict[bytes, int]:
+    """pubkey bytes -> FIRST validator index carrying it (list.index
+    semantics, which is what the altair sync-committee reward loop's
+    ``all_pubkeys.index(pubkey)`` resolves to on duplicate keys)."""
+
+    def build(v):
+        index_of: Dict[bytes, int] = {}
+        for i, pk in enumerate(cached_validator_pubkeys(v)):
+            index_of.setdefault(pk, i)
+        return index_of
+
+    return _PUBKEY_INDEX_CACHE.get(validators, build)
+
+
 def validator_columns(validators) -> Dict[str, np.ndarray]:
     """One walk over the registry subtrees -> all epoch-processing columns.
 

@@ -1,0 +1,117 @@
+"""Differential: the block-scoped vectorized process_attestation
+(specs/builder.py _install_altair_attestation_kernel) must mutate state
+identically to the sequential altair spec path — participation flags,
+proposer reward, and assert behavior."""
+import pytest
+
+from consensus_specs_tpu.specs import builder
+from consensus_specs_tpu.specs.builder import get_spec
+from consensus_specs_tpu.ssz import bulk
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testing.helpers.state import next_slots
+
+
+@pytest.fixture(scope="module")
+def env():
+    spec = get_spec("altair", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    next_slots(spec, state, 3)
+    att = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    return spec, state, att
+
+
+def _run_scoped(spec, state, att):
+    """Run the substituted path under a participation scope, flushing the
+    mirror — exactly what the process_block wrapper does."""
+    scope = builder._ParticipationBlockScope(state)
+    token = builder._part_scope.set(scope)
+    try:
+        spec.process_attestation(state, att)
+        scope.flush(state)
+    finally:
+        builder._part_scope.reset(token)
+
+
+def test_scoped_matches_sequential(env):
+    spec, state, att = env
+    seq, vec = state.copy(), state.copy()
+    spec.process_attestation.__wrapped__(seq, att)
+    _run_scoped(spec, vec, att)
+    assert bytes(vec.hash_tree_root()) == bytes(seq.hash_tree_root())
+    assert (bulk.packed_uint8_to_numpy(vec.current_epoch_participation)
+            == bulk.packed_uint8_to_numpy(seq.current_epoch_participation)).all()
+    assert vec.balances == seq.balances
+
+
+def test_second_inclusion_gives_no_double_reward(env):
+    """Flags already set -> zero new numerator on both paths."""
+    spec, state, att = env
+    seq, vec = state.copy(), state.copy()
+    spec.process_attestation.__wrapped__(seq, att)
+    spec.process_attestation.__wrapped__(seq, att)
+    scope = builder._ParticipationBlockScope(vec)
+    token = builder._part_scope.set(scope)
+    try:
+        spec.process_attestation(vec, att)
+        spec.process_attestation(vec, att)  # dedup against the mirror
+        scope.flush(vec)
+    finally:
+        builder._part_scope.reset(token)
+    assert bytes(vec.hash_tree_root()) == bytes(seq.hash_tree_root())
+
+
+def test_validation_asserts_match(env):
+    spec, state, att = env
+    bad = att.copy()
+    bad.data.index = spec.get_committee_count_per_slot(
+        state, bad.data.target.epoch) + 10
+    for runner in (
+        lambda st: _run_scoped(spec, st, bad),
+        lambda st: spec.process_attestation.__wrapped__(st, bad),
+    ):
+        st = state.copy()
+        with pytest.raises(AssertionError):
+            runner(st)
+
+
+def test_outside_scope_falls_back_to_sequential(env):
+    spec, state, att = env
+    seq, direct = state.copy(), state.copy()
+    spec.process_attestation.__wrapped__(seq, att)
+    spec.process_attestation(direct, att)  # no scope: must be sequential
+    assert bytes(direct.hash_tree_root()) == bytes(seq.hash_tree_root())
+
+
+def test_sync_aggregate_substitution_matches_sequential(env):
+    """process_sync_aggregate with the cached pubkey reverse index must
+    mutate balances identically to the spec's all-validators list.index
+    scan, for full, partial, and empty participation."""
+    from consensus_specs_tpu.testing.helpers.sync_committee import (
+        compute_aggregate_sync_committee_signature,
+        compute_committee_indices,
+    )
+
+    spec, state, _ = env
+    committee = compute_committee_indices(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    for bits in ([True] * size,
+                 [i % 2 == 0 for i in range(size)],
+                 [False] * size):
+        participants = [i for i, b in zip(committee, bits) if b]
+        agg = spec.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=compute_aggregate_sync_committee_signature(
+                spec, state, state.slot - 1, participants))
+        seq, vec = state.copy(), state.copy()
+        spec.process_sync_aggregate.__wrapped__(seq, agg)
+        spec.process_sync_aggregate(vec, agg)
+        assert bytes(vec.hash_tree_root()) == bytes(seq.hash_tree_root())

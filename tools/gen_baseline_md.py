@@ -100,6 +100,18 @@ def build_table(details: dict) -> str:
             f"{r.get('bls_backend', 'native')} batch verification)",
             "epoch_e2e_bls"))
 
+    r = details.get("epoch_e2e_bls_altair", {})
+    if "value" in r:
+        rows.append((
+            "★b", f"altair mainnet epoch end-to-end, 400k validators, BLS ON "
+            f"({r.get('blocks', 32)} blocks: "
+            f"{r.get('aggregate_attestations_verified', '?')} aggregates + "
+            f"{r.get('sync_aggregates_verified', '?')} full 512-member sync "
+            f"aggregates through `state_transition`)",
+            f"**{_fmt(r['value'])} s** ({_fmt(r.get('per_block_s'))} s/block, "
+            f"{r.get('bls_backend', 'native')} batch verification)",
+            "epoch_e2e_bls_altair"))
+
     r = details.get("altair_epoch", {})
     if "value" in r:
         rows.append((
