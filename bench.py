@@ -776,6 +776,145 @@ def bench_kzg_msm(results):
     }
 
 
+def build_forkchoice_ingest_inputs(spec, state, n_attestations):
+    """Stores + a ≥``n_attestations`` unaggregated-attestation corpus over a
+    2-fork tree on ``state`` (shared by bench.py and the slow pytest row).
+
+    Returns ``(store_seq, engine, attestations, roots)`` — two independent
+    stores primed identically: anchor at the epoch boundary, two competing
+    child blocks, clock one epoch ahead so the previous epoch's committees
+    are ingestible.  Attestations are single-committee-chunk votes split
+    between the two children, the unaggregated-gossip shape a node serving
+    heavy traffic sees."""
+    from consensus_specs_tpu.forkchoice import ForkChoiceEngine
+
+    # genesis-style header so child blocks' parent_root resolves to the
+    # anchor (process_block_header pins parent to the header's root), and
+    # a genesis-epoch anchor so the store's justified/finalized epoch is
+    # GENESIS_EPOCH — otherwise filter_block_tree rejects every leaf (the
+    # synthetic state's own checkpoints are zeroed) and the head walk
+    # would never actually weigh the forks being voted on
+    state.slot = spec.GENESIS_SLOT
+    state.latest_block_header = spec.BeaconBlockHeader(
+        body_root=spec.hash_tree_root(spec.BeaconBlockBody()))
+    anchor = spec.BeaconBlock(state_root=state.hash_tree_root())
+    store_seq = spec.get_forkchoice_store(state, anchor)
+    engine = ForkChoiceEngine(spec, spec.get_forkchoice_store(state, anchor))
+    anchor_root = anchor.hash_tree_root()
+
+    epoch = int(spec.get_current_epoch(state))
+    first_slot = int(spec.compute_start_slot_at_epoch(epoch))
+
+    # two competing children of the anchor (untimed; BLS off for the build)
+    def _child(graffiti):
+        st = state.copy()
+        spec.process_slots(st, first_slot + 1)
+        block = spec.BeaconBlock(
+            slot=first_slot + 1,
+            proposer_index=spec.get_beacon_proposer_index(st),
+            parent_root=anchor_root)
+        block.body.graffiti = graffiti
+        spec.process_block(st, block)
+        block.state_root = st.hash_tree_root()
+        return spec.SignedBeaconBlock(message=block)
+
+    from consensus_specs_tpu.testing.helpers.fork_choice import _slot_wall_time
+
+    forks = [_child(b"\x00" * 32), _child(b"\xff" * 32)]
+    t_children = _slot_wall_time(spec, state, first_slot + 1)
+    spec.on_tick(store_seq, t_children)
+    engine.on_tick(t_children)
+    for sb in forks:
+        spec.on_block(store_seq, sb)
+        engine.on_block(sb)
+    roots = [sb.message.hash_tree_root() for sb in forks]
+
+    # clock at the next epoch's start: targets of `epoch` remain ingestible
+    t_next = _slot_wall_time(spec, state, first_slot + int(spec.SLOTS_PER_EPOCH))
+    spec.on_tick(store_seq, t_next)
+    engine.on_tick(t_next)
+
+    # single-chunk attestations over this epoch's committees, votes split
+    # between the two forks; attestations at the fork slot vote the anchor
+    target = spec.Checkpoint(epoch=epoch, root=anchor_root)
+    attestations = []
+    chunk = 1  # one attester per attestation: the unaggregated shape
+    committees_per_slot = int(spec.get_committee_count_per_slot(state, epoch))
+    for slot in range(first_slot, first_slot + int(spec.SLOTS_PER_EPOCH)):
+        for index in range(committees_per_slot):
+            committee = spec.get_beacon_committee(state, slot, index)
+            size = len(committee)
+            vote = anchor_root if slot <= first_slot + 1 else \
+                roots[len(attestations) % 2]
+            data = spec.AttestationData(
+                slot=slot, index=index, beacon_block_root=vote,
+                source=state.current_justified_checkpoint, target=target)
+            for lo in range(0, size, chunk):
+                bits = [False] * size
+                for k in range(lo, min(lo + chunk, size)):
+                    bits[k] = True
+                attestations.append(spec.Attestation(
+                    aggregation_bits=bits, data=data))
+            if len(attestations) >= n_attestations:
+                break
+        if len(attestations) >= n_attestations:
+            break
+    return store_seq, engine, attestations, roots
+
+
+def bench_forkchoice_ingest(results, n_validators=None, n_attestations=100_000):
+    """Driver-parsed ``forkchoice_batch_ingest`` row: ≥100k unaggregated
+    attestations against a 400k-validator state, ingested by the literal
+    per-attestation spec loop (``on_attestation``) and by the proto-array
+    engine's batched path, with head parity asserted in-run and the spec's
+    O(blocks × validators) head walk timed against the engine's O(blocks)
+    proto-array query."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.specs.builder import get_spec
+
+    n = n_validators or N_VALIDATORS
+    spec = get_spec("phase0", "mainnet")
+    was_active = bls.bls_active
+    bls.bls_active = False  # measuring fork-choice bookkeeping, not pairing
+    try:
+        t_build, state = _timed(build_state, spec, n)
+        store_seq, engine, atts, _roots = build_forkchoice_ingest_inputs(
+            spec, state, n_attestations)
+
+        def _spec_loop():
+            for att in atts:
+                spec.on_attestation(store_seq, att)
+
+        t_seq, _ = _timed(_spec_loop)
+        t_batch, _ = _timed(engine.on_attestations, atts)
+
+        t_head_engine, head_engine = _timed(engine.get_head)
+        t_head_spec, head_spec = _timed(spec.get_head, store_seq)
+        assert bytes(head_engine) == bytes(head_spec), \
+            "engine head diverged from spec store after ingest"
+        assert engine.store.latest_messages == store_seq.latest_messages, \
+            "batched latest messages diverged from sequential fold"
+        speedup = t_seq / t_batch
+        assert speedup >= 10, (
+            f"batched ingest only {speedup:.1f}x the spec loop")
+
+        results["forkchoice_batch_ingest"] = {
+            "metric": f"forkchoice_batch_ingest_{len(atts)}_attestations_{n}_validators",
+            "value": round(len(atts) / t_batch, 1),
+            "unit": "attestations/s",
+            "batched_ingest_s": round(t_batch, 3),
+            "spec_loop_s": round(t_seq, 3),
+            "vs_baseline": round(speedup, 1),
+            "attestations": len(atts),
+            "get_head_engine_s": round(t_head_engine, 6),
+            "get_head_spec_s": round(t_head_spec, 3),
+            "state_build_s": round(t_build, 3),
+            "head_parity": True,
+        }
+    finally:
+        bls.bls_active = was_active
+
+
 def bench_scale_probe(results):
     """Scale-headroom probe (VERDICT r4 item 7): the BLS-free epoch
     transition at 2^20 validators (registry limit is 2^40; real mainnet is
@@ -904,6 +1043,10 @@ def main():
             bench_kzg_msm(results)
         except Exception as exc:
             results["kzg_blob_commitment"] = {"error": repr(exc)[:300]}
+        try:
+            bench_forkchoice_ingest(results)
+        except Exception as exc:
+            results["forkchoice_batch_ingest"] = {"error": repr(exc)[:300]}
     if os.environ.get("BENCH_SCALE_PROBE") == "1":
         try:
             bench_scale_probe(results)

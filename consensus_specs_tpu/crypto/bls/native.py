@@ -329,7 +329,10 @@ def G1MSMPrecompute(points_xy: bytes) -> bytes:
 def G1MSMFixed(table: bytes, n: int, scalars_be: bytes) -> bytes:
     """Fixed-base MSM against a G1MSMPrecompute table: one bucket pass, no
     inter-window doubling chain (~1.8x the on-the-fly Pippenger at blob
-    scale, on top of the table's one-time cost)."""
+    scale, on top of the table's one-time cost).  The C side sanity-checks
+    the first table entry against the curve, so a table from an
+    incompatible build (or a torn write that survived the disk cache's
+    digest) raises the ValueError below instead of returning garbage."""
     if len(scalars_be) != 32 * n or len(table) != 96 * n * _MSM_FIXED_WINDOWS:
         raise ValueError("table/scalar sizes inconsistent with n")
     out = (ctypes.c_uint8 * 48)()

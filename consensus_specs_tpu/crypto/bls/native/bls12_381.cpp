@@ -1281,13 +1281,17 @@ static G1 g1_msm_pippenger(const std::vector<Fp> &xs, const std::vector<Fp> &ys,
     if (n == 0) return G1::infinity();
     // argmin over window width of the field-mul count:
     //   windows * (n mixed adds @ ~11M + 2*2^c bucket-agg adds @ ~16M)
+    // ceil(255/c) windows cover the 255-bit scalar exactly; the previous
+    // biased form over-counted an always-empty top window whenever c
+    // divides 255 (c = 3, 5, 15), paying c doublings + a bucket pass for
+    // digits that are provably zero.
     unsigned c = 2;
     double best = 1e300;
     for (unsigned t = 2; t <= 16; t++) {
-        double cost = ((255 + t) / t) * (n * 11.0 + (double)(size_t(1) << t) * 32.0);
+        double cost = ((255 + t - 1) / t) * (n * 11.0 + (double)(size_t(1) << t) * 32.0);
         if (cost < best) { best = cost; c = t; }
     }
-    unsigned n_windows = (255 + c) / c;
+    unsigned n_windows = (255 + c - 1) / c;
     std::vector<G1> buckets(size_t(1) << c);
     G1 acc = G1::infinity();
     for (int w = (int)n_windows - 1; w >= 0; w--) {
@@ -1596,12 +1600,12 @@ int bls_g1_msm(const uint8_t *xys, const uint8_t *scalars32, size_t n,
 // n_windows on success, 0 on bad input.
 // Window count of the fixed-base layout — Python sizes the table buffer
 // from THIS export so the two sides can never drift.
-int bls_g1_msm_fixed_windows(void) { return (int)((255 + MSM_FIXED_C) / MSM_FIXED_C); }
+int bls_g1_msm_fixed_windows(void) { return (int)((255 + MSM_FIXED_C - 1) / MSM_FIXED_C); }
 
 int bls_g1_msm_precompute(const uint8_t *xys, size_t n, uint8_t *out_table) {
     bls_init();
     const unsigned c = MSM_FIXED_C;
-    const unsigned n_windows = (255 + c) / c;
+    const unsigned n_windows = (255 + c - 1) / c;
     if (n == 0) return (int)n_windows;
     std::vector<G1> shifted(n * n_windows);
     for (size_t i = 0; i < n; i++) {
@@ -1638,8 +1642,21 @@ int bls_g1_msm_fixed(const uint8_t *table, size_t n, const uint8_t *scalars32,
                      uint8_t out[48]) {
     bls_init();
     const unsigned c = MSM_FIXED_C;
-    const unsigned n_windows = (255 + c) / c;
+    const unsigned n_windows = (255 + c - 1) / c;
     const size_t n_groups = (size_t(1) << c) - 1;
+
+    // Cheap sanity probe of the opaque table: entries are raw Montgomery
+    // limb pairs, so a table persisted by an incompatible build (different
+    // limb layout / byte order) or a torn write decodes to coordinates off
+    // the curve.  Checking the first entry costs two 48-byte copies and
+    // one curve evaluation — the documented "corrupted MSM table" failure
+    // mode in native.py G1MSMFixed is only real because of this check.
+    if (n > 0) {
+        Fp x0, y0;
+        memcpy(x0.v.l, table, 48);
+        memcpy(y0.v.l, table + 48, 48);
+        if (!g1_on_curve(x0, y0)) return 0;
+    }
 
     // digit extraction + counting sort by bucket
     std::vector<uint16_t> digits((size_t)n_windows * n);

@@ -167,20 +167,53 @@ _FIXED_TABLES: dict = {}
 _FIXED_TABLES_MAX = 2
 
 
-def _load_or_build_fixed_table(nat, flat: bytes) -> bytes:
-    """Disk-cached shifted-window table: the ~1-5 s expansion of a blob
-    setup otherwise recurs in every process.  Keyed by (native source
-    digest, points digest) — the entries are raw Montgomery limbs, valid
-    only for the exact library build — with a trailing SHA-256 guarding
-    against torn/corrupted files."""
+_MSM_ABI_TAG = None
+
+
+def _msm_abi_tag(nat) -> str:
+    """ABI fingerprint of the persisted table format: byte order, pointer
+    width, and — the real behavioral probe — a digest of the serialized
+    window table of the G1 generator.  Entries are raw Montgomery limbs in
+    machine byte order, so any change to limb size, limb order, or the
+    Montgomery representation on the build host changes this tag and the
+    stale table becomes a cache miss instead of garbage input."""
+    global _MSM_ABI_TAG
+    if _MSM_ABI_TAG is None:
+        import ctypes
+        import hashlib
+        import sys
+
+        gen = g1_generator()
+        gen_xy = (gen.x.n.to_bytes(48, "big") + gen.y.n.to_bytes(48, "big"))
+        h = hashlib.sha256()
+        h.update(sys.byteorder.encode())
+        h.update(bytes([ctypes.sizeof(ctypes.c_void_p)]))
+        h.update(nat.G1MSMPrecompute(gen_xy))
+        _MSM_ABI_TAG = h.hexdigest()[:8]
+    return _MSM_ABI_TAG
+
+
+def _fixed_table_path(nat, flat: bytes) -> str:
     import hashlib
     import os
 
     here = os.path.join(os.path.dirname(os.path.abspath(nat.__file__)),
                         "native")
-    key = (nat._source_digest()[:8] + "_"
+    key = (nat._source_digest()[:8] + "_" + _msm_abi_tag(nat) + "_"
            + hashlib.sha256(flat).hexdigest()[:16])
-    path = os.path.join(here, f"_msmtab_{key}.bin")
+    return os.path.join(here, f"_msmtab_{key}.bin")
+
+
+def _load_or_build_fixed_table(nat, flat: bytes) -> bytes:
+    """Disk-cached shifted-window table: the ~1-5 s expansion of a blob
+    setup otherwise recurs in every process.  Keyed by (native source
+    digest, ABI tag, points digest) — the entries are raw Montgomery
+    limbs, valid only for the exact library build *and host ABI* — with a
+    trailing SHA-256 guarding against torn/corrupted files."""
+    import hashlib
+    import os
+
+    path = _fixed_table_path(nat, flat)
     expect = 96 * (len(flat) // 96) * nat._MSM_FIXED_WINDOWS
     try:
         with open(path, "rb") as f:

@@ -1,0 +1,197 @@
+"""Fork-choice engine: a real spec ``Store`` + a proto-array, kept in
+lockstep behind an ``on_tick / on_block / on_attestations / get_head``
+API.
+
+The engine *wraps* a spec ``Store`` (never forks its semantics): block,
+tick and slashing handling delegate to the spec handlers on that store,
+attestation batches go through the vectorized path in ``batch.py`` (which
+updates ``store.latest_messages`` with the spec's exact fold), and the
+proto-array mirrors the store's block tree and votes so ``get_head`` is
+one O(blocks) array walk instead of the spec's O(blocks × validators)
+recursive re-walk.
+
+Invariants (pinned by tests/spec/phase0/fork_choice/test_engine_differential.py):
+
+* ``engine.get_head()`` is byte-identical to ``spec.get_head(store)`` at
+  every point in any handler sequence, as are the justified/finalized
+  checkpoints (read straight off the wrapped store);
+* the wrapped store remains a spec-true ``Store`` — any spec function may
+  be applied to it at any time.  The one liberty taken: the justified
+  checkpoint's state is materialized eagerly (with the spec's own
+  ``store_target_checkpoint_state``) when the justified checkpoint moves,
+  where the spec materializes it lazily on the first matching
+  attestation; head behavior is identical.
+* the head is cached and invalidated on every write (any handler call);
+* on finalization the proto-array prunes to the finalized subtree; votes
+  for pruned branches keep their latest-message entries (as in the spec)
+  but carry no weight — the spec walk, rooted under the finalized block,
+  can never count them either.
+
+Effective balances and the proposer-boost score are snapshots of the
+justified checkpoint state, refreshed only when the justified checkpoint
+moves (at most once per epoch), via the same cached registry columns the
+epoch kernels use (``ops/epoch_jax.registry_columns``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from consensus_specs_tpu import tracing
+
+from . import batch
+from .proto_array import ProtoArray
+
+_ZERO32 = b"\x00" * 32
+
+
+def _cp(checkpoint) -> tuple:
+    return (int(checkpoint.epoch), bytes(checkpoint.root))
+
+
+class ForkChoiceEngine:
+    """Proto-array LMD-GHOST over a wrapped spec ``Store``."""
+
+    def __init__(self, spec, store):
+        self.spec = spec
+        self.store = store
+        self.proto = ProtoArray()
+        self._head = None
+        self._justified_seen = None
+        self._finalized_seen = _cp(store.finalized_checkpoint)
+        self._proposer_score = 0
+        self._equivocating_seen = set(store.equivocating_indices)
+        for root, block in sorted(store.blocks.items(),
+                                  key=lambda kv: int(kv[1].slot)):
+            self._insert_block(root)
+        # a warm store may already carry latest messages: seed the votes
+        # BEFORE the checkpoint sync so the balance refresh's full weight
+        # rebuild counts them (spec parity holds from the first get_head)
+        if store.latest_messages:
+            self.proto.ensure_validators(
+                int(max(store.latest_messages)) + 1)
+            for v, message in store.latest_messages.items():
+                if v in store.equivocating_indices:
+                    continue
+                self.proto.vote_node[int(v)] = \
+                    self.proto.node_index(message.root)
+                self.proto.vote_epoch[int(v)] = int(message.epoch)
+        self._sync_checkpoints()
+
+    # -- store mirroring -----------------------------------------------------
+
+    def _insert_block(self, root) -> None:
+        block = self.store.blocks[root]
+        state = self.store.block_states[root]
+        self.proto.insert(
+            root, block.parent_root, int(block.slot),
+            _cp(state.current_justified_checkpoint),
+            _cp(state.finalized_checkpoint))
+
+    def _refresh_justified(self) -> None:
+        """Justified checkpoint moved: snapshot its state's active
+        effective balances + proposer-boost score, rebuild weights."""
+        spec, store = self.spec, self.store
+        jc = store.justified_checkpoint
+        spec.store_target_checkpoint_state(store, jc)
+        state = store.checkpoint_states[jc]
+        from consensus_specs_tpu.ops.epoch_jax import active_mask, registry_columns
+
+        cols = registry_columns(state)
+        epoch = int(spec.get_current_epoch(state))
+        active = active_mask(cols, epoch)
+        balances = np.where(active, cols["effective_balance"], 0)
+        self.proto.set_balances(balances)
+        num = int(active.sum())
+        if num == 0:
+            self._proposer_score = 0
+            return
+        total = max(int(spec.EFFECTIVE_BALANCE_INCREMENT),
+                    int(balances.sum()))
+        avg = total // num
+        committee_weight = (num // int(spec.SLOTS_PER_EPOCH)) * avg
+        self._proposer_score = (
+            committee_weight * int(spec.config.PROPOSER_SCORE_BOOST) // 100)
+
+    def _sync_checkpoints(self) -> None:
+        jc = _cp(self.store.justified_checkpoint)
+        if jc != self._justified_seen:
+            self._justified_seen = jc
+            self._refresh_justified()
+        fc = _cp(self.store.finalized_checkpoint)
+        if fc != self._finalized_seen:
+            self._finalized_seen = fc
+            with tracing.span("forkchoice/prune"):
+                self.proto.prune(self.store.finalized_checkpoint.root)
+
+    # -- handlers ------------------------------------------------------------
+
+    def on_tick(self, time) -> None:
+        with tracing.span("forkchoice/on_tick"):
+            self.spec.on_tick(self.store, time)
+            self._sync_checkpoints()
+            self._head = None
+
+    def on_block(self, signed_block) -> None:
+        with tracing.span("forkchoice/on_block"):
+            self.spec.on_block(self.store, signed_block)
+            self._insert_block(
+                self.spec.hash_tree_root(signed_block.message))
+            self._sync_checkpoints()
+            self._head = None
+
+    def on_attestations(self, attestations, is_from_block: bool = False) -> None:
+        """Batched ``on_attestation``: the whole batch is validated before
+        any vote lands (see batch.py for the exact semantics)."""
+        with tracing.span("forkchoice/on_attestations"):
+            changed = batch.ingest_attestations(
+                self.spec, self.store, attestations, is_from_block)
+            if changed is not None:
+                validators, epochs, att_ids, block_roots = changed
+                self.proto.ensure_validators(int(validators.max()) + 1)
+                nodes = np.fromiter(
+                    (self.proto.node_index(block_roots[a])
+                     for a in att_ids.tolist()),
+                    dtype=np.int64, count=len(att_ids))
+                with tracing.span("forkchoice/apply_votes"):
+                    self.proto.apply_vote_changes(validators, nodes, epochs)
+            self._head = None
+
+    def on_attestation(self, attestation, is_from_block: bool = False) -> None:
+        self.on_attestations([attestation], is_from_block=is_from_block)
+
+    def on_attester_slashing(self, attester_slashing) -> None:
+        with tracing.span("forkchoice/on_attester_slashing"):
+            self.spec.on_attester_slashing(self.store, attester_slashing)
+            fresh = self.store.equivocating_indices - self._equivocating_seen
+            if fresh:
+                self._equivocating_seen |= fresh
+                eq = np.fromiter((int(i) for i in fresh), dtype=np.int64)
+                self.proto.ensure_validators(int(eq.max()) + 1)
+                self.proto.clear_votes(eq)
+            self._head = None
+
+    # -- queries -------------------------------------------------------------
+
+    def get_head(self):
+        if self._head is not None:
+            return self._head
+        with tracing.span("forkchoice/find_head"):
+            store = self.store
+            boost_root = bytes(store.proposer_boost_root)
+            boost = self._proposer_score if boost_root != _ZERO32 else 0
+            self._head = self.proto.find_head(
+                store.justified_checkpoint.root,
+                _cp(store.justified_checkpoint),
+                _cp(store.finalized_checkpoint),
+                int(self.spec.GENESIS_EPOCH),
+                boost_root=boost_root if boost else None,
+                boost_score=boost)
+        return self._head
+
+    @property
+    def justified_checkpoint(self):
+        return self.store.justified_checkpoint
+
+    @property
+    def finalized_checkpoint(self):
+        return self.store.finalized_checkpoint

@@ -94,7 +94,15 @@ def sharded_batch_fast_aggregate_verify(
     padded = [pairs for _, pairs in clean]
     while len(padded) % D:
         padded.append(padded[0])
-    K, Bp = 2, len(padded)
+    # K comes from the marshalled pairs themselves (FastAggregateVerify
+    # always yields 2 — e(pk_agg, H(m)) · e(-G1, sig) — but the device
+    # program is shaped by whatever the marshaller produced, not by a
+    # hardcoded constant that could silently drift from it)
+    K = len(padded[0])
+    assert all(len(ps) == K for ps in padded), (
+        "sharded pairing batch requires a uniform pair count per item; got "
+        f"{sorted({len(ps) for ps in padded})}")
+    Bp = len(padded)
     px = np.zeros((K, Bp, limbs.N_LIMBS), dtype=np.int64)
     py = np.zeros_like(px)
     qx = np.zeros((K, Bp, 2, limbs.N_LIMBS), dtype=np.int64)

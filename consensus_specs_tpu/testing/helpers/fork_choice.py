@@ -8,8 +8,17 @@ Shape differences from the reference: all "run handler, expect abort when
 invalid" plumbing funnels through ``_expecting_validity``; part naming goes
 through one ``_part_name`` table; the epoch/slots store-appliers share one
 implementation.
+
+Engine-backed mode: under ``engine_mode()`` every store built here gets a
+shadow ``forkchoice.ForkChoiceEngine`` (wrapping its own independent spec
+``Store``); each handler replays its input into the shadow expecting the
+same validity verdict, then asserts head + justified/finalized parity —
+so any scenario scripted through these helpers doubles as a differential
+test of the proto-array engine against the literal spec walk.
 """
 from __future__ import annotations
+
+import contextlib
 
 from ..exceptions import BlockNotFoundException
 from .attestations import next_slots_with_attestations
@@ -58,6 +67,66 @@ def _slot_wall_time(spec, state, slot) -> int:
     return int(state.genesis_time) + int(slot) * int(spec.config.SECONDS_PER_SLOT)
 
 
+# -- engine-backed differential mode -----------------------------------------
+
+_engine_mode = False
+_engine_mirrors: dict = {}  # id(primary store) -> ForkChoiceEngine
+
+
+@contextlib.contextmanager
+def engine_mode():
+    """Mirror every helper-driven store mutation into a shadow proto-array
+    engine and assert head/checkpoint parity after each step."""
+    global _engine_mode
+    prev = _engine_mode
+    _engine_mode = True
+    try:
+        yield
+    finally:
+        _engine_mode = prev
+        if not _engine_mode:
+            _engine_mirrors.clear()
+
+
+def _mirror(store):
+    if not _engine_mode:
+        return None
+    entry = _engine_mirrors.get(id(store))
+    # the strong store ref both prevents id reuse and confirms the match
+    if entry is None or entry[0] is not store:
+        return None
+    return entry[1]
+
+
+def _mirror_replay(spec, store, valid, call):
+    """Replay a handler into the shadow engine with the same validity
+    expectation the primary store was held to, then check parity."""
+    eng = _mirror(store)
+    if eng is None:
+        return
+    _expecting_validity(lambda: call(eng), valid)
+    if valid:
+        assert_engine_parity(spec, store)
+
+
+def assert_engine_parity(spec, store):
+    """Heads and checkpoints must be byte-identical between the literal
+    spec walk over ``store`` and the shadow engine's proto-array."""
+    eng = _mirror(store)
+    if eng is None:
+        return
+    # the spec materializes the justified checkpoint state lazily on the
+    # first matching attestation; parity queries the head at points the
+    # original scenarios didn't, so materialize it the spec's own way
+    spec.store_target_checkpoint_state(store, store.justified_checkpoint)
+    assert bytes(eng.get_head()) == bytes(spec.get_head(store)), \
+        "proto-array engine head diverged from spec get_head"
+    assert eng.store.justified_checkpoint == store.justified_checkpoint, \
+        "engine justified checkpoint diverged"
+    assert eng.store.finalized_checkpoint == store.finalized_checkpoint, \
+        "engine finalized checkpoint diverged"
+
+
 # -- store construction ------------------------------------------------------
 
 def get_anchor_root(spec, state):
@@ -70,7 +139,13 @@ def get_anchor_root(spec, state):
 def get_genesis_forkchoice_store_and_block(spec, genesis_state):
     assert genesis_state.slot == spec.GENESIS_SLOT
     anchor = spec.BeaconBlock(state_root=genesis_state.hash_tree_root())
-    return spec.get_forkchoice_store(genesis_state, anchor), anchor
+    store = spec.get_forkchoice_store(genesis_state, anchor)
+    if _engine_mode:
+        from consensus_specs_tpu.forkchoice import ForkChoiceEngine
+
+        shadow = spec.get_forkchoice_store(genesis_state, anchor)
+        _engine_mirrors[id(store)] = (store, ForkChoiceEngine(spec, shadow))
+    return store, anchor
 
 
 def get_genesis_forkchoice_store(spec, genesis_state):
@@ -83,16 +158,23 @@ def run_on_block(spec, store, signed_block, valid=True):
     done = _expecting_validity(lambda: spec.on_block(store, signed_block), valid)
     if done:
         assert store.blocks[signed_block.message.hash_tree_root()] == signed_block.message
+    _mirror_replay(spec, store, valid, lambda eng: eng.on_block(signed_block))
 
 
 def run_on_attestation(spec, store, attestation, is_from_block=False, valid=True):
     _expecting_validity(
         lambda: spec.on_attestation(store, attestation, is_from_block=is_from_block), valid)
+    _mirror_replay(
+        spec, store, valid,
+        lambda eng: eng.on_attestations([attestation], is_from_block=is_from_block))
 
 
 def run_on_attester_slashing(spec, store, attester_slashing, valid=True):
-    _expecting_validity(
+    completed = _expecting_validity(
         lambda: spec.on_attester_slashing(store, attester_slashing), valid)
+    _mirror_replay(spec, store, valid,
+                   lambda eng: eng.on_attester_slashing(attester_slashing))
+    return completed
 
 
 def add_block_to_store(spec, store, signed_block):
@@ -100,13 +182,16 @@ def add_block_to_store(spec, store, signed_block):
     arrival = _slot_wall_time(spec, parent_state, signed_block.message.slot)
     if store.time < arrival:
         spec.on_tick(store, arrival)
+        _mirror_replay(spec, store, True, lambda eng: eng.on_tick(arrival))
     spec.on_block(store, signed_block)
+    _mirror_replay(spec, store, True, lambda eng: eng.on_block(signed_block))
 
 
 # -- step-recording drivers (yield ssz parts, append step dicts) -------------
 
 def on_tick_and_append_step(spec, store, time, test_steps):
     spec.on_tick(store, time)
+    _mirror_replay(spec, store, True, lambda eng: eng.on_tick(time))
     test_steps.append({"tick": int(time)})
 
 
@@ -169,7 +254,7 @@ def tick_and_add_block(spec, store, signed_block, test_steps, valid=True,
 
 
 def add_attestation(spec, store, attestation, test_steps, is_from_block=False):
-    spec.on_attestation(store, attestation, is_from_block=is_from_block)
+    run_on_attestation(spec, store, attestation, is_from_block=is_from_block)
     part = get_attestation_file_name(attestation)
     yield part, attestation
     test_steps.append({"attestation": part})
@@ -190,8 +275,7 @@ def tick_and_run_on_attestation(spec, store, attestation, test_steps, is_from_bl
 def add_attester_slashing(spec, store, attester_slashing, test_steps, valid=True):
     part = get_attester_slashing_file_name(attester_slashing)
     yield part, attester_slashing
-    completed = _expecting_validity(
-        lambda: spec.on_attester_slashing(store, attester_slashing), valid)
+    completed = run_on_attester_slashing(spec, store, attester_slashing, valid)
     step = {"attester_slashing": part}
     if not completed:
         step["valid"] = False

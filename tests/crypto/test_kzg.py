@@ -150,3 +150,62 @@ def test_blob_to_kzg_native_and_python_paths_agree():
     blob = [rng.randrange(fr.R) for _ in range(n)]
     via_blob = kzg.blob_to_kzg(blob, setup)  # native fixed-base when present
     assert via_blob == g1_to_bytes(kzg.g1_msm_pippenger(setup, blob))
+
+
+def test_msm_table_disk_cache_keys_on_abi(tmp_path, monkeypatch):
+    """ADVICE r5 #1: the persisted MSM-table cache key folds in an ABI tag
+    (byte order + pointer width + digest of the generator's serialized
+    window table).  A table written by an incompatible build host lands at
+    a different path, so it can never pass the integrity check — the
+    loader sees a cache miss and rebuilds instead of feeding foreign
+    Montgomery limbs to the C side."""
+    nat = kzg._native_mod()
+    if nat is None:
+        pytest.skip("native backend unavailable")
+    setup = kzg.setup_lagrange(4)
+    flat = kzg._points_affine_bytes(setup)
+
+    tag = kzg._msm_abi_tag(nat)
+    assert len(tag) == 8
+    path_here = kzg._fixed_table_path(nat, flat)
+    assert f"_{tag}_" in path_here
+
+    table = kzg._load_or_build_fixed_table(nat, flat)
+    import os
+    assert os.path.exists(path_here)
+
+    # simulate loading on a host with a different ABI: the key changes, the
+    # compatible-host table is invisible, and the rebuild round-trips
+    monkeypatch.setattr(kzg, "_MSM_ABI_TAG", "00000000")
+    path_other = kzg._fixed_table_path(nat, flat)
+    assert path_other != path_here
+    assert not os.path.exists(path_other)
+    table2 = kzg._load_or_build_fixed_table(nat, flat)
+    assert table2 == table  # deterministic rebuild on this (same) host
+    assert os.path.exists(path_other)
+    os.unlink(path_other)  # don't leave the fake-ABI artifact behind
+
+
+def test_msm_abi_tag_tracks_table_serialization(monkeypatch):
+    """The tag's behavioral probe is the serialized window table of the
+    generator: a backend whose precompute emits different bytes (different
+    limb layout) must produce a different tag."""
+    nat = kzg._native_mod()
+    if nat is None:
+        pytest.skip("native backend unavailable")
+    real = kzg._msm_abi_tag(nat)
+
+    class _AlienABI:
+        _source_digest = staticmethod(nat._source_digest)
+        _MSM_FIXED_WINDOWS = nat._MSM_FIXED_WINDOWS
+
+        @staticmethod
+        def G1MSMPrecompute(xy):
+            table = nat.G1MSMPrecompute(xy)
+            return table[::-1]  # same data, alien byte order
+
+    monkeypatch.setattr(kzg, "_MSM_ABI_TAG", None)
+    alien = kzg._msm_abi_tag(_AlienABI)
+    monkeypatch.setattr(kzg, "_MSM_ABI_TAG", None)
+    assert kzg._msm_abi_tag(nat) == real  # cache rebuilt, stable
+    assert alien != real

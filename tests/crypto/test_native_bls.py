@@ -307,3 +307,69 @@ def test_deferred_scope_inactive_when_bls_off():
     finally:
         bls.bls_active = was
         bls.use_backend(prev)
+
+
+# --- fixed-base MSM table integrity + window-count pins (ADVICE r5 #2, #4) --
+
+def _gen_xy():
+    g = g1_generator()
+    return g.x.n.to_bytes(48, "big") + g.y.n.to_bytes(48, "big")
+
+
+def test_msm_fixed_rejects_corrupted_table():
+    """The 'corrupted MSM table' ValueError must be a REAL failure mode:
+    the C side sanity-checks the first table entry against the curve, so a
+    byte flipped anywhere in entry 0 (either coordinate) is rejected
+    instead of silently producing a wrong commitment."""
+    xy = _gen_xy()
+    table = native.G1MSMPrecompute(xy)
+    scalar = (7).to_bytes(32, "big")
+    ok = native.G1MSMFixed(table, 1, scalar)
+    assert ok == native.G1MSM(xy, scalar)
+    for byte_off in (0, 17, 48, 95):  # x limbs and y limbs of entry 0
+        bad = bytearray(table)
+        bad[byte_off] ^= 0x5A
+        with pytest.raises(ValueError, match="corrupted MSM table"):
+            native.G1MSMFixed(bytes(bad), 1, scalar)
+
+
+def _g1_affine_xy(p):
+    """Canonical affine x||y of a Jacobian point."""
+    from consensus_specs_tpu.crypto.bls.fields import P as FQ_P
+    zi = pow(p.z.n, FQ_P - 2, FQ_P)
+    zi2 = zi * zi % FQ_P
+    x = p.x.n * zi2 % FQ_P
+    y = p.y.n * zi2 % FQ_P * zi % FQ_P
+    return x.to_bytes(48, "big") + y.to_bytes(48, "big")
+
+
+def test_msm_fixed_corruption_check_result_still_correct():
+    """The sanity probe must not perturb results: a multi-point fixed-base
+    MSM still matches the variable-base Pippenger bit-for-bit."""
+    from consensus_specs_tpu.crypto.bls.curve import g1_from_bytes
+
+    sks = [3, 2**254 + 11, 0x123456789ABCDEF]
+    flat = b"".join(
+        _g1_affine_xy(g1_from_bytes(native.SkToPk(sk))) for sk in sks)
+    scalars = b"".join(((s * 31) % (2**255)).to_bytes(32, "big") for s in sks)
+    table = native.G1MSMPrecompute(flat)
+    assert native.G1MSMFixed(table, len(sks), scalars) == \
+        native.G1MSM(flat, scalars)
+
+
+def test_msm_window_counts_use_ceil():
+    """ADVICE r5 #4: both the Pippenger cost model and every n_windows
+    computation must use ceil(255/c) — the (255+c)/c form pays an always-
+    empty top window whenever c divides 255 (c = 3, 5, 15)."""
+    import math
+    import os
+    # the exported fixed-base window count is the C side's own layout
+    assert native._MSM_FIXED_WINDOWS == math.ceil(255 / 12)
+    # source pin: no remaining biased-window forms
+    src_path = os.path.join(os.path.dirname(native.__file__), "native",
+                            "bls12_381.cpp")
+    with open(src_path) as f:
+        src = f.read()
+    assert "(255 + t) / t" not in src
+    assert "(255 + c) / c" not in src
+    assert "(255 + MSM_FIXED_C) / MSM_FIXED_C" not in src

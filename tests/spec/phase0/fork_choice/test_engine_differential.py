@@ -1,0 +1,301 @@
+"""Differential suite: proto-array engine vs the literal spec ``Store``.
+
+Two layers of pinning:
+
+* **Adversarial replays** — every scenario in this package's get_head /
+  ex_ante / on_block suites re-runs under ``engine_mode()``: each helper-
+  driven store mutation is mirrored into a shadow ``ForkChoiceEngine``
+  and head + justified/finalized parity is asserted after every step
+  (testing/helpers/fork_choice.py), so the existing adversarial scripts
+  double as engine differentials.
+
+* **Random chains** — seeded random block DAGs (forks off random known
+  tips, skip slots, scattered LMD votes, full-participation epochs deep
+  enough to move justified/finalized and trigger pruning) driven through
+  both paths with parity asserted at every delivery; plus unit pins for
+  the batched latest-message fold against the sequential spec fold and
+  for the two segment-sum backends.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.ops.segment import segment_sum
+from consensus_specs_tpu.testing.context import (
+    spec_state_test,
+    with_phases,
+    with_presets,
+)
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+    sign_attestation,
+)
+from consensus_specs_tpu.testing.helpers.constants import MINIMAL
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    apply_next_epoch_with_attestations,
+    assert_engine_parity,
+    engine_mode,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    run_on_attestation,
+    tick_and_add_block,
+    tick_and_run_on_attestation,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+from .scenario import begin_forkchoice, make_branch_block, root_of, slot_time
+
+# -- adversarial replays ------------------------------------------------------
+
+from . import test_ex_ante as _ex_ante
+from . import test_get_head as _get_head
+from . import test_on_block as _on_block
+
+_REPLAY_CASES = [
+    (mod, name)
+    for mod in (_get_head, _ex_ante, _on_block)
+    for name in sorted(dir(mod))
+    if name.startswith("test_")
+]
+
+
+@pytest.mark.parametrize(
+    "mod,name", _REPLAY_CASES,
+    ids=[f"{m.__name__.rsplit('.', 1)[-1]}::{n}" for m, n in _REPLAY_CASES])
+def test_replay_scenario_through_engine(mod, name):
+    """Re-run an existing adversarial fork-choice scenario with the engine
+    mirror attached: parity is asserted after every store mutation.  BLS
+    off: the originals already pin signature handling, and this exercises
+    the batch path's vectorized no-BLS validation residue (the random
+    cases below keep BLS on)."""
+    with engine_mode():
+        getattr(mod, name)(phase="phase0", bls_active=False)
+
+
+# -- random-chain differential ------------------------------------------------
+
+
+def _vote_for_block(spec, rng, post, signed):
+    """A partial-committee attestation at the block's slot voting for it."""
+    att = get_valid_attestation(
+        spec, post, slot=post.slot, signed=False,
+        filter_participant_set=lambda comm: set(
+            sorted(comm)[:rng.randint(1, max(1, len(comm) // 2))]))
+    att.data.beacon_block_root = root_of(signed)
+    sign_attestation(spec, post, att)
+    return att
+
+
+def _deliver_vote(spec, store, att, test_steps):
+    """Mature the clock past the attested slot, then deliver the vote with
+    the validity verdict the spec's epoch-window check implies — random
+    DAGs legitimately produce votes whose target epoch has aged out, and
+    the engine must reject those exactly like the spec."""
+    mature = slot_time(spec, store, int(att.data.slot) + 1)
+    if store.time < mature:
+        on_tick_and_append_step(spec, store, mature, test_steps)
+    current_epoch = spec.compute_epoch_at_slot(spec.get_current_slot(store))
+    previous_epoch = max(int(current_epoch) - 1, int(spec.GENESIS_EPOCH))
+    valid = int(att.data.target.epoch) in (int(current_epoch), previous_epoch)
+    run_on_attestation(spec, store, att, valid=valid)
+    return valid
+
+
+def _run_random_forkchoice(spec, state, seed):
+    """Seeded random DAG: blocks fork off random known tips with random
+    skip distances; votes land on random blocks (sometimes long-stale,
+    exercising the rejection path); every delivery asserts engine parity
+    (helpers mirror)."""
+    rng = random.Random(seed)
+    test_steps = []
+    genesis_state = state.copy()
+    store = yield from begin_forkchoice(spec, state, test_steps)
+
+    blocks = []          # [(signed block, post state)]
+    base_states = [genesis_state]
+
+    for round_ in range(3):
+        # grow the DAG: a few blocks off random known states
+        for _ in range(rng.randint(2, 4)):
+            base = rng.choice(base_states)
+            slot = int(base.slot) + rng.randint(1, 3)
+            signed, post = make_branch_block(spec, base, slot)
+            blocks.append((signed, post))
+            base_states.append(post)
+            yield from tick_and_add_block(spec, store, signed, test_steps)
+            assert_engine_parity(spec, store)
+        # scatter LMD votes over random known blocks
+        for _ in range(rng.randint(1, 3)):
+            signed, post = rng.choice(blocks)
+            att = _vote_for_block(spec, rng, post, signed)
+            _deliver_vote(spec, store, att, test_steps)
+            assert_engine_parity(spec, store)
+    yield "steps", "data", test_steps
+
+
+def _make_random_case(seed):
+    @with_phases(["phase0"])
+    @spec_state_test
+    def case(spec, state):
+        with engine_mode():
+            yield from _run_random_forkchoice(spec, state, seed)
+
+    return case
+
+
+for _seed in range(20):
+    globals()[f"test_engine_differential_random_{_seed}"] = \
+        _make_random_case(_seed)
+del _seed
+
+
+# -- deep-chain differential (justified/finalized movement + pruning) --------
+
+
+def _make_deep_case(seed):
+    @with_phases(["phase0"])
+    @spec_state_test
+    @with_presets([MINIMAL], reason="too slow")
+    def case(spec, state):
+        """Full-participation epochs through the store until finalization
+        advances: exercises balance refresh on justified change and
+        proto-array pruning on finalized change, with a competing fork
+        plus votes afterwards."""
+        rng = random.Random(seed)
+        test_steps = []
+        with engine_mode():
+            store = yield from begin_forkchoice(spec, state, test_steps)
+            next_epoch(spec, state)
+            on_tick_and_append_step(
+                spec, store, slot_time(spec, store, state.slot), test_steps)
+            for _ in range(3):
+                state, store, last_block = yield from \
+                    apply_next_epoch_with_attestations(
+                        spec, state, store, True, True, test_steps=test_steps)
+                assert_engine_parity(spec, store)
+            assert store.finalized_checkpoint.epoch > 0
+            # competing fork off the head, then votes for it
+            base = store.block_states[spec.get_head(store)].copy()
+            signed, post = make_branch_block(
+                spec, base, int(base.slot) + rng.randint(1, 2))
+            yield from tick_and_add_block(spec, store, signed, test_steps)
+            assert_engine_parity(spec, store)
+            att = get_valid_attestation(
+                spec, post, slot=post.slot, signed=False)
+            att.data.beacon_block_root = root_of(signed)
+            sign_attestation(spec, post, att)
+            yield from tick_and_run_on_attestation(
+                spec, store, att, test_steps)
+            assert_engine_parity(spec, store)
+        yield "steps", "data", test_steps
+
+    return case
+
+
+for _seed in (100,):
+    globals()[f"test_engine_differential_deep_{_seed}"] = _make_deep_case(_seed)
+del _seed
+
+
+# -- unit pins ----------------------------------------------------------------
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_batched_latest_message_fold_matches_sequential(spec, state):
+    """The batch reduction (earliest entry of the max epoch, strict-epoch
+    gate) must leave ``store.latest_messages`` byte-identical to the
+    spec's sequential fold for a batch with repeated validators across
+    two target epochs and varying LMD roots."""
+    from consensus_specs_tpu.forkchoice import ForkChoiceEngine
+
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state.copy())
+    engine = ForkChoiceEngine(
+        spec, get_genesis_forkchoice_store_and_block(spec, state.copy())[0])
+
+    # linear chain through epochs 1-2 so two target epochs exist
+    st = state.copy()
+    blocks, posts = [], []
+    spe = int(spec.SLOTS_PER_EPOCH)
+    for _ in range(2 * spe):
+        signed, st = make_branch_block(spec, st, int(st.slot) + 1)
+        blocks.append(signed)
+        posts.append(st)
+    for signed in blocks:
+        t = slot_time(spec, store, signed.message.slot)
+        if store.time < t:
+            spec.on_tick(store, t)
+            engine.on_tick(t)
+        spec.on_block(store, signed)
+        engine.on_block(signed)
+    # clock one slot past the tip: epoch 2 is current, epoch 1 previous —
+    # every target below stays inside the spec's ingestion window
+    t = slot_time(spec, store, int(blocks[-1].message.slot) + 1)
+    spec.on_tick(store, t)
+    engine.on_tick(t)
+
+    # attestations at random slots of epochs 1-2, each voting a random
+    # block between its target's epoch start and its own slot
+    rng = random.Random(7)
+    atts = []
+    for _ in range(10):
+        i = rng.randint(spe - 1, 2 * spe - 1)   # block index; slot = i + 1
+        slot = i + 1
+        epoch_start_idx = (slot // spe) * spe - 1
+        att = get_valid_attestation(
+            spec, posts[i], slot=slot, signed=False,
+            filter_participant_set=lambda comm: set(
+                rng.sample(sorted(comm), max(1, len(comm) // 2))))
+        att.data.beacon_block_root = \
+            blocks[rng.randint(epoch_start_idx, i)].message.hash_tree_root()
+        sign_attestation(spec, posts[i], att)
+        atts.append(att)
+    rng.shuffle(atts)
+    for att in atts:
+        spec.on_attestation(store, att)
+    engine.on_attestations(atts)
+    assert dict(store.latest_messages) == dict(engine.store.latest_messages)
+    assert bytes(spec.get_head(store)) == bytes(engine.get_head())
+
+
+def test_segment_sum_backends_agree():
+    rng = np.random.default_rng(3)
+    values = rng.integers(0, 32_000_000_000, 5000)
+    ids = rng.integers(0, 37, 5000)
+    host = segment_sum(values, ids, 37, backend="numpy")
+    dev = segment_sum(values, ids, 37, backend="jax")
+    assert host.dtype == np.int64
+    assert np.array_equal(host, dev)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_engine_wraps_warm_store_with_standing_votes(spec, state):
+    """Constructing the engine around a store that already carries latest
+    messages must seed the proto-array votes — parity from the very first
+    ``get_head``, not just for stores the engine saw grow."""
+    from consensus_specs_tpu.forkchoice import ForkChoiceEngine
+
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state.copy())
+    base = state.copy()
+    side_a = base.copy()
+    signed_a, post_a = make_branch_block(spec, side_a, int(base.slot) + 1)
+    side_b = base.copy()
+    signed_b, post_b = make_branch_block(spec, side_b, int(base.slot) + 1)
+    if bytes(root_of(signed_a)) > bytes(root_of(signed_b)):
+        signed_a, post_a, signed_b, post_b = signed_b, post_b, signed_a, post_a
+    # deliver both, then vote for the lexicographically SMALLER root so
+    # the head depends on the standing vote, not the tie-break
+    t = slot_time(spec, store, int(spec.SLOTS_PER_EPOCH) + 2)
+    spec.on_tick(store, t)
+    spec.on_block(store, signed_a)
+    spec.on_block(store, signed_b)
+    att = get_valid_attestation(spec, post_a, slot=post_a.slot, signed=False)
+    att.data.beacon_block_root = root_of(signed_a)
+    sign_attestation(spec, post_a, att)
+    spec.on_attestation(store, att)
+    assert bytes(spec.get_head(store)) == bytes(root_of(signed_a))
+
+    engine = ForkChoiceEngine(spec, store)  # wrap the WARM store
+    assert bytes(engine.get_head()) == bytes(spec.get_head(store))

@@ -1,0 +1,70 @@
+"""FC01 lint rule: the spec ``Store`` and the proto-array engine each hold
+a latest-message view; they stay in lockstep only if every write goes
+through the spec handlers or ``forkchoice/batch.py``.  The rule flags any
+direct ``store.latest_messages`` mutation outside ``specs/`` and
+``forkchoice/`` — and the live tree must be clean."""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import lint  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+_VIOLATIONS = """\
+def bad(store, spec, i, msg):
+    store.latest_messages[i] = msg          # subscript assign
+    store.latest_messages.update({i: msg})  # mutating method
+    del store.latest_messages[i]            # deletion
+    store.latest_messages = {}              # rebind
+"""
+
+_CLEAN = """\
+def good(spec, store, att):
+    spec.on_attestation(store, att)
+    return store.latest_messages.get(0), len(store.latest_messages)
+"""
+
+
+def _findings_for(tmp_path, name, source, code="FC01"):
+    p = tmp_path / name
+    p.write_text(source)
+    return [f for f in lint.check_file(p) if code in f[2]]
+
+
+def test_fc01_flags_every_mutation_shape(tmp_path):
+    found = _findings_for(tmp_path, "helpers.py", _VIOLATIONS)
+    assert sorted(f[1] for f in found) == [2, 3, 4, 5]
+
+
+def test_fc01_ignores_reads(tmp_path):
+    assert _findings_for(tmp_path, "helpers.py", _CLEAN) == []
+
+
+def test_fc01_exempts_spec_and_forkchoice_dirs(tmp_path):
+    for exempt in ("specs", "forkchoice"):
+        d = tmp_path / exempt
+        d.mkdir()
+        assert _findings_for(d, "impl.py", _VIOLATIONS) == []
+
+
+def test_fc01_respects_noqa(tmp_path):
+    src = "def f(s, m):\n    s.latest_messages[0] = m  # noqa\n"
+    assert _findings_for(tmp_path, "x.py", src) == []
+
+
+def test_live_tree_is_fc01_clean():
+    findings = []
+    for f in lint.iter_py_files(
+            [REPO / "consensus_specs_tpu", REPO / "tests", REPO / "tools",
+             REPO / "bench.py"]):
+        findings += [x for x in lint.check_file(f) if "FC01" in x[2]]
+    assert findings == [], findings
+
+
+def test_fc01_ignores_bare_annotations(tmp_path):
+    src = ("def f(store, m):\n"
+           "    store.latest_messages: dict\n"          # declaration only
+           "    store.latest_messages: dict = {0: m}\n")  # annotated write
+    found = _findings_for(tmp_path, "x.py", src)
+    assert [f[1] for f in found] == [3]

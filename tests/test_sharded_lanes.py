@@ -76,3 +76,35 @@ def test_sharded_bls_rejects_malformed_and_empty():
     assert got[1] is False          # malformed pubkey
     assert got[2] is True           # valid
     assert got[3] is False          # malformed signature
+
+
+def test_sharded_bls_pair_count_derived_not_hardcoded(monkeypatch):
+    """ADVICE r5 #3: the per-item pair count K is derived from the
+    marshalled pairs (K = len(padded[0])), with a clear assert on ragged
+    batches — a marshaller change can no longer silently disagree with a
+    hardcoded K=2."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite as cs
+    from consensus_specs_tpu.ops import bls_jax
+    from consensus_specs_tpu.parallel.bls_sharded import (
+        sharded_batch_fast_aggregate_verify,
+    )
+
+    mesh = _mesh(2)
+    msg = b"\x07" * 32
+    pk, sig = cs.SkToPk(21), cs.Sign(21, msg)
+
+    # a marshaller that returns a ragged batch must trip the uniformity
+    # assert, not shape-garble the device program
+    real = bls_jax.marshal_fast_aggregate_items
+
+    def ragged(pk_lists, msgs, sigs):
+        results, todo = real(pk_lists, msgs, sigs)
+        b, pairs = todo[0]
+        todo[0] = (b, pairs + [pairs[0]])  # 3 pairs vs 2 elsewhere
+        return results, todo
+
+    monkeypatch.setattr(bls_jax, "marshal_fast_aggregate_items", ragged)
+    import pytest as _pytest
+    with _pytest.raises(AssertionError, match="uniform pair count"):
+        sharded_batch_fast_aggregate_verify(
+            mesh, [[pk], [pk]], [msg, msg], [sig, sig])

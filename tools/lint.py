@@ -11,10 +11,16 @@ CI does:
   W291  trailing whitespace
   W191  tab indentation
   B001  bare except
+  FC01  direct store.latest_messages mutation outside specs/ + forkchoice/
 
 Spec-source files (`specs/src/*.py`) are exempt from E501: their bodies
 are pinned AST-for-AST to the reference markdown and must not be
-rewrapped.  Usage: python tools/lint.py [paths...]; exit 1 on findings.
+rewrapped.  FC01 is a project rule, not a flake8 one: the spec ``Store``
+and the proto-array engine each hold a latest-message view, and they stay
+in lockstep only if every write goes through the spec handlers or
+``forkchoice/batch.py`` — a stray ``store.latest_messages[i] = ...``
+anywhere else silently desynchronizes the two vote stores.  Usage:
+python tools/lint.py [paths...]; exit 1 on findings.
 """
 from __future__ import annotations
 
@@ -113,7 +119,50 @@ def check_file(path: Path) -> list:
             if node.lineno not in noqa_lines:
                 findings.append((path, node.lineno, "B001 bare except"))
 
+    parts = Path(path).parts
+    if "specs" not in parts and "forkchoice" not in parts:
+        for lineno in _latest_messages_mutations(tree):
+            if lineno not in noqa_lines:
+                findings.append((path, lineno,
+                                 "FC01 direct store.latest_messages mutation "
+                                 "(route through spec handlers or "
+                                 "forkchoice/batch.py)"))
+
     return findings
+
+
+_MUTATING_DICT_METHODS = {"update", "pop", "popitem", "clear", "setdefault",
+                          "__setitem__", "__delitem__"}
+
+
+def _is_latest_messages(expr) -> bool:
+    return isinstance(expr, ast.Attribute) and expr.attr == "latest_messages"
+
+
+def _latest_messages_mutations(tree):
+    """Line numbers of writes into a ``.latest_messages`` mapping: subscript
+    assignment / augmented assignment / deletion, mutating dict-method
+    calls, and rebinding the attribute itself."""
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:  # bare annotations declare, not write
+                targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        for t in targets:
+            if isinstance(t, ast.Subscript) and _is_latest_messages(t.value):
+                yield node.lineno
+            elif _is_latest_messages(t):
+                yield node.lineno
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if (node.func.attr in _MUTATING_DICT_METHODS
+                    and _is_latest_messages(node.func.value)):
+                yield node.lineno
 
 
 def main(argv):
