@@ -283,11 +283,22 @@ def _build_epoch_blocks(spec, state, with_sync=False):
 def bench_epoch_e2e_bls(results):
     """Permanent metric ``mainnet_epoch_e2e_bls_on_<N>``: one full epoch of
     32 signed mainnet blocks — each carrying 128 aggregate attestations
-    (the two preceding slots' 64 committees) — through ``state_transition``
-    with BLS verification ON, ending in the epoch transition (SURVEY §3.2
-    end-to-end; reference: phase0/beacon-chain.md:1241-1253, 1807-1833)."""
+    (the two preceding slots' 64 committees) — with BLS verification ON,
+    ending in the epoch transition (SURVEY §3.2 end-to-end; reference:
+    phase0/beacon-chain.md:1241-1253, 1807-1833).
+
+    ``value`` is the SHIPPING path — the batched block-transition engine
+    (``stf.apply_signed_blocks``: one BLS multi-pairing per block with
+    cross-block triple dedup, vectorized attestation application, resident
+    slot roots) — measured A/B against the literal per-block
+    ``spec.state_transition`` replay in the same process (the PR-1
+    measurement position), with byte-identical post-state roots asserted
+    in-run.  The engine run reports a phase breakdown so regressions
+    localize."""
+    from consensus_specs_tpu import stf
     from consensus_specs_tpu.crypto import bls
     from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.stf import verify as stf_verify
 
     spec = get_spec("phase0", "mainnet")
     bls.use_fastest()
@@ -302,15 +313,39 @@ def bench_epoch_e2e_bls(results):
     # -- measured phase: full verification + transition, BLS ON
     bls.bls_active = True
 
-    def _replay():
+    def _spec_replay():
+        s = state.copy()
         for sb in signed_blocks:
-            spec.state_transition(state, sb, True)
+            spec.state_transition(s, sb, True)
+        return s
 
-    t_e2e, _ = _timed(_replay)
+    t_spec, spec_post = _timed(_spec_replay)
+
+    stf.reset_stats()
+    stf_verify.reset_memo()  # cold dedup memo: the engine warms it itself
+    # cold-start symmetry: the spec replay warmed the native pubkey
+    # decompression cache; the engine leg must pay its own decompression +
+    # membership checks and committee-geometry builds, like the spec leg did
+    from consensus_specs_tpu.stf import attestations as stf_attestations
+
+    stf_attestations.reset_caches()
+
+    def _engine_replay():
+        s = state.copy()
+        stf.apply_signed_blocks(spec, s, signed_blocks, True)
+        return s
+
+    t_e2e, engine_post = _timed(_engine_replay)
     bls.bls_active = False
-    assert int(state.slot) % int(spec.SLOTS_PER_EPOCH) == 0  # epoch boundary hit
+    assert int(engine_post.slot) % int(spec.SLOTS_PER_EPOCH) == 0  # epoch hit
+    assert bytes(engine_post.hash_tree_root()) == bytes(spec_post.hash_tree_root()), \
+        "engine post-state diverged from the literal spec replay"
+    assert stf.stats["fast_blocks"] == len(signed_blocks), \
+        f"engine fell back to spec replay on {stf.stats['replayed_blocks']} blocks"
 
     t_oracle_scaled = _oracle_verify_time(128) * n_atts
+    phases = {k: round(stf.stats[k], 3) for k in
+              ("sig_verify_s", "attestation_apply_s", "slot_roots_s", "other_s")}
 
     results["epoch_e2e_bls"] = {
         "metric": f"mainnet_epoch_e2e_bls_on_{N_VALIDATORS}",
@@ -320,11 +355,18 @@ def bench_epoch_e2e_bls(results):
         "blocks": len(signed_blocks),
         "aggregate_attestations_verified": n_atts,
         "per_block_s": round(t_e2e / len(signed_blocks), 3),
+        "literal_spec_s": round(t_spec, 3),
+        "vs_literal_spec": round(t_spec / t_e2e, 1),
+        "engine_spec_root_parity": True,
+        "sig_batches": stf_verify.stats["batches"],
+        "sig_entries_settled": stf_verify.stats["entries"],
+        "sig_memo_hits": stf_verify.stats["memo_hits"],
+        **phases,
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
         "block_corpus_cached": corpus_cached,
         "python_oracle_scaled_s": round(t_oracle_scaled, 1),
-        "bls_backend": bls.backend_name() if hasattr(bls, "backend_name") else "native",
+        "bls_backend": bls.backend_name(),
     }
 
 
@@ -401,7 +443,7 @@ def bench_epoch_e2e_bls_altair(results):
         "block_build_s": round(t_build_blocks, 3),
         "block_corpus_cached": corpus_cached,
         "python_oracle_scaled_s": round(t_oracle_scaled, 1),
-        "bls_backend": bls.backend_name() if hasattr(bls, "backend_name") else "native",
+        "bls_backend": bls.backend_name(),
     }
 
 
@@ -691,7 +733,7 @@ def bench_bls_batches(results):
         t_batch, ok = _timed(native.BatchFastAggregateVerify, items)
         assert ok
         t_seq, _ = _timed(
-            lambda: [native.FastAggregateVerify(pk_set, msg, agg)
+            lambda: [native.FastAggregateVerify(pk_set, msg, agg)  # noqa: ST01 sequential baseline
                      for _ in range(B)])
         bls_jax.batch_fast_aggregate_verify(
             [pk_set] * B, [msg] * B, [agg] * B)  # compile

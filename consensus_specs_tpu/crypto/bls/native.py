@@ -273,6 +273,61 @@ def BatchFastAggregateVerify(items, seed: bytes = None) -> bool:
     ))
 
 
+def pubkey_affine(pubkey: bytes):
+    """Validated 96-byte affine x||y for a compressed pubkey, or None when
+    malformed / off-subgroup / infinity (cached; the block-transition
+    engine gathers these into per-registry coordinate matrices so batch
+    entries skip the per-member dict walk)."""
+    return _affine_of(bytes(pubkey))
+
+
+def clear_affine_cache() -> None:
+    """Drop the decompressed-pubkey cache.  Measurement control: an A/B
+    bench leg that should pay its own cold decompression+membership cost
+    must not inherit the other leg's warm cache."""
+    _AFFINE_PKS.clear()
+
+
+def BatchFastAggregateVerifyFlat(counts: Sequence[int], flat_affines: bytes,
+                                 messages: Sequence[bytes],
+                                 signatures: Sequence[bytes],
+                                 seed: bytes = None) -> bool:
+    """Preflattened BatchFastAggregateVerify: the member pubkeys of every
+    item arrive as one contiguous affine-coordinate buffer (96-byte x||y
+    each, item i owning ``counts[i]`` consecutive entries) instead of
+    per-member compressed keys.  Coordinates must come from
+    ``pubkey_affine`` (validated + subgroup-checked); the C side trusts
+    them, exactly as it trusts the ``_affine_of`` cache in the compressed
+    path.  Same RLC multi-pairing and soundness as
+    ``BatchFastAggregateVerify``."""
+    counts = [int(c) for c in counts]
+    k = len(counts)
+    if k == 0:
+        return True
+    sigs = [bytes(s) for s in signatures]
+    msgs = [bytes(m) for m in messages]
+    if len(sigs) != k or len(msgs) != k:
+        raise ValueError(f"{k} counts vs {len(msgs)} messages / {len(sigs)} signatures")
+    if any(c <= 0 for c in counts) or any(len(s) != 96 for s in sigs):
+        return False
+    flat = bytes(flat_affines)
+    if len(flat) != 96 * sum(counts):
+        raise ValueError("affine buffer size inconsistent with counts")
+    if seed is None:
+        seed = os.urandom(32)
+    elif len(seed) != 32:
+        raise ValueError(f"seed must be exactly 32 bytes, got {len(seed)}")
+    return bool(_lib.bls_batch_fast_aggregate_verify_affine(
+        k,
+        _buf(flat),
+        (ctypes.c_size_t * k)(*counts),
+        _buf(b"".join(msgs)),
+        (ctypes.c_size_t * k)(*[len(m) for m in msgs]),
+        _buf(b"".join(sigs)),
+        _buf(seed),
+    ))
+
+
 def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signature: bytes) -> bool:
     pks = [bytes(p) for p in pubkeys]
     msgs = [bytes(m) for m in messages]

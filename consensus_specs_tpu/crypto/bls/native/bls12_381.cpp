@@ -668,6 +668,32 @@ static bool g2_in_subgroup_fast(const G2 &p) {
     return jac_eq(g2_psi(p), g2_mul_x(p));
 }
 
+// --- fast G1 membership (Scott, eprint 2021/1130) --------------------------
+// The GLV endomorphism sigma(x, y) = (beta*x, y) (beta a primitive cube
+// root of unity in Fp — the same constant psi^2 scales the twist's x by)
+// acts on the r-order subgroup as multiplication by an eigenvalue lambda
+// with lambda^2 + lambda + 1 = 0 (mod r); the two eigenvalues are -z^2 and
+// z^2 - 1 (r = z^4 - z^2 + 1).  For BLS12-381 no other E(Fp) point
+// satisfies sigma(P) == [-z^2]P, so the check needs two 64-bit scalar
+// mults instead of the generic 255-bit [r]P == inf.  Registry pubkeys are
+// decompressed + membership-checked once per validator (native.py affine
+// cache), which made this the dominant cold cost of the block engine.
+//
+// Orientation is self-established at init: whichever of {beta, beta^2}
+// satisfies sigma(G1_GEN) == [-z^2]G1_GEN is the eigenvalue -z^2 pairing
+// (an endomorphism relation that holds on the prime-order generator holds
+// on the whole subgroup).  If neither matches — foreign constants — the
+// generic [r]P check stays in force.
+static Fp G1_ENDO_BETA;
+static bool G1_FAST_CHECK_OK = false;
+
+static bool g1_in_subgroup_fast(const G1 &p) {
+    if (p.is_inf()) return true;
+    G1 sigma{p.x * G1_ENDO_BETA, p.y, p.z};
+    G1 z2p = mul_u64(mul_u64(p, ATE_LOOP), ATE_LOOP);  // [z^2]P: signs cancel
+    return jac_eq(sigma, z2p.neg());
+}
+
 static bool g1_on_curve(const Fp &x, const Fp &y) {
     return y.square() == x.square() * x + B1;
 }
@@ -1249,6 +1275,21 @@ static void bls_init_impl() {
     PSI_CX_C = fp2_from_limbs(PSI_CX_C0, PSI_CX_C1);
     PSI_CY_C = fp2_from_limbs(PSI_CY_C0, PSI_CY_C1);
     PSI2_CX_Q = fp_from_limbs(PSI2_CX);
+    // orient the G1 endomorphism: whichever cube root of unity pairs with
+    // eigenvalue -z^2 on the generator serves the fast membership check
+    {
+        G1 z2g = mul_u64(mul_u64(G1_GEN, ATE_LOOP), ATE_LOOP).neg();
+        Fp beta = PSI2_CX_Q;
+        for (int attempt = 0; attempt < 2; attempt++) {
+            G1 sigma{G1_GEN.x * beta, G1_GEN.y, G1_GEN.z};
+            if (jac_eq(sigma, z2g)) {
+                G1_ENDO_BETA = beta;
+                G1_FAST_CHECK_OK = true;
+                break;
+            }
+            beta = beta.square();  // the other primitive cube root
+        }
+    }
 }
 
 // ===========================================================================
@@ -1340,10 +1381,14 @@ static void g1_batch_to_affine(const std::vector<G1> &pts,
     }
 }
 
+static bool g1_in_subgroup(const G1 &p) {
+    return G1_FAST_CHECK_OK ? g1_in_subgroup_fast(p) : in_subgroup(p);
+}
+
 static int load_pubkey(G1 &out, const uint8_t pk[48]) {
     int rc = g1_deserialize(out, pk);
     if (rc) return rc;
-    if (!out.is_inf() && !in_subgroup(out)) return 5;
+    if (!out.is_inf() && !g1_in_subgroup(out)) return 5;
     return 0;
 }
 

@@ -77,6 +77,24 @@ class DeltaInputs(NamedTuple):
     effective_balance_increment: int
 
 
+def attesting_indices(spec, state, data, bits) -> np.ndarray:
+    """``get_attesting_indices`` for a state-resident pending attestation
+    as one numpy gather off the cached whole-epoch committee geometry
+    (stf/attestations.committee_context) — the spec call materializes the
+    committee as a Python list per attestation, which made the epoch's
+    pending-attestation scans the block-path replay's second-largest cost.
+    ``data`` was validated at inclusion, so ``compute_epoch_at_slot(slot)``
+    indexes a real committee.  Element-set equality with the spec call is
+    pinned by tests/spec/phase0/test_epoch_kernel.py."""
+    from consensus_specs_tpu.ssz import bulk
+    from consensus_specs_tpu.stf.attestations import committee_context
+
+    slot = int(data.slot)
+    ctx = committee_context(spec, state, slot // int(spec.SLOTS_PER_EPOCH))
+    committee = ctx.committee(slot, int(data.index))
+    return committee[bulk.bitlist_to_numpy(bits)]
+
+
 def extract_delta_inputs(spec, state) -> DeltaInputs:
     """Host-side flattening of state + pending attestations into arrays.
 
@@ -104,11 +122,7 @@ def extract_delta_inputs(spec, state) -> DeltaInputs:
     def participation(atts):
         mask = np.zeros(n, dtype=bool)
         for a in atts:
-            idx = np.fromiter(
-                spec.get_attesting_indices(state, a.data, a.aggregation_bits),
-                dtype=np.int64,
-            )
-            mask[idx] = True
+            mask[attesting_indices(spec, state, a.data, a.aggregation_bits)] = True
         return mask & ~slashed
 
     source_part = participation(source_atts)
@@ -120,10 +134,7 @@ def extract_delta_inputs(spec, state) -> DeltaInputs:
     incl_delay = np.full(n, np.iinfo(np.int64).max, dtype=np.int64)
     incl_proposer = np.zeros(n, dtype=np.int64)
     for a in source_atts:
-        idx = np.fromiter(
-            spec.get_attesting_indices(state, a.data, a.aggregation_bits),
-            dtype=np.int64,
-        )
+        idx = attesting_indices(spec, state, a.data, a.aggregation_bits)
         d = int(a.inclusion_delay)
         upd = d < incl_delay[idx]
         upd_idx = idx[upd]
@@ -292,11 +303,7 @@ def attestation_deltas_for_state(spec, state):
 def participation_mask(spec, state, attestations, n: int) -> np.ndarray:
     mask = np.zeros(n, dtype=bool)
     for a in attestations:
-        idx = np.fromiter(
-            spec.get_attesting_indices(state, a.data, a.aggregation_bits),
-            dtype=np.int64,
-        )
-        mask[idx] = True
+        mask[attesting_indices(spec, state, a.data, a.aggregation_bits)] = True
     return mask
 
 

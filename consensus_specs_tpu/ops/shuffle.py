@@ -64,3 +64,14 @@ def compute_shuffle_permutation(seed: bytes, index_count: int, round_count: int)
     m.setflags(write=False)  # shared across callers; mutation would corrupt committees
     _cache[key] = m
     return m
+
+
+def committee_bounds(n_active: int, committees_per_epoch: int) -> np.ndarray:
+    """Slice boundaries of every committee of an epoch over the shuffled
+    permutation: ``bounds[g] : bounds[g + 1]`` is the permutation range of
+    global committee index ``g`` (``(slot % SLOTS_PER_EPOCH) *
+    committees_per_slot + index``), exactly the spec's ``compute_committee``
+    start/end arithmetic (beacon-chain.md:944-950) evaluated for all
+    committees at once."""
+    g = np.arange(committees_per_epoch + 1, dtype=np.int64)
+    return (n_active * g) // committees_per_epoch

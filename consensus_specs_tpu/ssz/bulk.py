@@ -74,6 +74,16 @@ def set_packed_uint8_from_numpy(view, arr: np.ndarray) -> None:
     _set_packed_from_numpy(view, np.ascontiguousarray(arr, dtype=np.uint8))
 
 
+def bitlist_to_numpy(bits) -> np.ndarray:
+    """Bool column of a ``Bitlist``/``Bitvector`` view (the per-bit view
+    protocol costs a Python object per member; attestation batching reads
+    whole aggregation-bit columns)."""
+    inner = getattr(bits, "_bits", None)
+    if inner is not None:  # the in-repo bit views hold a plain bool list
+        return np.asarray(inner, dtype=bool)
+    return np.fromiter(bits, dtype=bool, count=len(bits))
+
+
 def composite_subtrees(view) -> list:
     """The backing subtree node of each element of a List/Vector of
     composites, left to right (no hashing is triggered)."""

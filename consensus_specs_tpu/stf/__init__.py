@@ -1,0 +1,23 @@
+"""Batched block-path transition engine.
+
+``apply_signed_blocks(spec, state, signed_blocks)`` replays signed blocks
+with one BLS multi-pairing per block (cross-block triple dedup), whole-
+block vectorized attestation application, and resident-routed per-slot
+roots — differentially pinned to byte-identical post-states and identical
+failure behavior vs the literal ``spec.state_transition``.
+
+Layers (see docs/architecture.md, "The block path"):
+
+* ``attestations`` — committee/attester resolution off the cached shuffle
+  permutation, bulk counts via ``ops/segment.py``, registry affine matrix;
+* ``verify``       — per-block signature batch: preflattened
+  ``BatchFastAggregateVerify`` entries, verified-triple memo, bisection;
+* ``slot_roots``   — spec-identical ``process_slots`` with dirty bulk
+  subtrees routed through the resident merkle path;
+* ``engine``       — the optimistic fast path + exact-spec replay
+  fallback that makes failure behavior literally the spec's.
+"""
+from .attestations import FastPathViolation
+from .engine import apply_signed_blocks, reset_stats, stats
+
+__all__ = ["apply_signed_blocks", "FastPathViolation", "reset_stats", "stats"]

@@ -1,0 +1,291 @@
+"""Whole-block attestation resolution for the batched transition engine.
+
+The spec's ``process_attestation`` resolves each aggregate's committee with
+``get_beacon_committee`` (a Python list comprehension over the shuffled
+permutation) and its attesters with a per-bit Python loop, then gathers
+per-member pubkeys one view access at a time — ~25k Python object hops per
+full mainnet block.  This module resolves the WHOLE block at once:
+
+* committees come straight off the cached whole-epoch shuffle permutation
+  (``ops/shuffle.py``) as numpy gathers — ``active[perm[start:end]]`` with
+  the spec's exact ``compute_committee`` slice arithmetic
+  (``ops/shuffle.committee_bounds``);
+* attester sets are one boolean mask + sort per attestation, with
+  per-attestation participation counts reduced in bulk by
+  ``ops/segment.segment_sum`` (the same primitive the fork-choice batch
+  path uses for vote deltas);
+* member pubkeys are rows of a registry-keyed affine-coordinate matrix
+  (decompressed once per validator through the native cache), so batch
+  entries are contiguous buffer slices instead of per-member dict walks.
+
+Every structural rule of ``process_attestation`` is checked here in spec
+order; any violation raises ``FastPathViolation`` and the engine replays
+the block through the literal spec path, which re-raises the spec's exact
+exception (stf/engine.py).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from consensus_specs_tpu.ops.segment import segment_sum
+from consensus_specs_tpu.ops.shuffle import committee_bounds, compute_shuffle_permutation
+from consensus_specs_tpu.ssz import bulk
+
+
+class FastPathViolation(Exception):
+    """A block failed a fast-path check (or needs a capability the fast
+    path lacks): the engine rolls back and replays through the literal
+    spec, which raises the spec's own exception."""
+
+
+# -- per-epoch committee geometry --------------------------------------------
+
+_ACTIVE_CACHE: dict = {}
+_CTX_CACHE: dict = {}
+_CTX_LOOKUP: dict = {}
+_CACHE_MAX = 8
+
+
+def _fifo_put(cache: dict, key, value):
+    if len(cache) >= _CACHE_MAX:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
+    return value
+
+
+def active_indices(spec, state, epoch: int) -> np.ndarray:
+    """Ascending active-validator index array for ``epoch`` (the numpy
+    form of ``get_active_validator_indices``), registry-root-cached."""
+    from consensus_specs_tpu.ops.epoch_jax import active_mask, registry_columns
+
+    key = (bytes(state.validators.hash_tree_root()), int(epoch))
+    hit = _ACTIVE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    return _fifo_put(_ACTIVE_CACHE, key, np.nonzero(
+        active_mask(registry_columns(state), int(epoch)))[0])
+
+
+class _CommitteeContext:
+    """Numpy view of one epoch's committees: active-validator array, the
+    cached shuffle permutation, and all committee slice bounds."""
+
+    def __init__(self, spec, state, epoch: int, seed: bytes):
+        self.active = active_indices(spec, state, epoch)
+        self.slots_per_epoch = int(spec.SLOTS_PER_EPOCH)
+        n = len(self.active)
+        # get_committee_count_per_slot (beacon-chain.md:931-940) off the
+        # active COUNT — the spec call would materialize the 400k-element
+        # active index list just to len() it
+        self.committees_per_slot = max(1, min(
+            int(spec.MAX_COMMITTEES_PER_SLOT),
+            n // self.slots_per_epoch // int(spec.TARGET_COMMITTEE_SIZE)))
+        count = self.committees_per_slot * self.slots_per_epoch
+        self.bounds = committee_bounds(n, count)
+        self.perm = compute_shuffle_permutation(
+            seed, n, int(spec.SHUFFLE_ROUND_COUNT))
+
+    def committee(self, slot: int, index: int) -> np.ndarray:
+        g = (slot % self.slots_per_epoch) * self.committees_per_slot + index
+        lo, hi = self.bounds[g], self.bounds[g + 1]
+        return self.active[self.perm[lo:hi]]
+
+
+def committee_context(spec, state, epoch: int) -> _CommitteeContext:
+    """Cached committee geometry.  The context itself is keyed on registry
+    root + attester seed (the full input set of the spec's committee
+    computation); a lookup layer keyed on the memoized registry/randao
+    roots makes the per-attestation hit path a dict probe instead of a
+    ``get_seed`` hash chain."""
+    lookup_key = (
+        bytes(state.validators.hash_tree_root()),
+        bytes(state.randao_mixes.hash_tree_root()),
+        int(epoch),
+    )
+    ctx = _CTX_LOOKUP.get(lookup_key)
+    if ctx is not None:
+        return ctx
+    seed = bytes(spec.get_seed(
+        state, spec.Epoch(epoch), spec.DOMAIN_BEACON_ATTESTER))
+    key = (lookup_key[0], int(epoch), seed)
+    ctx = _CTX_CACHE.get(key)
+    if ctx is None:
+        ctx = _fifo_put(
+            _CTX_CACHE, key, _CommitteeContext(spec, state, int(epoch), seed))
+    return _fifo_put(_CTX_LOOKUP, lookup_key, ctx)
+
+
+# -- proposer index off the numpy active set ---------------------------------
+
+_PROPOSER_CACHE: dict = {}
+
+
+def beacon_proposer_index(spec, state):
+    """``get_beacon_proposer_index`` (beacon-chain.md:954-961) evaluated
+    against the numpy active array: same seed, same scalar shuffled-index
+    walk, same effective-balance rejection sampling — without building the
+    spec's 400k-element ``ValidatorIndex`` list per epoch."""
+    from consensus_specs_tpu.ops.epoch_jax import registry_columns
+
+    epoch = spec.get_current_epoch(state)
+    seed = bytes(spec.hash(
+        spec.get_seed(state, epoch, spec.DOMAIN_BEACON_PROPOSER)
+        + spec.uint_to_bytes(spec.uint64(state.slot))))
+    key = (bytes(state.validators.hash_tree_root()), seed)
+    hit = _PROPOSER_CACHE.get(key)
+    if hit is not None:
+        return hit
+    active = active_indices(spec, state, int(epoch))
+    eff = registry_columns(state)["effective_balance"]
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    total = spec.uint64(len(active))
+    # compute_proposer_index (beacon-chain.md:886-902) verbatim over the
+    # numpy candidates; compute_shuffled_index is the spec's own (LRU'd)
+    assert total > 0
+    i = spec.uint64(0)
+    while True:
+        shuffled = spec.compute_shuffled_index(
+            spec.uint64(int(i) % int(total)), total, seed)
+        candidate = int(active[int(shuffled)])
+        random_byte = spec.hash(
+            seed + spec.uint_to_bytes(spec.uint64(int(i) // 32)))[int(i) % 32]
+        if int(eff[candidate]) * 255 >= max_eb * random_byte:
+            return _fifo_put(_PROPOSER_CACHE, key, spec.ValidatorIndex(candidate))
+        i = spec.uint64(int(i) + 1)
+
+
+# -- registry affine-coordinate matrix ---------------------------------------
+
+_AFFINE_MATRIX_CACHE = bulk.RootKeyedCache(2)
+
+_ZERO_ROW = b"\x00" * 96
+
+
+def _new_affine_matrix(validators):
+    """Eager whole-registry affine matrix: decompress each UNIQUE pubkey
+    once (native cache), then one C-speed join over the column.  Rows whose
+    pubkey cannot decompress are zero-marked, not fatal — the spec only
+    fails when such a validator actually attests."""
+    from consensus_specs_tpu.crypto.bls import native
+
+    column = bulk.cached_validator_pubkeys(validators)
+    affine_of = {pk: native.pubkey_affine(pk) for pk in set(column)}
+    invalid_pks = {pk for pk, xy in affine_of.items() if xy is None}
+    for pk in invalid_pks:
+        affine_of[pk] = _ZERO_ROW
+    n = len(column)
+    mat = np.frombuffer(
+        b"".join(map(affine_of.__getitem__, column)), dtype=np.uint8
+    ).reshape(n, 96)
+    invalid = None
+    if invalid_pks:
+        invalid = np.fromiter(
+            (pk in invalid_pks for pk in column), dtype=bool, count=n)
+    return {"mat": mat, "invalid": invalid, "root": bytes(validators.hash_tree_root())}
+
+
+def affine_matrix(validators) -> dict:
+    """Registry-root-cached affine coordinate matrix + invalid-row mask."""
+    return _AFFINE_MATRIX_CACHE.get(validators, _new_affine_matrix)
+
+
+def reset_caches() -> None:
+    """Drop every derived-geometry cache (committee contexts, active sets,
+    proposer walks, affine matrices) plus the native decompression cache —
+    bench cold-start control and test isolation."""
+    _ACTIVE_CACHE.clear()
+    _CTX_CACHE.clear()
+    _CTX_LOOKUP.clear()
+    _PROPOSER_CACHE.clear()
+    _AFFINE_MATRIX_CACHE._store.clear()
+    try:
+        from consensus_specs_tpu.crypto.bls import native
+
+        native.clear_affine_cache()
+    except ImportError:
+        pass
+
+
+def affine_rows(validators, indices: np.ndarray) -> bytes:
+    """Contiguous affine x||y coordinates for ``indices`` (ascending
+    member order of one batch entry)."""
+    entry = affine_matrix(validators)
+    if entry["invalid"] is not None and entry["invalid"][indices].any():
+        # an unverifiable member pubkey: the spec's FastAggregateVerify
+        # returns False and process_attestation asserts — replay path
+        raise FastPathViolation("invalid registry pubkey among attesters")
+    return entry["mat"][indices].tobytes()
+
+
+# -- whole-block resolution ---------------------------------------------------
+
+def resolve_block_attestations(spec, state) -> "_BlockResolver":
+    return _BlockResolver(spec, state)
+
+
+class _BlockResolver:
+    """Resolves every attestation of one block against a fixed pre-ops
+    state snapshot of the committee geometry."""
+
+    def __init__(self, spec, state):
+        self.spec = spec
+        self.state = state
+        self.previous_epoch = int(spec.get_previous_epoch(state))
+        self.current_epoch = int(spec.get_current_epoch(state))
+        self.state_slot = int(state.slot)
+        self.min_delay = int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
+        self.slots_per_epoch = int(spec.SLOTS_PER_EPOCH)
+
+    def resolve(self, attestations) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """[(committee, bits)] per attestation, after the spec's structural
+        asserts (process_attestation, beacon-chain.md:1686-1714) — target
+        epoch window, slot inclusion window, committee index range, and
+        bit-count/committee-size match — evaluated in spec order."""
+        spec, state = self.spec, self.state
+        out = []
+        for att in attestations:
+            data = att.data
+            target_epoch = int(data.target.epoch)
+            slot = int(data.slot)
+            if target_epoch not in (self.previous_epoch, self.current_epoch):
+                raise FastPathViolation("target epoch outside window")
+            if target_epoch != slot // self.slots_per_epoch:
+                raise FastPathViolation("target epoch != epoch of slot")
+            if not (slot + self.min_delay <= self.state_slot
+                    <= slot + self.slots_per_epoch):
+                raise FastPathViolation("inclusion window")
+            ctx = committee_context(spec, state, target_epoch)
+            if int(data.index) >= ctx.committees_per_slot:
+                raise FastPathViolation("committee index out of range")
+            committee = ctx.committee(slot, int(data.index))
+            bits = bulk.bitlist_to_numpy(att.aggregation_bits)
+            if len(bits) != len(committee):
+                raise FastPathViolation("aggregation bits != committee size")
+            out.append((committee, bits))
+        return out
+
+
+def attesting_index_sets(resolved) -> List[np.ndarray]:
+    """Sorted attesting-index arrays for a block's resolved attestations.
+
+    One concatenated mask selects every attester in the block; per-item
+    participation counts are one ``segment_sum`` over the item axis (the
+    indexed-attestation emptiness rule — is_valid_indexed_attestation's
+    ``len(indices) == 0`` reject — checked for all items in bulk).
+    Committee members are unique by construction (permutation slices), so
+    the sorted gather IS the spec's ``sorted(set(...))``."""
+    if not resolved:
+        return []
+    k = len(resolved)
+    lens = np.fromiter((len(bits) for _, bits in resolved), np.int64, k)
+    item_ids = np.repeat(np.arange(k, dtype=np.int64), lens)
+    all_bits = np.concatenate([bits for _, bits in resolved])
+    counts = segment_sum(all_bits.astype(np.int64), item_ids, k)
+    if not counts.all():
+        raise FastPathViolation("empty attesting set")
+    members = np.concatenate([committee for committee, _ in resolved])
+    selected = members[all_bits]
+    offsets = np.cumsum(counts)[:-1]
+    return [np.sort(part) for part in np.split(selected, offsets)]

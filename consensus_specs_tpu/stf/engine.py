@@ -1,0 +1,275 @@
+"""Batched block-transition engine: ``apply_signed_blocks``.
+
+Replays a sequence of signed blocks through the spec state transition with
+three fused optimizations (docs/architecture.md, "The block path"):
+
+1. **one BLS batch per block** — the proposer signature, the RANDAO
+   reveal, and every aggregate attestation settle in a single
+   ``BatchFastAggregateVerify`` multi-pairing (stf/verify.py), with
+   cross-block dedup of already-verified triples;
+2. **vectorized attestation application** — committees and attester sets
+   resolve off the cached whole-epoch shuffle permutation as numpy
+   gathers, participation counts reduce through ``ops/segment.py``
+   (stf/attestations.py), and only the spec-mandated tree writes
+   (pending-attestation appends) touch the state;
+3. **cheap per-slot roots** — ``process_slots`` runs with dirty packed
+   balance subtrees routed through the resident merkle path
+   (stf/slot_roots.py).
+
+Failure contract — differential-exact by construction: the fast path is
+optimistic; on ANY trouble (a structural check, a failed signature batch,
+a fork or backend the fast path does not cover) the block's pre-state is
+restored from its O(1) backing snapshot and the block replays through the
+literal ``spec.state_transition``, which raises the spec's exact exception
+type/message at the spec's exact point and leaves the state exactly as
+poisoned as the sequential path would have.  Valid blocks therefore land
+byte-identical post-states, and invalid blocks are indistinguishable from
+the spec path (pinned by
+tests/spec/phase0/sanity/test_stf_engine_differential.py).
+"""
+from __future__ import annotations
+
+import time
+
+from consensus_specs_tpu import tracing
+
+from . import slot_roots, verify
+from .attestations import (
+    FastPathViolation,
+    affine_rows,
+    attesting_index_sets,
+    beacon_proposer_index,
+    resolve_block_attestations,
+)
+
+stats = {
+    "fast_blocks": 0,
+    "replayed_blocks": 0,
+    "fast_path_errors": 0,
+    "sig_verify_s": 0.0,
+    "attestation_apply_s": 0.0,
+    "slot_roots_s": 0.0,
+    "other_s": 0.0,
+}
+
+
+def reset_stats() -> None:
+    """Zero ALL engine counters — the per-block phase/fallback dict here
+    and the signature-settlement counters in stf/verify.py (one call, so
+    bench rows can't accidentally report cumulative halves)."""
+    for k in stats:
+        stats[k] = 0.0 if isinstance(stats[k], float) else 0
+    for k in verify.stats:
+        verify.stats[k] = 0
+
+
+def _native_available() -> bool:
+    try:
+        from consensus_specs_tpu.crypto.bls import native  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def apply_signed_blocks(spec, state, signed_blocks, validate_result: bool = True):
+    """Apply ``signed_blocks`` to ``state`` in place, semantically
+    identical to ``for sb in signed_blocks: spec.state_transition(state,
+    sb, validate_result)`` — same post-states on success, same exception
+    and partial state on the first invalid block."""
+    for signed_block in signed_blocks:
+        _apply_one(spec, state, signed_block, validate_result)
+    return state
+
+
+def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
+    pre_backing = state.get_backing()
+    try:
+        if getattr(spec, "fork", None) != "phase0" or not _native_available():
+            # later forks keep their own kernel substitutions + the
+            # facade's deferred per-block batch; the fast path below is
+            # the phase0 shape (ROADMAP follow-up: altair lineage)
+            raise FastPathViolation("fast path covers phase0 + native BLS")
+        _fast_transition(spec, state, signed_block, validate_result)
+        stats["fast_blocks"] += 1
+        tracing.count("stf.fast_block")
+    except Exception as exc:
+        if not isinstance(exc, FastPathViolation):
+            stats["fast_path_errors"] += 1
+        stats["replayed_blocks"] += 1
+        tracing.count("stf.replayed_block")
+        state.set_backing(pre_backing)
+        spec.state_transition(state, signed_block, validate_result)
+
+
+def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
+    from consensus_specs_tpu.crypto import bls
+
+    block = signed_block.message
+    t0 = time.perf_counter()
+    slot_roots.process_slots(spec, state, block.slot)
+    t1 = time.perf_counter()
+    stats["slot_roots_s"] += t1 - t0
+
+    bls_on = bls.bls_active
+    entries, keys = [], []
+
+    def collect(members_id, count, flat, message, signature):
+        key = verify.triple_key(members_id, message, signature)
+        if verify.is_verified(key):
+            return
+        entries.append((count, flat(), message, signature))
+        keys.append(key)
+
+    if validate_result and bls_on:
+        _proposer_entry(spec, state, signed_block, collect)
+    t2 = time.perf_counter()
+
+    # process_block, phase0 shape (phase0.py:1149-1154): header/RANDAO/
+    # attestations run the vectorized or collect-don't-verify variants
+    # below; the remaining operations are the spec's own functions
+    _header(spec, state, block)
+    _randao_collect(spec, state, block.body, collect, bls_on)
+    spec.process_eth1_data(state, block.body)
+    t3 = time.perf_counter()
+    # _attestations times itself into attestation_apply_s; the remaining
+    # operations (slashings, deposits, exits) belong to other_s so a
+    # regression in e.g. process_deposit localizes honestly
+    apply_before = stats["attestation_apply_s"]
+    _operations(spec, state, block.body, collect, bls_on)
+    t4 = time.perf_counter()
+    non_attestation_ops = (t4 - t3) - (stats["attestation_apply_s"] - apply_before)
+
+    bad = verify.settle(entries, keys)
+    if bad is not None:
+        raise FastPathViolation(f"invalid signature (batch entry {bad})")
+    t5 = time.perf_counter()
+    if validate_result:
+        if bytes(block.state_root) != bytes(slot_roots.state_root(spec, state)):
+            raise FastPathViolation("state root mismatch")
+    t6 = time.perf_counter()
+    stats["sig_verify_s"] += (t2 - t1) + (t5 - t4)
+    stats["other_s"] += (t3 - t2) + non_attestation_ops + (t6 - t5)
+
+
+def _proposer_entry(spec, state, signed_block, collect) -> None:
+    """verify_block_signature (phase0.py:777-780) as one batch entry."""
+    block = signed_block.message
+    proposer = state.validators[block.proposer_index]
+    signing_root = spec.compute_signing_root(
+        block, spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER))
+    pk = bytes(proposer.pubkey)
+    collect(pk, 1, lambda: _single_affine(pk),
+            bytes(signing_root), bytes(signed_block.signature))
+
+
+def _single_affine(pubkey: bytes) -> bytes:
+    from consensus_specs_tpu.crypto.bls import native
+
+    xy = native.pubkey_affine(pubkey)
+    if xy is None:
+        raise FastPathViolation("unverifiable pubkey")
+    return xy
+
+
+def _header(spec, state, block) -> None:
+    """process_block_header (phase0.py:1156-1176) with the proposer check
+    against the numpy-active fast proposer walk."""
+    assert block.slot == state.slot
+    assert block.slot > state.latest_block_header.slot
+    assert block.proposer_index == beacon_proposer_index(spec, state)
+    assert block.parent_root == spec.hash_tree_root(state.latest_block_header)
+    state.latest_block_header = spec.BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=spec.Bytes32(),  # Overwritten in the next process_slot call
+        body_root=spec.hash_tree_root(block.body),
+    )
+    proposer = state.validators[block.proposer_index]
+    assert not proposer.slashed
+
+
+def _randao_collect(spec, state, body, collect, bls_on) -> None:
+    """process_randao (phase0.py:1179-1187) with the reveal's pairing
+    check deferred into the block batch."""
+    epoch = spec.get_current_epoch(state)
+    proposer = state.validators[beacon_proposer_index(spec, state)]
+    if bls_on:
+        signing_root = spec.compute_signing_root(
+            epoch, spec.get_domain(state, spec.DOMAIN_RANDAO))
+        pk = bytes(proposer.pubkey)
+        collect(pk, 1, lambda: _single_affine(pk),
+                bytes(signing_root), bytes(body.randao_reveal))
+    mix = spec.xor(spec.get_randao_mix(state, epoch),
+                   spec.hash(body.randao_reveal))
+    state.randao_mixes[epoch % spec.EPOCHS_PER_HISTORICAL_VECTOR] = mix
+
+
+def _operations(spec, state, body, collect, bls_on) -> None:
+    """process_operations (phase0.py:1196-1208) with the attestation loop
+    replaced by the whole-block vectorized path."""
+    assert len(body.deposits) == min(
+        spec.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index)
+
+    for operation in body.proposer_slashings:
+        spec.process_proposer_slashing(state, operation)
+    for operation in body.attester_slashings:
+        spec.process_attester_slashing(state, operation)
+    _attestations(spec, state, body.attestations, collect, bls_on)
+    for operation in body.deposits:
+        spec.process_deposit(state, operation)
+    for operation in body.voluntary_exits:
+        spec.process_voluntary_exit(state, operation)
+
+
+def _attestations(spec, state, attestations, collect, bls_on) -> None:
+    """The block's process_attestation loop (phase0.py:1249-1275),
+    vectorized: one resolution pass, one bulk attester-set reduction, then
+    the spec-mandated pending-attestation appends and one signature entry
+    per aggregate."""
+    if len(attestations) == 0:
+        return
+    t0 = time.perf_counter()
+    try:
+        _attestations_inner(spec, state, attestations, collect, bls_on)
+    finally:
+        stats["attestation_apply_s"] += time.perf_counter() - t0
+
+
+def _attestations_inner(spec, state, attestations, collect, bls_on) -> None:
+    resolver = resolve_block_attestations(spec, state)
+    resolved = resolver.resolve(attestations)
+    index_sets = attesting_index_sets(resolved)
+    tracing.count("stf.attestations", len(index_sets))
+
+    # identical for every attestation in the block: state.slot is fixed and
+    # process_block_header already pinned it to the block's proposer
+    proposer_index = beacon_proposer_index(spec, state)
+    current_epoch = resolver.current_epoch
+    validators = state.validators
+    registry_root = bytes(validators.hash_tree_root())
+
+    for att, attesters in zip(attestations, index_sets):
+        data = att.data
+        pending = spec.PendingAttestation(
+            data=data,
+            aggregation_bits=att.aggregation_bits,
+            inclusion_delay=state.slot - data.slot,
+            proposer_index=proposer_index,
+        )
+        if int(data.target.epoch) == current_epoch:
+            if data.source != state.current_justified_checkpoint:
+                raise FastPathViolation("source != current justified")
+            state.current_epoch_attestations.append(pending)
+        else:
+            if data.source != state.previous_justified_checkpoint:
+                raise FastPathViolation("source != previous justified")
+            state.previous_epoch_attestations.append(pending)
+        if bls_on:
+            signing_root = spec.compute_signing_root(
+                data, spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                                      data.target.epoch))
+            collect(registry_root + attesters.tobytes(), len(attesters),
+                    lambda a=attesters: affine_rows(validators, a),
+                    bytes(signing_root), bytes(att.signature))

@@ -4,8 +4,62 @@
 The sub-transition table is data, not a dict of lambdas: each row names the
 spec function and how to feed it from a block, and ``run_block_processing_to``
 walks rows in canonical order until it reaches the requested one.
+
+Engine-backed mode: under ``engine_mode()`` every signed-block transition
+driven through ``state_transition_and_sign_block`` (helpers/state.py) is
+mirrored through ``stf.apply_signed_blocks`` on a shadow copy of the
+pre-state with the same validity expectation, then post-state
+``hash_tree_root`` parity is asserted — so any scenario scripted through
+the helpers doubles as a differential test of the batched block-transition
+engine against the literal spec path (same pattern as
+helpers/fork_choice.py's fork-choice engine mirror).
 """
 from __future__ import annotations
+
+import contextlib
+
+# -- engine-backed differential mode -----------------------------------------
+
+_engine_mode = False
+
+
+@contextlib.contextmanager
+def engine_mode():
+    """Mirror every helper-driven signed-block transition through the
+    batched transition engine and assert post-state parity."""
+    global _engine_mode
+    prev = _engine_mode
+    _engine_mode = True
+    try:
+        yield
+    finally:
+        _engine_mode = prev
+
+
+def engine_pre_state(state):
+    """Pre-transition snapshot for the engine mirror (None when inactive)."""
+    return state.copy() if _engine_mode else None
+
+
+def mirror_signed_block(spec, pre_state, signed_block, post_state,
+                        expect_fail=False):
+    """Replay ``signed_block`` on the engine-mode shadow pre-state and
+    assert byte-identical post-state (or that the engine also rejects)."""
+    if pre_state is None:
+        return
+    from consensus_specs_tpu import stf
+
+    shadow = pre_state
+    if expect_fail:
+        try:
+            stf.apply_signed_blocks(spec, shadow, [signed_block])
+        except Exception:
+            return
+        raise AssertionError(
+            "engine accepted a block the spec path rejected")
+    stf.apply_signed_blocks(spec, shadow, [signed_block])
+    assert bytes(shadow.hash_tree_root()) == bytes(post_state.hash_tree_root()), \
+        "engine post-state diverged from the literal spec transition"
 
 # (spec function name, block accessor, mode)
 #   mode "block":   fn(state, block)

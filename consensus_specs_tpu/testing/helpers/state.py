@@ -66,13 +66,23 @@ def next_epoch_via_signed_block(spec, state):
 
 
 def state_transition_and_sign_block(spec, state, block, expect_fail=False):
-    """Run ``block`` through the transition, then seal in root + signature."""
+    """Run ``block`` through the transition, then seal in root + signature.
+
+    Under ``block_processing.engine_mode()`` the sealed block also replays
+    through the batched transition engine on a shadow pre-state copy, with
+    post-state parity (or shared rejection) asserted."""
+    from . import block_processing
+
+    pre_state = block_processing.engine_pre_state(state)
     if expect_fail:
         expect_assertion_error(lambda: transition_unsigned_block(spec, state, block))
     else:
         transition_unsigned_block(spec, state, block)
     block.state_root = state.hash_tree_root()
-    return sign_block(spec, state, block)
+    signed_block = sign_block(spec, state, block)
+    block_processing.mirror_signed_block(
+        spec, pre_state, signed_block, state, expect_fail=expect_fail)
+    return signed_block
 
 
 # -- participation flags (altair+) -------------------------------------------

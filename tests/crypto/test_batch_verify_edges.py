@@ -1,0 +1,123 @@
+"""BatchFastAggregateVerify edge cases — the failure modes the block
+engine's bisection fallback leans on (stf/verify.py, crypto/bls/native.py).
+
+Covered: the vacuous empty batch, duplicate messages across items (the
+RLC scalars must keep the equations independent), a single tampered
+signature hiding inside a 128-item batch (bisection must name exactly it),
+and deterministic-seed replay (same seed -> same verdict, byte-for-byte
+reproducible batches for test vectors)."""
+import hashlib
+
+import pytest
+
+from consensus_specs_tpu.crypto import bls as bls_facade
+from consensus_specs_tpu.stf import verify as stf_verify
+
+native = pytest.importorskip(
+    "consensus_specs_tpu.crypto.bls.native",
+    reason="native BLS backend unavailable on this host")
+
+
+def _item(sks, msg):
+    pks = [native.SkToPk(sk) for sk in sks]
+    sig = native.Aggregate([native.Sign(sk, msg) for sk in sks])
+    return pks, msg, sig
+
+
+def _flat(pks, msg, sig):
+    affines = b"".join(native.pubkey_affine(pk) for pk in pks)
+    return (len(pks), affines, bytes(msg), bytes(sig))
+
+
+@pytest.fixture(scope="module")
+def batch128():
+    """128 4-member aggregates over distinct messages."""
+    return [_item(range(4 * i + 1, 4 * i + 5),
+                  hashlib.sha256(bytes([i])).digest()) for i in range(128)]
+
+
+def test_empty_batch_is_vacuously_true():
+    assert native.BatchFastAggregateVerify([]) is True
+    assert native.BatchFastAggregateVerifyFlat([], b"", [], []) is True
+    assert stf_verify.settle([], []) is None
+
+
+def test_duplicate_messages_across_items():
+    """Same message signed by different key sets: every equation must be
+    weighed independently (a naive shared-message merge would let one
+    valid item mask another's tampered signature)."""
+    msg = b"\x07" * 32
+    a = _item((1, 2, 3), msg)
+    b = _item((4, 5, 6), msg)
+    assert native.BatchFastAggregateVerify([a, b])
+    bad = (b[0], b[1], native.Aggregate(
+        [native.Sign(sk, b"\x08" * 32) for sk in (4, 5, 6)]))
+    assert not native.BatchFastAggregateVerify([a, bad])
+    assert not native.BatchFastAggregateVerify([bad, a])
+
+
+def test_single_tampered_signature_in_128_item_batch(batch128):
+    for poison in (0, 77, 127):
+        items = list(batch128)
+        pks, msg, _ = items[poison]
+        wrong = native.Aggregate([native.Sign(999, msg)])
+        items[poison] = (pks, msg, wrong)
+        assert not native.BatchFastAggregateVerify(items)
+        # facade bisection names exactly the poisoned index
+        entries = [(tuple(bytes(p) for p in pks_), bytes(m), bytes(s))
+                   for pks_, m, s in items]
+        assert bls_facade._first_invalid(entries) == poison
+        # stf flat-path bisection agrees
+        flat = [_flat(*it) for it in items]
+        assert stf_verify.first_invalid(flat) == poison
+
+
+def test_flat_path_matches_compressed_path(batch128):
+    items = batch128[:8]
+    flat = [_flat(*it) for it in items]
+    counts, affines, msgs, sigs = zip(*flat)
+    assert native.BatchFastAggregateVerifyFlat(
+        counts, b"".join(affines), msgs, sigs)
+    assert native.BatchFastAggregateVerify(items)
+
+
+def test_deterministic_seed_replay(batch128):
+    items = batch128[:16]
+    seed = b"\x5a" * 32
+    for _ in range(3):
+        assert native.BatchFastAggregateVerify(items, seed=seed)
+    tampered = list(items)
+    pks, msg, _ = tampered[9]
+    tampered[9] = (pks, msg, native.Aggregate([native.Sign(31337, msg)]))
+    for _ in range(3):
+        assert not native.BatchFastAggregateVerify(tampered, seed=seed)
+    with pytest.raises(ValueError, match="32 bytes"):
+        native.BatchFastAggregateVerify(items, seed=b"\x01" * 16)
+    count, affines, msg, sig = _flat(*items[0])
+    with pytest.raises(ValueError, match="32 bytes"):
+        native.BatchFastAggregateVerifyFlat(
+            [count], affines, [msg], [sig], seed=b"short")
+
+
+def test_flat_input_validation(batch128):
+    count, affines, msg, sig = _flat(*batch128[0])
+    # inconsistent affine buffer size
+    with pytest.raises(ValueError, match="inconsistent"):
+        native.BatchFastAggregateVerifyFlat(
+            [count + 1], affines, [msg], [sig])
+    # zero-member item: invalid, not an error
+    assert not native.BatchFastAggregateVerifyFlat([0], b"", [msg], [sig])
+    # malformed signature length: invalid
+    assert not native.BatchFastAggregateVerifyFlat(
+        [count], affines, [msg], [sig[:95]])
+
+
+def test_verified_triple_memo_roundtrip(batch128):
+    stf_verify.reset_memo()
+    entries = [_flat(*it) for it in batch128[:4]]
+    keys = [stf_verify.triple_key(e[1], e[2], e[3]) for e in entries]
+    assert not any(stf_verify.is_verified(k) for k in keys)
+    assert stf_verify.settle(entries, keys) is None
+    assert all(stf_verify.is_verified(k) for k in keys)
+    stf_verify.reset_memo()
+    assert not stf_verify.is_verified(keys[0])

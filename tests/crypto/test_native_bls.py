@@ -195,6 +195,43 @@ def test_non_subgroup_g2_rejected():
             [([pk], b"msg", encoded)], seed=b"\x07" * 32)
 
 
+def test_non_subgroup_g1_pubkey_rejected():
+    """The endomorphism-based fast G1 membership test (load_pubkey /
+    bls_decompress_pubkey) must reject on-curve points outside the r-order
+    subgroup exactly as the generic [r]P == inf test did — a regression
+    here silently accepts rogue pubkeys, so it gets the same pin as the
+    G2 analogue above."""
+    import random
+
+    from consensus_specs_tpu.crypto.bls.curve import Point
+    from consensus_specs_tpu.crypto.bls.fields import Fq, P
+
+    rng = random.Random(1117)
+    b1 = Fq(4)
+    found = 0
+    while found < 3:
+        x = Fq(rng.randrange(P))
+        y = (x.square() * x + b1).sqrt()
+        if y is None:
+            continue
+        pt = Point(x, y, Fq.one(), b1)
+        if pt.in_subgroup():  # astronomically unlikely
+            continue
+        found += 1
+        encoded = g1_to_bytes(pt)
+        assert not py.KeyValidate(encoded)
+        assert not native.KeyValidate(encoded)
+        assert native.pubkey_affine(encoded) is None
+        sig = native.Sign(5, b"msg")
+        assert not native.Verify(encoded, b"msg", sig)
+        assert not native.FastAggregateVerify([encoded], b"msg", sig)
+    # positives stay positive: real pubkeys pass both paths
+    for sk in (1, 7, 2**200):
+        pk = native.SkToPk(sk)
+        assert native.KeyValidate(pk) and py.KeyValidate(pk)
+        assert native.pubkey_affine(pk) is not None
+
+
 def test_batch_fast_aggregate_verify_matches_sequential():
     """Differential: for random valid/invalid mixes, the batch answer equals
     the AND of the individual FastAggregateVerify answers."""

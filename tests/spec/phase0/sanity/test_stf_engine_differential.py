@@ -1,0 +1,195 @@
+"""Differential suite: the batched block-transition engine
+(``stf.apply_signed_blocks``) vs the literal ``spec.state_transition``.
+
+Three layers of pinning:
+
+* **Sanity replays** — every scenario in this package's sanity-blocks and
+  multi-operations suites re-runs under
+  ``testing/helpers/block_processing.engine_mode()``: each helper-driven
+  signed-block transition is mirrored through the engine on a shadow
+  pre-state and post-state ``hash_tree_root`` parity (or shared
+  rejection) is asserted after every block — the existing adversarial
+  scripts double as engine differentials.
+
+* **Seeded random epochs** — multi-block attestation-bearing epochs and
+  randomized-operation walks driven through both paths with per-block
+  root parity and a no-silent-fallback assertion (a fast path that
+  quietly degrades to spec replay would still pass root parity, so the
+  engine's own counters are part of the contract).
+
+* **Failure behavior** — invalid blocks must raise the literal spec's
+  exception type and message at the spec's point in processing AND leave
+  the state byte-identically as poisoned (the engine's rollback + spec
+  replay makes this exact, including the bisection-located signature
+  failures).
+"""
+import pytest
+
+from consensus_specs_tpu import stf
+from consensus_specs_tpu.stf import slot_roots
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.helpers.attestations import (
+    next_slots_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.block_processing import engine_mode
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+from consensus_specs_tpu.testing.random_scenarios import run_random_scenario
+
+from . import test_blocks as _blocks
+from . import test_multi_operations as _multi
+
+# -- adversarial sanity replays ----------------------------------------------
+
+_REPLAY_CASES = [
+    (mod, name)
+    for mod in (_blocks, _multi)
+    for name in sorted(dir(mod))
+    if name.startswith("test_")
+]
+
+
+@pytest.mark.parametrize(
+    "mod,name", _REPLAY_CASES,
+    ids=[f"{m.__name__.rsplit('.', 1)[-1]}::{n}" for m, n in _REPLAY_CASES])
+def test_replay_sanity_scenario_through_engine(mod, name):
+    """Re-run an existing sanity scenario with the engine mirror attached.
+    BLS off for speed (``always_bls`` scenarios force it back on, so the
+    signature-batch path is exercised where the original demanded it);
+    structural parity and shared-rejection behavior is what the replays
+    pin — the BLS-on cases below cover the batch itself."""
+    with engine_mode():
+        getattr(mod, name)(phase="phase0", bls_active=False)
+
+
+# -- seeded random multi-block epochs ----------------------------------------
+
+
+def _per_block_differential(spec, state, signed_blocks):
+    """Replay block-by-block through both paths, roots compared at every
+    block boundary; the engine must take its fast path on every block."""
+    s_spec, s_eng = state.copy(), state.copy()
+    stf.reset_stats()
+    for i, sb in enumerate(signed_blocks):
+        spec.state_transition(s_spec, sb, True)
+        stf.apply_signed_blocks(spec, s_eng, [sb], True)
+        assert bytes(s_spec.hash_tree_root()) == bytes(s_eng.hash_tree_root()), \
+            f"post-state diverged at block {i}"
+    assert stf.stats["fast_blocks"] == len(signed_blocks), \
+        f"engine silently replayed {stf.stats['replayed_blocks']} blocks"
+    return s_eng
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_stf_differential_full_epochs_bls(spec, state):
+    """Two attestation-bearing epochs, BLS ON: every block settles its
+    proposer + RANDAO + aggregate signatures in one engine batch."""
+    next_epoch(spec, state)
+    _, signed_blocks, _ = next_slots_with_attestations(
+        spec, state.copy(), int(spec.SLOTS_PER_EPOCH) * 2, True, True)
+    _per_block_differential(spec, state, signed_blocks)
+    yield None
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_stf_differential_random_scenario(seed):
+    """Seeded randomized-operation walks (slashings, skips, epoch jumps)
+    mirrored through the engine by the helper hook; BLS on."""
+    @with_phases(["phase0"])
+    @spec_state_test
+    def case(spec, state):
+        with engine_mode():
+            yield from run_random_scenario(spec, state, seed=seed, stages=4)
+
+    case(phase="phase0", bls_active=True)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [31, 47, 59])
+def test_stf_differential_random_scenario_deep(seed):
+    """Longer random walks (leak epochs included) — the heavy tail of the
+    same contract."""
+    @with_phases(["phase0"])
+    @spec_state_test
+    def case(spec, state):
+        with engine_mode():
+            yield from run_random_scenario(
+                spec, state, seed=seed, stages=8, with_leak=True)
+
+    case(phase="phase0", bls_active=True)
+
+
+# -- identical failure behavior ----------------------------------------------
+
+
+def _exception_parity(spec, state, signed_block):
+    """Both paths must raise the same exception type/message and leave the
+    state byte-identically (partially) mutated."""
+    exc_spec = exc_eng = None
+    s_spec, s_eng = state.copy(), state.copy()
+    try:
+        spec.state_transition(s_spec, signed_block, True)
+    except Exception as e:  # noqa: B001 - parity harness captures anything
+        exc_spec = e
+    try:
+        stf.apply_signed_blocks(spec, s_eng, [signed_block], True)
+    except Exception as e:  # noqa: B001
+        exc_eng = e
+    assert exc_spec is not None, "scenario was supposed to be invalid"
+    assert type(exc_spec) is type(exc_eng), (exc_spec, exc_eng)
+    assert str(exc_spec) == str(exc_eng), (exc_spec, exc_eng)
+    assert bytes(s_spec.hash_tree_root()) == bytes(s_eng.hash_tree_root()), \
+        "poisoned post-states diverged"
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_stf_invalid_blocks_fail_identically(spec, state):
+    next_epoch(spec, state)
+    _, signed_blocks, _ = next_slots_with_attestations(
+        spec, state.copy(), int(spec.SLOTS_PER_EPOCH), True, False)
+    base = signed_blocks[0]
+
+    def tamper(fn):
+        sb = base.copy()
+        fn(sb)
+        return sb
+
+    cases = [
+        tamper(lambda sb: setattr(sb, "signature", b"\x11" * 96)),
+        tamper(lambda sb: setattr(sb.message.body, "randao_reveal",
+                                  spec.BLSSignature(b"\x22" * 96))),
+        tamper(lambda sb: setattr(sb.message.body.attestations[0], "signature",
+                                  spec.BLSSignature(b"\x33" * 96))),
+        tamper(lambda sb: setattr(sb.message, "slot", sb.message.slot + 1)),
+        tamper(lambda sb: setattr(sb.message, "proposer_index",
+                                  sb.message.proposer_index + 1)),
+        tamper(lambda sb: setattr(sb.message.body.attestations[0].data,
+                                  "index", 2 ** 32)),
+        tamper(lambda sb: setattr(sb.message, "state_root",
+                                  spec.Root(b"\x44" * 32))),
+    ]
+    for sb in cases:
+        _exception_parity(spec, state, sb)
+    yield None
+
+
+# -- per-slot roots (stf/slot_roots vs spec.process_slots) --------------------
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_slot_roots_process_slots_differential(spec, state):
+    """Empty-slot advancement across an epoch boundary: the resident-
+    routed replica must land byte-identical states at every boundary."""
+    for jump in (1, 3, int(spec.SLOTS_PER_EPOCH) + 2):
+        s_spec, s_eng = state.copy(), state.copy()
+        target = s_spec.slot + jump
+        spec.process_slots(s_spec, target)
+        slot_roots.process_slots(spec, s_eng, target)
+        assert bytes(s_spec.hash_tree_root()) == bytes(s_eng.hash_tree_root())
+        state = s_spec
+    # same assert on an already-reached slot
+    with pytest.raises(AssertionError):
+        slot_roots.process_slots(spec, state.copy(), state.slot)
+    yield None

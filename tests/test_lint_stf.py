@@ -1,0 +1,73 @@
+"""ST01 lint rule: per-item ``bls.Verify`` / ``bls.FastAggregateVerify``
+loops outside ``specs/`` and ``crypto/`` are the one-pairing-at-a-time
+pattern the batched block engine (consensus_specs_tpu/stf) deletes — new
+code must batch through ``stf/verify.py`` or the facade's deferred scope.
+The spec sources keep the reference's sequential shape and ``crypto/``
+implements both paths, so both stay exempt; the live tree must be clean."""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import lint  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+_VIOLATIONS = """\
+def bad(bls, atts, state, spec):
+    for att in atts:
+        assert bls.FastAggregateVerify(att.pks, att.msg, att.sig)  # for loop
+    ok = [bls.Verify(a.pk, a.msg, a.sig) for a in atts]            # listcomp
+    i = 0
+    while i < len(atts):
+        spec.bls.Verify(atts[i].pk, atts[i].msg, atts[i].sig)      # while
+        i += 1
+    return ok
+"""
+
+_CLEAN = """\
+def good(bls, stf_verify, atts, entries, keys):
+    assert bls.FastAggregateVerify(atts[0].pks, atts[0].msg, atts[0].sig)
+    assert bls.BatchFastAggregateVerify(
+        [(a.pks, a.msg, a.sig) for a in atts])
+    for a in atts:
+        entries.append((len(a.pks), a.flat, a.msg, a.sig))  # collect, not verify
+    return stf_verify.settle(entries, keys)
+"""
+
+
+def _findings_for(tmp_path, name, source, code="ST01"):
+    p = tmp_path / name
+    p.write_text(source)
+    return [f for f in lint.check_file(p) if code in f[2]]
+
+
+def test_st01_flags_every_loop_shape(tmp_path):
+    found = _findings_for(tmp_path, "helpers.py", _VIOLATIONS)
+    assert sorted(f[1] for f in found) == [3, 4, 7]
+
+
+def test_st01_ignores_single_calls_and_batches(tmp_path):
+    assert _findings_for(tmp_path, "helpers.py", _CLEAN) == []
+
+
+def test_st01_exempts_spec_and_crypto_dirs(tmp_path):
+    for exempt in ("specs", "crypto"):
+        d = tmp_path / exempt
+        d.mkdir()
+        assert _findings_for(d, "impl.py", _VIOLATIONS) == []
+
+
+def test_st01_respects_noqa(tmp_path):
+    src = ("def f(bls, items):\n"
+          "    return [bls.Verify(p, m, s)  # noqa: ST01 baseline\n"
+          "            for p, m, s in items]\n")
+    assert _findings_for(tmp_path, "x.py", src) == []
+
+
+def test_live_tree_is_st01_clean():
+    findings = []
+    for f in lint.iter_py_files(
+            [REPO / "consensus_specs_tpu", REPO / "tests", REPO / "tools",
+             REPO / "bench.py", REPO / "__graft_entry__.py"]):
+        findings.extend(x for x in lint.check_file(f) if "ST01" in x[2])
+    assert findings == [], findings

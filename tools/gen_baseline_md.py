@@ -91,13 +91,18 @@ def build_table(details: dict) -> str:
         blocks = r.get("blocks", 32)
         atts = r.get("aggregate_attestations_verified", "?")
         verdict = "**MET**" if r["value"] < 60 else "**MISSED**"
+        spec_s = r.get("literal_spec_s")
+        vs_spec = (f"; literal spec replay {_fmt(spec_s)} s, roots identical"
+                   if spec_s is not None else "")
         rows.append((
             "★", f"mainnet epoch end-to-end, 400k validators, BLS ON "
-            f"({blocks} signed blocks, {atts} aggregates through "
-            f"`state_transition`) — the north star, target < 60 s",
+            f"({blocks} signed blocks, {atts} aggregates through the "
+            f"batched block engine `stf.apply_signed_blocks`) — "
+            f"the north star, target < 60 s",
             f"**{_fmt(r['value'])} s** — target {verdict} "
             f"({_fmt(r.get('per_block_s'))} s/block, "
-            f"{r.get('bls_backend', 'native')} batch verification)",
+            f"{r.get('bls_backend', 'native')} batch verification"
+            f"{vs_spec})",
             "epoch_e2e_bls"))
 
     r = details.get("epoch_e2e_bls_altair", {})

@@ -12,6 +12,7 @@ CI does:
   W191  tab indentation
   B001  bare except
   FC01  direct store.latest_messages mutation outside specs/ + forkchoice/
+  ST01  per-item bls.Verify/FastAggregateVerify loop outside specs/ + crypto/
 
 Spec-source files (`specs/src/*.py`) are exempt from E501: their bodies
 are pinned AST-for-AST to the reference markdown and must not be
@@ -128,6 +129,14 @@ def check_file(path: Path) -> list:
                                  "(route through spec handlers or "
                                  "forkchoice/batch.py)"))
 
+    if "specs" not in parts and "crypto" not in parts:
+        for lineno in sorted(set(_per_item_verify_loops(tree))):
+            if lineno not in noqa_lines:
+                findings.append((path, lineno,
+                                 "ST01 per-item bls verification in a loop "
+                                 "(batch via stf/verify.py or the facade's "
+                                 "deferred scope)"))
+
     return findings
 
 
@@ -163,6 +172,31 @@ def _latest_messages_mutations(tree):
             if (node.func.attr in _MUTATING_DICT_METHODS
                     and _is_latest_messages(node.func.value)):
                 yield node.lineno
+
+
+_PER_ITEM_VERIFY_FNS = {"Verify", "FastAggregateVerify"}
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While,
+               ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _per_item_verify_loops(tree):
+    """Line numbers of ``bls.Verify`` / ``bls.FastAggregateVerify`` calls
+    issued inside a loop or comprehension: the one-pairing-at-a-time
+    pattern the batched block engine exists to delete.  One batched
+    multi-pairing (``BatchFastAggregateVerify`` via ``stf/verify.py`` or
+    the facade's deferred scope) settles the whole set with a single
+    shared final exponentiation.  Spec sources keep the reference's
+    sequential shape and ``crypto/`` implements both paths, so both are
+    exempt; measurement baselines mark themselves ``# noqa``."""
+    for loop in ast.walk(tree):
+        if not isinstance(loop, _LOOP_NODES):
+            continue
+        for node in ast.walk(loop):
+            if node is loop:
+                continue
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr in _PER_ITEM_VERIFY_FNS:
+                    yield node.lineno
 
 
 def main(argv):
