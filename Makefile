@@ -36,6 +36,11 @@ dcn-dryrun:
 lint:
 	python tools/lint.py
 
+# full semantic analysis with JSON report (rule catalog:
+# docs/architecture.md "Static analysis"); same checker as `make lint`
+analyze:
+	python tools/lint.py --json ANALYSIS.json
+
 GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing finality rewards genesis random transition ssz_generic fork_choice merkle
 
 gen-all: $(addprefix gen-,$(GENERATORS))
@@ -58,4 +63,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench limb-probe dcn-dryrun lint consume mdspec gen-all FORCE
+.PHONY: test test-par test-fast test-mainnet bench limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE

@@ -106,7 +106,7 @@ class ForkChoiceEngine:
             self._proposer_score = 0
             return
         total = max(int(spec.EFFECTIVE_BALANCE_INCREMENT),
-                    int(balances.sum()))
+                    int(balances.sum(dtype=np.uint64)))
         avg = total // num
         committee_weight = (num // int(spec.SLOTS_PER_EPOCH)) * avg
         self._proposer_score = (

@@ -89,7 +89,7 @@ def rewards_and_penalties(spec, state) -> None:
         participating = _unslashed_participating_mask(
             spec, state, cols, prev_flags, flag_index)
         participating_increments = (
-            int(np.sum(np.where(participating, eff, 0))) // ebi
+            int(np.sum(np.where(participating, eff, 0), dtype=np.uint64)) // ebi
         )
         rewards = np.zeros_like(eff)
         penalties = np.zeros_like(eff)
@@ -157,8 +157,8 @@ def justification_and_finalization(spec, state) -> None:
         spec, state, cols, cur_flags, target,
         epoch=int(spec.get_current_epoch(state)))
     # get_total_balance floors at one increment
-    prev_bal = max(ebi, int(np.sum(np.where(prev_mask, eff, 0))))
-    cur_bal = max(ebi, int(np.sum(np.where(cur_mask, eff, 0))))
+    prev_bal = max(ebi, int(np.sum(np.where(prev_mask, eff, 0), dtype=np.uint64)))
+    cur_bal = max(ebi, int(np.sum(np.where(cur_mask, eff, 0), dtype=np.uint64)))
     spec.weigh_justification_and_finalization(
         state, spec.get_total_active_balance(state),
         spec.Gwei(prev_bal), spec.Gwei(cur_bal))

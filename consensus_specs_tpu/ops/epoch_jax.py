@@ -313,14 +313,16 @@ def attesting_balance(spec, state, attestations) -> int:
     cols = registry_columns(state)
     mask = participation_mask(spec, state, attestations, len(cols["slashed"]))
     mask &= ~cols["slashed"]
-    total = int(np.sum(np.where(mask, cols["effective_balance"], 0)))
+    total = int(np.sum(np.where(mask, cols["effective_balance"], 0),
+                       dtype=np.uint64))
     return max(int(spec.EFFECTIVE_BALANCE_INCREMENT), total)
 
 
 def total_active_balance(spec, state) -> int:
     cols = registry_columns(state)
     act = active_mask(cols, int(spec.get_current_epoch(state)))
-    total = int(np.sum(np.where(act, cols["effective_balance"], 0)))
+    total = int(np.sum(np.where(act, cols["effective_balance"], 0),
+                       dtype=np.uint64))
     return max(int(spec.EFFECTIVE_BALANCE_INCREMENT), total)
 
 

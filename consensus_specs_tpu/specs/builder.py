@@ -487,7 +487,7 @@ def _install_altair_attestation_kernel(g: Dict[str, Any]) -> None:
                 continue
             mirror[newly] |= bit
             proposer_reward_numerator += int(
-                np.sum(base_rewards[newly])) * int(weight)
+                np.sum(base_rewards[newly], dtype=np.uint64)) * int(weight)
 
         proposer_reward_denominator = (
             (g["WEIGHT_DENOMINATOR"] - g["PROPOSER_WEIGHT"])

@@ -1,0 +1,8 @@
+"""Make the analyzer package importable the same way tools/lint.py does
+(the repo is not an installed distribution; tools/ rides on sys.path)."""
+import sys
+from pathlib import Path
+
+TOOLS = Path(__file__).resolve().parent.parent.parent / "tools"
+if str(TOOLS) not in sys.path:
+    sys.path.insert(0, str(TOOLS))

@@ -1,0 +1,108 @@
+"""Baseline semantics: grandfathered findings match on (file, code,
+snippet) — line-drift immune — and stale entries are reported so the
+baseline cannot rot."""
+import json
+
+import pytest
+
+from analysis import run
+from analysis.baseline import Baseline
+
+
+def _write_baseline(tmp_path, entries):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"entries": entries}))
+    return p
+
+
+def _run(tmp_path, baseline):
+    return run([tmp_path], root=tmp_path, use_cache=False,
+               baseline_path=baseline)
+
+
+def test_baselined_finding_does_not_fail(tmp_path):
+    (tmp_path / "a.py").write_text("import os\n")
+    bl = _write_baseline(tmp_path, [{
+        "file": "a.py", "code": "F401", "snippet": "import os",
+        "justification": "kept for the doctest namespace"}])
+    result = _run(tmp_path, bl)
+    assert result.findings == []
+    assert [f.code for f in result.baselined] == ["F401"]
+    assert result.stale_baseline == []
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\ny = 2\n\nimport os\n")
+    bl = _write_baseline(tmp_path, [{
+        "file": "a.py", "code": "F401", "snippet": "import os",
+        "justification": "kept"}])
+    result = _run(tmp_path, bl)
+    assert result.findings == [] and len(result.baselined) == 1
+
+
+def test_stale_entry_is_reported(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\n")  # clean: entry is stale
+    bl = _write_baseline(tmp_path, [{
+        "file": "a.py", "code": "F401", "snippet": "import os",
+        "justification": "was needed once"}])
+    result = _run(tmp_path, bl)
+    assert result.findings == []
+    assert [e["code"] for e in result.stale_baseline] == ["F401"]
+
+
+def test_entry_consumes_at_most_one_finding(tmp_path):
+    # a SECOND identical violation in the same file is new, unreviewed
+    # code: only one of the two findings is absorbed by the entry
+    (tmp_path / "a.py").write_text("x = 1   \ny = 2\nx = 1   \n")
+    bl = _write_baseline(tmp_path, [{
+        "file": "a.py", "code": "W291", "snippet": "x = 1",
+        "justification": "the first one is reviewed"}])
+    result = _run(tmp_path, bl)
+    assert len(result.baselined) == 1
+    assert [(f.line, f.code) for f in result.findings] == [(3, "W291")]
+    assert result.stale_baseline == []
+
+
+def test_deleted_file_entry_is_stale(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\n")
+    bl = _write_baseline(tmp_path, [{
+        "file": "gone.py", "code": "F401", "snippet": "import os",
+        "justification": "for a file that no longer exists"}])
+    result = _run(tmp_path, bl)
+    assert [e["file"] for e in result.stale_baseline] == ["gone.py"]
+
+
+def test_out_of_scope_entry_is_not_stale(tmp_path):
+    # the entry's file exists but is outside this run's roots: no verdict
+    sub = tmp_path / "scanned"
+    sub.mkdir()
+    (sub / "a.py").write_text("x = 1\n")
+    (tmp_path / "other.py").write_text("import os\n")
+    bl = _write_baseline(tmp_path, [{
+        "file": "other.py", "code": "F401", "snippet": "import os",
+        "justification": "kept"}])
+    result = run([sub], root=tmp_path, use_cache=False, baseline_path=bl)
+    assert result.stale_baseline == []
+
+
+def test_baseline_does_not_mask_other_findings(tmp_path):
+    (tmp_path / "a.py").write_text("import os\nimport sys\n")
+    bl = _write_baseline(tmp_path, [{
+        "file": "a.py", "code": "F401", "snippet": "import os",
+        "justification": "kept"}])
+    result = _run(tmp_path, bl)
+    assert [(f.line, f.code) for f in result.findings] == [(2, "F401")]
+
+
+def test_malformed_baseline_entry_rejected(tmp_path):
+    p = _write_baseline(tmp_path, [{"file": "a.py", "code": "F401"}])
+    with pytest.raises(ValueError, match="snippet"):
+        Baseline.load(p)
+
+
+def test_live_baseline_entries_all_have_justifications():
+    from analysis.runner import DEFAULT_BASELINE
+
+    bl = Baseline.load(DEFAULT_BASELINE)
+    for e in bl.entries:
+        assert e["justification"].strip(), e

@@ -1,0 +1,79 @@
+"""Incremental cache: findings are keyed by content hash — editing one
+file re-analyzes only that file, and a rule-source change drops the
+whole cache (version digest)."""
+import analysis
+from analysis import run
+from analysis.cachefile import AnalysisCache
+
+
+def _tree(tmp_path):
+    (tmp_path / "a.py").write_text("import os\n")  # F401
+    (tmp_path / "b.py").write_text("x = 1\n")
+    (tmp_path / "c.py").write_text("y = 2   \n")   # W291
+    return tmp_path
+
+
+def _run(tmp_path):
+    return run([tmp_path], root=tmp_path,
+               cache_path=tmp_path / "cache.json",
+               baseline_path=tmp_path / "missing-baseline.json")
+
+
+def test_second_run_is_fully_cached_and_identical(tmp_path):
+    _tree(tmp_path)
+    first = _run(tmp_path)
+    assert first.cache_hits == 0 and first.n_files == 3
+    second = _run(tmp_path)
+    assert second.cache_hits == 3
+    assert [(f.file, f.line, f.code) for f in second.findings] == \
+        [(f.file, f.line, f.code) for f in first.findings]
+    assert {f.code for f in second.findings} == {"F401", "W291"}
+
+
+def test_editing_one_file_reanalyzes_only_it(tmp_path):
+    _tree(tmp_path)
+    _run(tmp_path)
+    (tmp_path / "b.py").write_text("import sys\n")  # now has a finding
+    third = _run(tmp_path)
+    assert third.cache_hits == 2  # a.py and c.py came from the cache
+    assert any(f.file == "b.py" and f.code == "F401"
+               for f in third.findings)
+
+
+def test_rule_subset_runs_never_poison_the_cache(tmp_path):
+    import analysis
+    _tree(tmp_path)
+    # a subset run must not seed entries a later full run would trust
+    subset = run([tmp_path], root=tmp_path,
+                 cache_path=tmp_path / "cache.json",
+                 baseline_path=tmp_path / "missing-baseline.json",
+                 rules=analysis.all_rules(codes=["W291"]))
+    assert {f.code for f in subset.findings} == {"W291"}
+    full = _run(tmp_path)
+    assert full.cache_hits == 0  # nothing trusted from the subset run
+    assert {f.code for f in full.findings} == {"F401", "W291"}
+
+
+def test_version_change_drops_cache(tmp_path):
+    _tree(tmp_path)
+    cache_file = tmp_path / "cache.json"
+    c1 = AnalysisCache(cache_file, version="v1")
+    c1.put("a.py", "sha", [])
+    c1.save()
+    assert AnalysisCache(cache_file, version="v1").get("a.py", "sha") == []
+    assert AnalysisCache(cache_file, version="v2").get("a.py", "sha") is None
+
+
+def test_overlapping_roots_do_not_double_report(tmp_path):
+    _tree(tmp_path)
+    result = run([tmp_path, tmp_path / "a.py"], root=tmp_path,
+                 cache_path=tmp_path / "cache.json",
+                 baseline_path=tmp_path / "missing-baseline.json")
+    assert result.n_files == 3
+    assert [f.code for f in result.findings if f.file == "a.py"] == ["F401"]
+
+
+def test_analyzer_version_digests_rule_sources():
+    v = analysis.runner.analyzer_version()
+    assert v == analysis.runner.analyzer_version()  # deterministic
+    assert len(v) == 64

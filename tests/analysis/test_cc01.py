@@ -1,0 +1,126 @@
+"""CC01 cache coherence: insertions into registered memos and mutations
+of producer-returned values, outside the owning module, without a paired
+invalidation."""
+from analysis import analyze_text
+
+
+def cc01(path, src):
+    return [f for f in analyze_text(path, src) if f.code == "CC01"]
+
+
+_ALIAS_INSERT = """\
+from consensus_specs_tpu.ops import shuffle
+
+def warm(seed, n, perm):
+    shuffle._cache[(seed, n, 90)] = perm
+"""
+
+_PRODUCER_MUTATION = """\
+from consensus_specs_tpu.ops.shuffle import compute_shuffle_permutation
+
+def corrupt(seed, n):
+    perm = compute_shuffle_permutation(seed, n, 90)
+    view = perm[:16]
+    view[0] = 3          # derived view of the shared cached array
+    perm.fill(0)         # mutating ndarray method
+    return perm
+"""
+
+_PAIRED_INVALIDATION = """\
+from consensus_specs_tpu.ops import shuffle
+from consensus_specs_tpu.stf.attestations import reset_caches
+
+def rebuild(seed, n, perm):
+    shuffle._cache[(seed, n, 90)] = perm
+    reset_caches()
+"""
+
+_READS_AND_INVALIDATIONS = """\
+from consensus_specs_tpu.ops import shuffle
+from consensus_specs_tpu.ops.shuffle import compute_shuffle_permutation
+
+def fine(seed, n, engine):
+    perm = compute_shuffle_permutation(seed, n, 90)
+    local = perm.copy()
+    local[0] = 1                  # mutating a copy is not the cache
+    shuffle._cache.clear()        # full invalidation: always legal
+    shuffle._cache.pop((seed, n, 90), None)
+    del shuffle._cache[(seed, n, 90)]
+    engine._head = None           # = None rebind IS the invalidation
+    return perm[0], len(shuffle._cache)
+"""
+
+_HEAD_POKE = """\
+def poke(engine, node):
+    engine._head = node
+"""
+
+_MEMO_INSERT = """\
+from consensus_specs_tpu.stf import verify
+
+def fake_verified(key):
+    verify._VERIFIED_MEMO[key] = True
+"""
+
+
+def test_cc01_flags_alias_insertion():
+    assert [f.line for f in cc01("tests/helper.py", _ALIAS_INSERT)] == [4]
+
+
+def test_cc01_flags_producer_value_mutation():
+    lines = [f.line for f in cc01("tests/helper.py", _PRODUCER_MUTATION)]
+    assert lines == [6, 7]
+
+
+def test_cc01_pardons_paired_invalidation():
+    assert cc01("tests/helper.py", _PAIRED_INVALIDATION) == []
+
+
+def test_cc01_ignores_reads_copies_and_invalidations():
+    assert cc01("tests/helper.py", _READS_AND_INVALIDATIONS) == []
+
+
+def test_cc01_flags_head_cache_poke_but_not_none():
+    assert [f.line for f in cc01("tests/helper.py", _HEAD_POKE)] == [2]
+
+
+def test_cc01_flags_verified_memo_insertion():
+    assert [f.line for f in cc01("tests/helper.py", _MEMO_INSERT)] == [4]
+
+
+def test_cc01_exempts_owner_modules():
+    # the same writes inside the owning module are the implementation
+    owner = "consensus_specs_tpu/ops/shuffle.py"
+    src = "_cache = {}\n\ndef put(k, v):\n    _cache[k] = v\n"
+    assert cc01(owner, src) == []
+    assert cc01("consensus_specs_tpu/forkchoice/engine.py", _HEAD_POKE) == []
+    assert cc01("consensus_specs_tpu/stf/verify.py", _MEMO_INSERT) == []
+
+
+def test_cc01_ignores_unrelated_functions_sharing_producer_names():
+    # a local helper that merely shares a producer's name is not the cache
+    src = ("def active_indices(n):\n"
+           "    return list(range(n))\n"
+           "def use(n):\n"
+           "    idx = active_indices(n)\n"
+           "    idx[0] = 5\n"
+           "    return idx\n")
+    assert cc01("tools/helper.py", src) == []
+
+
+def test_cc01_ignores_own_class_attributes():
+    # an unrelated class reusing a registered attr name writes into ITS
+    # namespace, not the engines' caches
+    src = ("class TreeNode:\n"
+           "    def __init__(self, root):\n"
+           "        self._root = root\n"
+           "        self._head = None\n"
+           "    def rehash(self, d):\n"
+           "        self._root = d\n")
+    assert cc01("tools/helper.py", src) == []
+
+
+def test_cc01_respects_targeted_noqa():
+    src = _ALIAS_INSERT.replace(
+        "] = perm", "] = perm  # noqa: CC01 (test warms the cache)")
+    assert cc01("tests/helper.py", src) == []

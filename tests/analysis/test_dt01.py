@@ -1,0 +1,65 @@
+"""DT01 Gwei dtype safety: numpy reductions over balance/weight arrays
+need an explicit 64-bit accumulator."""
+from analysis import analyze_text
+
+
+def dt01(path, src):
+    return [f for f in analyze_text(path, src) if f.code == "DT01"]
+
+
+_VIOLATIONS = """\
+import numpy as np
+
+def totals(balances, weights, eff, mask, cols):
+    a = np.sum(balances)                               # plain np.sum
+    b = np.cumsum(weights)                             # cumsum
+    c = balances.sum()                                 # method form
+    d = np.sum(np.where(mask, eff, 0))                 # eff through where
+    e = np.sum(np.where(mask, cols["effective_balance"], 0))  # string key
+    f = np.dot(balances, weights)                      # dot
+    return a, b, c, d, e, f
+"""
+
+_CLEAN = """\
+import numpy as np
+import jax.numpy as jnp
+
+def totals(balances, weights, eff, mask, counts, active):
+    a = np.sum(balances, dtype=np.uint64)
+    b = np.cumsum(weights, dtype=np.uint64)
+    c = balances.sum(dtype=np.uint64)
+    d = np.sum(rewards_minus := np.where(mask, eff, 0), dtype=np.int64)
+    e = np.dot(balances.astype(np.uint64), weights.astype(np.uint64))
+    f = np.sum(counts)          # not a balance/weight array
+    g = int(active.sum())       # bool attendance count: no hint
+    h = jnp.sum(jnp.where(mask, eff, 0))  # jnp: width policy is x64 flag
+    return a, b, c, d, e, f, g, h
+"""
+
+
+def test_dt01_flags_every_reduction_shape():
+    assert [f.line for f in dt01("m.py", _VIOLATIONS)] == [4, 5, 6, 7, 8, 9]
+
+
+def test_dt01_accepts_explicit_64bit_dtypes_and_skips_non_gwei():
+    assert dt01("m.py", _CLEAN) == []
+
+
+def test_dt01_exempts_spec_sources():
+    assert dt01("consensus_specs_tpu/specs/src/phase0.py", _VIOLATIONS) == []
+
+
+def test_dt01_skips_method_form_on_jax_arrays():
+    # the x64 flag governs jnp arrays; only numpy receivers are flagged
+    src = ("import jax.numpy as jnp\n"
+           "def t(state):\n"
+           "    balances = jnp.asarray(state.balances)\n"
+           "    return balances.sum()\n")
+    assert dt01("m.py", src) == []
+
+
+def test_dt01_respects_targeted_noqa():
+    src = ("import numpy as np\n"
+           "def t(balances):\n"
+           "    return np.sum(balances)  # noqa: DT01 (tiny fixture state)\n")
+    assert dt01("m.py", src) == []

@@ -1,0 +1,83 @@
+"""Fixture tests for the hygiene rules: the legacy codes keep their exact
+semantics (incl. the specs/src E501 exemption and __init__ F401
+re-export exemption), plus the W605/B006 additions."""
+from analysis import analyze_text
+
+
+def only(path, src, code):
+    return [f for f in analyze_text(path, src) if f.code == code]
+
+
+# -- legacy codes -------------------------------------------------------------
+
+def test_e501_flags_long_lines():
+    src = "x = " + "'a' + " * 30 + "'end'\n"
+    assert len(src.splitlines()[0]) > 120
+    assert [f.line for f in only("m.py", src, "E501")] == [1]
+
+
+def test_e501_exempts_spec_sources():
+    src = "x = " + "'a' + " * 30 + "'end'\n"
+    assert only("consensus_specs_tpu/specs/src/phase0.py", src, "E501") == []
+
+
+def test_w291_trailing_whitespace_and_w191_tabs():
+    src = "a = 1   \n\tb = 2\n"
+    assert [f.line for f in only("m.py", src, "W291")] == [1]
+    assert [f.line for f in only("m.py", src, "W191")] == [2]
+
+
+def test_e999_syntax_error_single_finding():
+    findings = analyze_text("m.py", "def f(:\n")
+    assert [f.code for f in findings] == ["E999"]
+
+
+def test_b001_bare_except():
+    src = "try:\n    x = 1\nexcept:\n    pass\n"
+    assert [f.line for f in only("m.py", src, "B001")] == [3]
+
+
+def test_f401_unused_import_and_exemptions():
+    src = "import os\nimport sys\nprint(sys.argv)\n"
+    assert [f.line for f in only("m.py", src, "F401")] == [1]
+    # __init__.py imports are re-exports
+    assert only("pkg/__init__.py", src, "F401") == []
+    # a whole-word occurrence in a string (e.g. __all__) counts as a use
+    src2 = 'import os\n__all__ = ["os"]\n'
+    assert only("m.py", src2, "F401") == []
+
+
+# -- W605: invalid escape sequence --------------------------------------------
+
+def test_w605_flags_invalid_escape_in_plain_string():
+    src = 'pat = "\\d+"\n'  # \d is not a recognized string escape
+    assert [f.line for f in only("m.py", src, "W605")] == [1]
+
+
+def test_w605_ignores_raw_strings_and_valid_escapes():
+    src = 'a = r"\\d+"\nb = "\\n\\t\\x41\\101"\nc = b"\\x00"\n'
+    assert only("m.py", src, "W605") == []
+
+
+def test_w605_bytes_reject_unicode_escapes():
+    src = 'a = b"\\u1234"\n'
+    assert [f.line for f in only("m.py", src, "W605")] == [1]
+    assert only("m.py", 'a = "\\u1234"\n', "W605") == []
+
+
+def test_w605_line_numbers_in_multiline_strings():
+    src = 'doc = """line one\nbad \\q here\n"""\n'
+    assert [f.line for f in only("m.py", src, "W605")] == [2]
+
+
+# -- B006: mutable default argument -------------------------------------------
+
+def test_b006_flags_mutable_defaults():
+    src = ("def f(a, b=[], c={}, d=set(), *, e=dict()):\n"
+           "    return a\n")
+    assert len(only("m.py", src, "B006")) == 4
+
+
+def test_b006_ignores_immutable_defaults():
+    src = "def f(a=1, b=(), c=None, d='x', e=frozenset()):\n    return a\n"
+    assert only("m.py", src, "B006") == []

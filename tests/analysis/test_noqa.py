@@ -1,0 +1,56 @@
+"""Targeted ``# noqa`` suppression: bare form silences everything on the
+line (legacy behavior), coded form silences exactly the listed codes."""
+from analysis import analyze_text
+
+LONG = "x = 1  " + "# pad the line out to something past the limit " * 3
+
+
+def codes(path, src):
+    return sorted({f.code for f in analyze_text(path, src)})
+
+
+def test_bare_noqa_suppresses_everything():
+    src = f"{LONG}# noqa\n"
+    assert len(src.splitlines()[0]) > 120
+    assert codes("x.py", src) == []
+
+
+def test_coded_noqa_suppresses_only_that_code():
+    # line is both too long AND carries trailing whitespace
+    src = f"{LONG}# noqa: E501   \n"
+    assert codes("x.py", src) == ["W291"]
+
+
+def test_code_list():
+    src = f"{LONG}# noqa: E501, W291   \n"
+    assert codes("x.py", src) == []
+
+
+def test_trailing_prose_after_codes_is_ignored():
+    src = f"{LONG}# noqa: E501 (pinned constant), W291\n"
+    assert codes("x.py", src) == []
+
+
+def test_unknown_codes_are_legal_but_do_not_blanket():
+    # E402 is a flake8 code this analyzer does not implement: listing it
+    # documents intent without silencing anything else on the line
+    src = f"{LONG}# noqa: E402\n"
+    assert "E501" in codes("x.py", src)
+
+
+def test_prose_mentioning_a_code_does_not_suppress_it():
+    # only the LEADING run of code tokens counts: naming FC01 in the
+    # trailing prose of an E501 noqa must not silence FC01
+    src = ("def f(s, m):\n"
+           "    s.latest_messages[0] = m  # noqa: E501 see also FC01 docs\n")
+    assert "FC01" in codes("x.py", src)
+
+
+def test_case_insensitive_noqa_word():
+    src = f"{LONG}# NOQA: E501\n"
+    assert "E501" not in codes("x.py", src)
+
+
+def test_noqa_on_other_line_does_not_leak():
+    src = f"ok = 1  # noqa\n{LONG}\n"
+    assert "E501" in codes("x.py", src)

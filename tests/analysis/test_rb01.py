@@ -1,0 +1,87 @@
+"""RB01 rollback safety: spec-state writes in stf/ stay inside the
+snapshot-protected region of apply_signed_blocks."""
+from analysis import analyze_text
+
+
+def rb01(path, src):
+    return [f for f in analyze_text(path, src) if f.code == "RB01"]
+
+
+_VIOLATIONS = """\
+def resolve_helper(spec, state, root):
+    state.latest_block_header.state_root = root   # attribute chain write
+    state.state_roots[0] = root                   # subscript write
+    state.slot += 1                               # augmented assignment
+    state.current_epoch_attestations.append(root) # mutating method
+    state.set_backing(root)                       # backing swap
+    st = state
+    st.slot = 5                                   # aliased write
+"""
+
+_READS = """\
+def reader(spec, state):
+    snapshot = state.get_backing()
+    slot = state.slot
+    return snapshot, slot, len(state.validators), state.copy()
+"""
+
+_WHITELISTED = """\
+def _apply_one(spec, state, signed_block, validate_result):
+    pre = state.get_backing()
+    state.set_backing(pre)
+
+def _header(spec, state, block):
+    state.latest_block_header = block
+
+def _attestations_inner(spec, state, pending):
+    state.current_epoch_attestations.append(pending)
+
+    def closure():
+        state.slot += 1   # nested inside a whitelisted function: protected
+    closure()
+"""
+
+
+def test_rb01_flags_every_write_shape():
+    found = rb01("consensus_specs_tpu/stf/engine.py", _VIOLATIONS)
+    assert sorted(f.line for f in found) == [2, 3, 4, 5, 6, 8]
+
+
+def test_rb01_ignores_reads():
+    assert rb01("consensus_specs_tpu/stf/engine.py", _READS) == []
+
+
+def test_rb01_whitelists_the_protected_region():
+    assert rb01("consensus_specs_tpu/stf/engine.py", _WHITELISTED) == []
+
+
+def test_rb01_whitelist_is_per_file():
+    # _header is protected in engine.py, not in a random stf module
+    assert [f.line for f in rb01(
+        "consensus_specs_tpu/stf/verify.py", _WHITELISTED)] == [3, 6, 9, 12]
+
+
+def test_rb01_only_applies_to_stf():
+    assert rb01("consensus_specs_tpu/forkchoice/engine.py", _VIOLATIONS) == []
+    assert rb01("tests/helper.py", _VIOLATIONS) == []
+
+
+def test_rb01_catches_state_like_parameter_names():
+    # naming the parameter `st` or `*_state` must not bypass the gate
+    src = ("def sneaky(spec, st, root):\n"
+           "    st.latest_block_header.state_root = root\n"
+           "def sneakier(spec, pre_state):\n"
+           "    pre_state.slot += 1\n"
+           "def fine(cache, key, value):\n"
+           "    cache[key] = value\n")
+    found = rb01("consensus_specs_tpu/stf/verify.py", src)
+    assert [f.line for f in found] == [2, 4]
+
+
+def test_rb01_slot_roots_whitelist():
+    src = ("def _process_slot(spec, state):\n"
+           "    state.state_roots[0] = b'x'\n"
+           "def other(spec, state):\n"
+           "    state.state_roots[0] = b'x'\n")
+    found = rb01("consensus_specs_tpu/stf/slot_roots.py", src)
+    assert [f.line for f in found] == [4]

@@ -1,0 +1,30 @@
+"""Registry contract: every rule is uniquely coded and documented, and
+the legacy single-file-checker codes all survived the migration."""
+from analysis import REGISTRY, all_rules
+
+LEGACY_CODES = {"E501", "F401", "W291", "W191", "B001", "E999",
+                "FC01", "ST01"}
+SEMANTIC_CODES = {"CC01", "RB01", "JX01", "DT01"}
+HYGIENE_ADDITIONS = {"W605", "B006"}
+
+
+def test_all_expected_codes_registered():
+    rules = {r.code for r in all_rules()}
+    assert LEGACY_CODES <= rules
+    assert SEMANTIC_CODES <= rules
+    assert HYGIENE_ADDITIONS <= rules
+
+
+def test_every_rule_has_unique_code_summary_and_docs():
+    seen = set()
+    for rule in all_rules():
+        assert rule.code and rule.code not in seen, rule
+        seen.add(rule.code)
+        assert rule.summary, f"{rule.code} has no summary"
+        assert type(rule).__doc__, f"{rule.code} has no docstring"
+    assert seen == set(REGISTRY)
+
+
+def test_rule_subset_selection():
+    subset = all_rules(codes=["FC01", "DT01"])
+    assert [r.code for r in subset] == ["FC01", "DT01"]

@@ -20,7 +20,7 @@ def _random_inputs(n, seed=7):
     head = tgt & (rng.random(n) < 0.9)
     delay = np.where(src, rng.integers(1, 9, n), 1).astype(np.int64)
     proposer = rng.integers(0, n, n).astype(np.int64)
-    total = int(np.sum(np.where(eligible, eff, 0)))
+    total = int(np.sum(np.where(eligible, eff, 0), dtype=np.uint64))
     return DeltaInputs(
         effective_balance=eff,
         eligible=eligible,

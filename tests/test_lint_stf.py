@@ -1,16 +1,19 @@
-"""ST01 lint rule: per-item ``bls.Verify`` / ``bls.FastAggregateVerify``
+"""ST01 rule: per-item ``bls.Verify`` / ``bls.FastAggregateVerify``
 loops outside ``specs/`` and ``crypto/`` are the one-pairing-at-a-time
 pattern the batched block engine (consensus_specs_tpu/stf) deletes — new
 code must batch through ``stf/verify.py`` or the facade's deferred scope.
 The spec sources keep the reference's sequential shape and ``crypto/``
-implements both paths, so both stay exempt; the live tree must be clean."""
+implements both paths, so both stay exempt; the live tree must be clean.
+
+Migrated from the legacy ``tools/lint.py`` single-file checker to the
+``tools/analysis`` registry API (same fixtures, same assertions).
+"""
 import sys
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
-import lint  # noqa: E402
-
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+from analysis import all_rules, analyze_file, iter_py_files  # noqa: E402
 
 _VIOLATIONS = """\
 def bad(bls, atts, state, spec):
@@ -38,12 +41,12 @@ def good(bls, stf_verify, atts, entries, keys):
 def _findings_for(tmp_path, name, source, code="ST01"):
     p = tmp_path / name
     p.write_text(source)
-    return [f for f in lint.check_file(p) if code in f[2]]
+    return [f for f in analyze_file(p) if f.code == code]
 
 
 def test_st01_flags_every_loop_shape(tmp_path):
     found = _findings_for(tmp_path, "helpers.py", _VIOLATIONS)
-    assert sorted(f[1] for f in found) == [3, 4, 7]
+    assert sorted(f.line for f in found) == [3, 4, 7]
 
 
 def test_st01_ignores_single_calls_and_batches(tmp_path):
@@ -59,15 +62,16 @@ def test_st01_exempts_spec_and_crypto_dirs(tmp_path):
 
 def test_st01_respects_noqa(tmp_path):
     src = ("def f(bls, items):\n"
-          "    return [bls.Verify(p, m, s)  # noqa: ST01 baseline\n"
-          "            for p, m, s in items]\n")
+           "    return [bls.Verify(p, m, s)  # noqa: ST01 baseline\n"
+           "            for p, m, s in items]\n")
     assert _findings_for(tmp_path, "x.py", src) == []
 
 
 def test_live_tree_is_st01_clean():
+    st01 = all_rules(codes=["ST01"])
     findings = []
-    for f in lint.iter_py_files(
+    for f in iter_py_files(
             [REPO / "consensus_specs_tpu", REPO / "tests", REPO / "tools",
              REPO / "bench.py", REPO / "__graft_entry__.py"]):
-        findings.extend(x for x in lint.check_file(f) if "ST01" in x[2])
+        findings.extend(analyze_file(f, rules=st01))
     assert findings == [], findings

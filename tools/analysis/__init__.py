@@ -1,0 +1,29 @@
+"""Pluggable semantic analyzer enforcing the engine invariants.
+
+Grown from the single-file ``tools/lint.py`` checker (PR 1/PR 2 bolted
+FC01 and ST01 onto it ad hoc): a rule-plugin registry with a shared
+symbol-resolution pass, per-code ``# noqa`` suppression, a reviewed
+baseline for grandfathered findings, a JSON report, and a content-hash
+incremental cache.  ``python tools/lint.py`` remains the CLI; the rule
+catalog lives in docs/architecture.md ("Static analysis").
+
+Hygiene rules: E501 E999 W191 W291 W605 F401 B001 B006
+Engine-invariant rules: FC01 ST01 CC01 RB01 JX01 DT01
+"""
+from .core import FileContext, Finding, REGISTRY, Rule, all_rules, register
+from .runner import (
+    DEFAULT_ROOTS,
+    REPO_ROOT,
+    Result,
+    analyze_file,
+    analyze_text,
+    iter_py_files,
+    run,
+    write_report,
+)
+
+__all__ = [
+    "FileContext", "Finding", "REGISTRY", "Rule", "all_rules", "register",
+    "DEFAULT_ROOTS", "REPO_ROOT", "Result", "analyze_file", "analyze_text",
+    "iter_py_files", "run", "write_report",
+]

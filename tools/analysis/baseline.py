@@ -1,0 +1,61 @@
+"""Reviewed baseline for grandfathered findings.
+
+``baseline.json`` holds findings a reviewer has examined and accepted,
+each with a one-line justification.  A finding matches an entry on
+``(file, code, snippet)`` — the stripped source line, not the line
+number, so baselined findings survive unrelated edits above them — and
+each entry consumes at most ONE finding, so a second identical violation
+added to the same file is new unreviewed code and fails the gate.  The
+runner reports matched findings separately (they don't fail the build)
+and flags stale entries (baselined lines that no longer produce the
+finding, or whose file is gone) so the file can't rot.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from .core import Finding
+
+
+class Baseline:
+    def __init__(self, entries: List[dict]):
+        self.entries = entries
+        # an entry consumes AT MOST one finding: a second identical
+        # violation in the same file is new, unreviewed code and must
+        # fail the gate (duplicate the entry to deliberately allow two)
+        self._allowed: Dict[Tuple[str, str, str], int] = {}
+        self._sample: Dict[Tuple[str, str, str], dict] = {}
+        for e in entries:
+            key = (e["file"], e["code"], e["snippet"])
+            self._allowed[key] = self._allowed.get(key, 0) + 1
+            self._sample[key] = e
+        self._matched: Dict[Tuple[str, str, str], int] = {}
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        p = Path(path)
+        if not p.exists():
+            return cls([])
+        data = json.loads(p.read_text())
+        entries = data.get("entries", [])
+        for e in entries:
+            for field in ("file", "code", "snippet", "justification"):
+                if field not in e:
+                    raise ValueError(
+                        f"baseline entry missing {field!r}: {e}")
+        return cls(entries)
+
+    def matches(self, finding: Finding) -> bool:
+        key = (finding.file, finding.code, finding.snippet)
+        used = self._matched.get(key, 0)
+        if used < self._allowed.get(key, 0):
+            self._matched[key] = used + 1
+            return True
+        return False
+
+    def stale_entries(self) -> List[dict]:
+        """Entries that matched no finding in the last run."""
+        return [e for k, e in self._sample.items()
+                if self._matched.get(k, 0) == 0]

@@ -1,0 +1,65 @@
+"""Content-hash incremental cache.
+
+Findings are a pure function of (file bytes, analyzer sources): the cache
+keys each file's findings by the sha256 of its text and drops wholesale
+when the analyzer's own sources change (``version`` digest, computed by
+the runner over every ``tools/analysis`` module).  noqa filtering happens
+before caching (it only reads the same text); baseline matching happens
+after (so editing baseline.json never needs a re-analysis).  A warm
+full-tree run is therefore one hash + one dict probe per file.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .core import Finding
+
+
+def text_digest(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8", "surrogatepass")).hexdigest()
+
+
+class AnalysisCache:
+    def __init__(self, path: Optional[Path], version: str):
+        self.path = Path(path) if path else None
+        self.version = version
+        self._files: Dict[str, dict] = {}
+        self.hits = 0
+        self.misses = 0
+        if self.path is None or not self.path.exists():
+            return
+        try:
+            data = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return
+        if data.get("version") == version:
+            self._files = data.get("files", {})
+
+    def get(self, display: str, digest: str) -> Optional[List[Finding]]:
+        entry = self._files.get(display)
+        if entry is None or entry.get("sha") != digest:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return [Finding(display, line, code, message, snippet)
+                for line, code, message, snippet in entry["findings"]]
+
+    def put(self, display: str, digest: str, findings: List[Finding]) -> None:
+        self._files[display] = {
+            "sha": digest,
+            "findings": [[f.line, f.code, f.message, f.snippet]
+                         for f in findings],
+        }
+
+    def save(self) -> None:
+        if self.path is None:
+            return
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self.path.write_text(json.dumps(
+                {"version": self.version, "files": self._files}))
+        except OSError:
+            pass  # a read-only checkout just stays cold

@@ -1,0 +1,121 @@
+"""Analyzer core: findings, the rule-plugin registry, and per-file context.
+
+A rule is a class with a unique ``code``, a one-line ``summary`` (shown in
+the catalog and registry tests), and a ``check(ctx)`` generator yielding
+``(line, message)`` pairs.  Rules register themselves with ``@register``;
+the runner instantiates every registered rule once per process and feeds
+each file through all of them.  Shared per-file facts (source text, parsed
+AST, the symbol-resolution pass) live on the ``FileContext`` so rules stay
+small and never re-derive them.
+"""
+from __future__ import annotations
+
+import ast
+import warnings
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple, Type
+
+from .symbols import SymbolTable
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding.  ``file`` is the display path (repo-relative
+    when under the analysis root), ``snippet`` the stripped source line —
+    the baseline matches on (file, code, snippet) so grandfathered
+    findings survive unrelated line-number drift."""
+
+    file: str
+    line: int
+    code: str
+    message: str
+    snippet: str = ""
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.code} {self.message}"
+
+
+class Rule:
+    """Base class for analyzer rules (subclass + ``@register``)."""
+
+    code: str = ""
+    summary: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Tuple[int, str]]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
+REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding a rule to the registry (unique code, summary
+    and docstring required — enforced by tests/analysis/test_registry.py)."""
+    if not cls.code:
+        raise ValueError(f"rule {cls.__name__} has no code")
+    if cls.code in REGISTRY:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    REGISTRY[cls.code] = cls
+    return cls
+
+
+def all_rules(codes=None) -> List[Rule]:
+    """Instantiate the registered rules (optionally a subset by code)."""
+    from . import rules  # noqa: F401  (import populates the registry)
+
+    selected = sorted(REGISTRY) if codes is None else list(codes)
+    return [REGISTRY[c]() for c in selected]
+
+
+@dataclass
+class FileContext:
+    """Everything rules may need about one file, computed once."""
+
+    path: Path
+    display: str
+    text: str
+    lines: List[str] = field(default_factory=list)
+    tree: Optional[ast.AST] = None
+    syntax_error: Optional[SyntaxError] = None
+    _symbols: Optional[SymbolTable] = None
+
+    @classmethod
+    def build(cls, path, text: str, display: Optional[str] = None) -> "FileContext":
+        ctx = cls(path=Path(path), display=display or str(path), text=text)
+        ctx.lines = text.splitlines()
+        try:
+            with warnings.catch_warnings():
+                # invalid escapes warn at parse time; W605 reports them
+                warnings.simplefilter("ignore")
+                ctx.tree = ast.parse(text, filename=str(path))
+        except SyntaxError as e:
+            ctx.syntax_error = e
+        return ctx
+
+    @property
+    def parts(self) -> tuple:
+        return self.path.parts
+
+    def in_dir(self, *names: str) -> bool:
+        """True when any path component equals one of ``names`` (the
+        directory-exemption idiom: specs/, crypto/, forkchoice/, ...)."""
+        return any(n in self.parts for n in names)
+
+    @property
+    def is_spec_source(self) -> bool:
+        """specs/src modules are pinned AST-for-AST to the reference
+        markdown and exempt from style rewraps."""
+        return "specs/src" in str(self.path).replace("\\", "/")
+
+    @property
+    def symbols(self) -> SymbolTable:
+        if self._symbols is None:
+            self._symbols = SymbolTable(self.tree)
+        return self._symbols
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""

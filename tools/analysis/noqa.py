@@ -1,0 +1,55 @@
+"""Per-line ``# noqa`` suppression with per-code targeting.
+
+``# noqa`` (bare) suppresses every rule on its line — the legacy checker's
+only mode, kept for compatibility.  ``# noqa: E501`` or
+``# noqa: FC01, ST01`` suppresses only the listed codes; trailing prose
+(``# noqa: E501 (RFC 9380 G2 h_eff)``) is allowed and ignored.  Codes the
+registry doesn't know are legal (flake8 codes like E402/E731 document
+intent even though this analyzer doesn't implement them).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Set
+
+# bare form captures nothing after "noqa"; coded form captures the rest
+# of the comment, from which only the LEADING run of code-shaped tokens
+# is taken (flake8 semantics) — prose after the codes never re-arms a
+# suppression just by mentioning a rule code
+_NOQA_RE = re.compile(r"#\s*noqa\b(?P<colon>:)?(?P<rest>[^#]*)", re.IGNORECASE)
+_CODE_RE = re.compile(r"[A-Za-z]+[0-9]+$")
+
+ALL = None  # sentinel: bare noqa suppresses every code
+
+
+def _leading_codes(rest: str) -> Set[str]:
+    codes: Set[str] = set()
+    for token in re.split(r"[,\s]+", rest.strip()):
+        if not _CODE_RE.fullmatch(token):
+            break  # first non-code token ends the list; prose follows
+        codes.add(token.upper())
+    return codes
+
+
+def parse_noqa(lines: List[str]) -> Dict[int, Optional[Set[str]]]:
+    """Map 1-based line number -> set of suppressed codes (ALL for bare)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(lines, 1):
+        if "noqa" not in line.lower():
+            continue
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        if not m.group("colon"):
+            out[i] = ALL
+            continue
+        found = _leading_codes(m.group("rest"))
+        out[i] = found if found else ALL
+    return out
+
+
+def suppressed(noqa: Dict[int, Optional[Set[str]]], line: int, code: str) -> bool:
+    if line not in noqa:
+        return False
+    codes = noqa[line]
+    return codes is ALL or code in codes

@@ -1,0 +1,12 @@
+"""Rule plugins.  Importing this package populates the registry; add a
+new rule by dropping a module here and importing it below (the registry
+test asserts every rule has a unique code, a summary, and a docstring).
+"""
+from . import (  # noqa: F401
+    cache_coherence,
+    dtype_safety,
+    engine_rules,
+    hygiene,
+    jit_purity,
+    rollback,
+)

@@ -1,0 +1,237 @@
+"""CC01 — cache coherence.
+
+The engines earn their speed from registered memos: the whole-epoch
+shuffle permutation (``ops/shuffle.py``), the committee-geometry /
+proposer / affine-matrix caches (``stf/attestations.py``), the
+verified-triple memo (``stf/verify.py``), the registry column caches
+(``ops/epoch_jax.py``, ``ssz/bulk.py``), the fork-choice head cache
+(``forkchoice/engine.py``), and the resident-merkle root memo
+(``ssz/node.py`` ``_root`` / view ``_dirty_chunks``).  Each is coherent
+only while every insertion goes through its owning module: a write from
+anywhere else can install an entry the owner's keying discipline never
+blessed — and the engines then serve stale committees, signatures, heads,
+or roots with no failing assert anywhere near the cause.
+
+CC01 flags, outside the owning module and without a paired invalidation
+in the same function:
+
+* **insertions into the cache structure itself** — subscript assignment,
+  ``update``/``setdefault``, or rebinding, through a module alias
+  (``shuffle._cache[k] = v``) or a registered instance attribute
+  (``engine._head = node``).  Deletions, ``clear()``/``pop()`` and
+  ``= None`` rebinds are invalidations — removing an entry can only force
+  a recompute, never staleness — and stay legal everywhere;
+* **mutation of a producer's return value** — the caches hand out shared
+  objects (``compute_shuffle_permutation`` returns the cached ndarray
+  itself), so ``perm[i] = x`` after ``perm = compute_shuffle_permutation(...)``
+  corrupts every later committee resolution.  The symbol pass tracks the
+  producing call through plain rebinding and derived views.
+
+A write is pardoned when its enclosing function is a registered
+invalidator or calls one (``reset_caches()`` / ``reset_memo()``): wiping
+the memo after touching its backing is exactly the documented protocol.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import FrozenSet, Tuple
+
+from ..core import Rule, register
+from ..symbols import module_matches, root_name, written_targets
+
+_INSERTING_METHODS = {"update", "setdefault", "__setitem__"}
+_ARRAY_MUTATORS = {"fill", "sort", "put", "itemset", "partition", "resize"}
+
+
+@dataclass(frozen=True)
+class CacheSpec:
+    """One registered memo: where it lives, how it is spelled, and which
+    calls count as its invalidation protocol."""
+
+    name: str
+    owner: Tuple[str, ...]        # contiguous path parts of the owning module
+    module: str                   # dotted module (alias resolution target)
+    module_globals: FrozenSet[str] = frozenset()
+    instance_attrs: FrozenSet[str] = frozenset()
+    producers: FrozenSet[str] = frozenset()
+    invalidators: FrozenSet[str] = frozenset()
+
+
+CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
+    CacheSpec(
+        name="shuffle-permutation cache",
+        owner=("ops", "shuffle.py"),
+        module="consensus_specs_tpu.ops.shuffle",
+        module_globals=frozenset({"_cache"}),
+        producers=frozenset({"compute_shuffle_permutation"}),
+        invalidators=frozenset({"reset_caches"}),
+    ),
+    CacheSpec(
+        name="committee-geometry cache",
+        owner=("stf", "attestations.py"),
+        module="consensus_specs_tpu.stf.attestations",
+        module_globals=frozenset({"_ACTIVE_CACHE", "_CTX_CACHE", "_CTX_LOOKUP",
+                                  "_PROPOSER_CACHE", "_AFFINE_MATRIX_CACHE"}),
+        producers=frozenset({"active_indices", "committee_context",
+                             "affine_matrix"}),
+        invalidators=frozenset({"reset_caches"}),
+    ),
+    CacheSpec(
+        name="verified-triple memo",
+        owner=("stf", "verify.py"),
+        module="consensus_specs_tpu.stf.verify",
+        module_globals=frozenset({"_VERIFIED_MEMO"}),
+        invalidators=frozenset({"reset_memo"}),
+    ),
+    CacheSpec(
+        name="registry-columns cache",
+        owner=("ops", "epoch_jax.py"),
+        module="consensus_specs_tpu.ops.epoch_jax",
+        module_globals=frozenset({"_COLS_CACHE"}),
+        producers=frozenset({"registry_columns"}),
+        invalidators=frozenset({"reset_caches"}),
+    ),
+    CacheSpec(
+        name="pubkey-column cache",
+        owner=("ssz", "bulk.py"),
+        module="consensus_specs_tpu.ssz.bulk",
+        module_globals=frozenset({"_PUBKEY_CACHE", "_PUBKEY_INDEX_CACHE"}),
+        producers=frozenset({"cached_validator_pubkeys",
+                             "cached_pubkey_index"}),
+        invalidators=frozenset({"reset_caches"}),
+    ),
+    CacheSpec(
+        name="fork-choice head cache",
+        owner=("forkchoice",),
+        module="consensus_specs_tpu.forkchoice.engine",
+        instance_attrs=frozenset({"_head", "vote_node", "vote_epoch"}),
+        invalidators=frozenset(),
+    ),
+    CacheSpec(
+        name="resident-merkle root memo",
+        owner=("ssz",),
+        module="consensus_specs_tpu.ssz.node",
+        instance_attrs=frozenset({"_root", "_dirty_chunks"}),
+        invalidators=frozenset({"_invalidate"}),
+    ),
+)
+
+
+def _parts_contain(parts: tuple, owner: Tuple[str, ...]) -> bool:
+    n = len(owner)
+    return any(parts[i:i + n] == owner for i in range(len(parts) - n + 1))
+
+
+def _is_none(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+@register
+class CacheCoherenceRule(Rule):
+    """Writes to structures backing a registered memo outside the owning
+    module, without a paired invalidation in the same function."""
+
+    code = "CC01"
+    summary = "cache-structure write outside the owning module"
+
+    registry = CACHE_REGISTRY
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.in_dir("specs"):
+            return
+        specs = [s for s in self.registry
+                 if not _parts_contain(ctx.parts, s.owner)]
+        if not specs:
+            return
+        sym = ctx.symbols
+        for node in ast.walk(ctx.tree):
+            for spec, detail in self._writes(node, sym, specs):
+                if self._pardoned(node, sym, spec):
+                    continue
+                owner = "/".join(spec.owner)
+                fix = (f"pair with {sorted(spec.invalidators)[0]}()"
+                       if spec.invalidators else "invalidate it (= None)")
+                yield (node.lineno,
+                       f"{detail} of the {spec.name} outside {owner}; "
+                       f"{fix} or move the write into the owner")
+
+    # -- write detection -----------------------------------------------------
+
+    def _writes(self, node, sym, specs):
+        """Yield (spec, detail) for each registered-cache write at node
+        (only the specs this file does NOT own).  ``delete`` targets are
+        skipped by design: removal is an invalidation."""
+        for kind, expr, method in written_targets(node):
+            if kind == "method":
+                if method in _INSERTING_METHODS:
+                    spec = self._cache_expr(expr, sym, specs)
+                    if spec is not None:
+                        yield (spec, "insertion")
+                elif method in _ARRAY_MUTATORS:
+                    spec = self._produced_expr(expr, sym, node, specs)
+                    if spec is not None:
+                        yield (spec, "in-place mutation of a cached value")
+            elif kind == "delete":
+                continue
+            elif isinstance(expr, ast.Subscript):
+                spec = self._cache_expr(expr.value, sym, specs)
+                if spec is not None:
+                    yield (spec, "insertion")
+                    continue
+                spec = self._produced_expr(expr.value, sym, node, specs)
+                if spec is not None:
+                    yield (spec, "in-place mutation of a cached value")
+            else:
+                spec = self._cache_expr(expr, sym, specs)
+                if spec is not None and not _is_none(getattr(node, "value", None)):
+                    yield (spec, "rebind")
+
+    def _cache_expr(self, expr, sym, specs):
+        """The CacheSpec an expression denotes, if it names a registered
+        cache structure: ``<owner-module-alias>.<global>`` or a registered
+        instance attribute on an outside object.  ``self.X``/``cls.X`` in
+        a non-owner file is that class's OWN attribute namespace — an
+        unrelated class reusing a name like ``_root`` or ``_head`` is not
+        a write into the engines' caches."""
+        if not isinstance(expr, ast.Attribute):
+            return None
+        for spec in specs:
+            if expr.attr in spec.module_globals and module_matches(
+                    sym.resolve(expr.value), spec.module):
+                return spec
+            if expr.attr in spec.instance_attrs and not (
+                    isinstance(expr.value, ast.Name)
+                    and expr.value.id in ("self", "cls")):
+                return spec
+        return None
+
+    def _produced_expr(self, expr, sym, node, specs):
+        """The CacheSpec whose producer's return value ``expr`` is rooted
+        in (via the scope's alias/origin tracking).  The producing call
+        must resolve INTO the owner module (through an import or module
+        attribute): an unrelated local function that merely shares a
+        producer's name is not the cache."""
+        base = root_name(expr)
+        if base is None:
+            return None
+        origin = sym.scope_of(node).origin_of(base)
+        if origin is None or "." not in origin.lstrip("."):
+            return None  # bare name: locally defined, not the owner's
+        prefix, last = origin.rsplit(".", 1)
+        for spec in specs:
+            if last in spec.producers and module_matches(prefix, spec.module):
+                return spec
+        return None
+
+    # -- pardons -------------------------------------------------------------
+
+    def _pardoned(self, node, sym, spec) -> bool:
+        if not spec.invalidators:
+            return False
+        for func in sym.enclosing_functions(node):
+            if func.name in spec.invalidators:
+                return True
+            if sym.calls_function(func, spec.invalidators):
+                return True
+        return False

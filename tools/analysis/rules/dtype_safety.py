@@ -1,0 +1,113 @@
+"""DT01 — Gwei dtype safety.
+
+``np.sum`` / ``np.cumsum`` / ``np.dot`` pick their accumulator from the
+input dtype — and when the input is anything but a 64-bit integer array
+(a bool mask promoted through ``np.where``, an int32 intermediate, a
+list), numpy accumulates in platform ``intp``.  Mainnet balances make
+that a live hazard: 400k validators × 32 ETH ≈ 1.3e16 Gwei, past int32
+by six orders of magnitude, and a 32-bit-``intp`` build wraps silently —
+a wrong total-active-balance changes justification thresholds with no
+exception anywhere.  The spec side is immune by construction (python
+ints); only the numpy fast paths can wrap.
+
+DT01 flags ``np.sum``/``np.cumsum``/``np.dot`` calls (function or
+method form) whose reduced operand mentions a balance/weight identifier
+(``balance``, ``weight``, ``gwei``, ``reward``, ``penalt``, ``eff``)
+without an explicit 64-bit accumulator: pass ``dtype=np.uint64``
+(preferred for Gwei; ``np.int64`` is accepted where signed deltas are
+real).  ``jnp`` reductions are exempt — their width policy is the global
+x64 flag, set once in ``_jaxcache.configure``.  ``specs/src`` modules
+are exempt (pinned AST-for-AST to the reference)."""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register
+from ..symbols import root_name
+
+_REDUCERS = {"sum", "cumsum", "dot"}
+_HINT_SUBSTRINGS = ("balance", "weight", "gwei", "reward", "penalt")
+_HINT_EXACT = {"eff"}
+_OK_DTYPES = {"uint64", "int64", "u8", "i8"}
+
+
+def _gwei_hint(expr: ast.AST) -> bool:
+    """True when the expression mentions a balance/weight-ish identifier
+    (names, attributes, or string keys like cols["effective_balance"])."""
+    for node in ast.walk(expr):
+        word = None
+        if isinstance(node, ast.Name):
+            word = node.id
+        elif isinstance(node, ast.Attribute):
+            word = node.attr
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            word = node.value
+        if word is None:
+            continue
+        w = word.lower()
+        if w in _HINT_EXACT or any(h in w for h in _HINT_SUBSTRINGS):
+            return True
+    return False
+
+
+def _dtype_ok(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg != "dtype":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Attribute) and v.attr in _OK_DTYPES:
+            return True
+        if isinstance(v, ast.Name) and v.id in _OK_DTYPES:
+            return True
+        if isinstance(v, ast.Constant) and str(v.value) in _OK_DTYPES:
+            return True
+    return False
+
+
+@register
+class GweiDtypeRule(Rule):
+    """numpy reduction over a balance/weight array without an explicit
+    64-bit accumulator dtype."""
+
+    code = "DT01"
+    summary = "Gwei reduction without explicit dtype=np.uint64"
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.is_spec_source:
+            return
+        sym = ctx.symbols
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute) or f.attr not in _REDUCERS:
+                continue
+            resolved = sym.resolve(f)
+            if resolved and resolved.lstrip(".").startswith("numpy."):
+                operands = node.args  # np.sum(x) / np.dot(a, b)
+            elif resolved and (resolved.lstrip(".").startswith("jax.")
+                               or resolved.lstrip(".").startswith("jnp.")):
+                continue  # jnp width policy is the global x64 flag
+            else:
+                # x.sum() / a.dot(b) — skip receivers that provably hold
+                # a jax array (assigned from a jax/jnp call in scope)
+                base = root_name(f.value)
+                origin = (sym.scope_of(node).origin_of(base)
+                          if base else None)
+                if origin and origin.lstrip(".").split(".")[0] in ("jax", "jnp"):
+                    continue
+                operands = [f.value, *node.args]
+            if not any(_gwei_hint(op) for op in operands):
+                continue
+            if _dtype_ok(node):
+                continue
+            if f.attr == "dot" and any(
+                    isinstance(n, ast.Attribute) and n.attr in _OK_DTYPES
+                    for op in operands for n in ast.walk(op)):
+                continue  # operands already cast with .astype(np.uint64)
+            yield (node.lineno,
+                   f"np.{f.attr} over a balance/weight array without an "
+                   "explicit 64-bit accumulator (platform-intp overflow at "
+                   "mainnet balances; pass dtype=np.uint64"
+                   + (" or cast operands with .astype(np.uint64)"
+                      if f.attr == "dot" else "") + ")")

@@ -1,0 +1,87 @@
+"""Project rules carried over from the PR-1/PR-2 bespoke checkers.
+
+FC01 — the spec ``Store`` and the proto-array engine each hold a
+latest-message view; they stay in lockstep only if every write goes
+through the spec handlers or ``forkchoice/batch.py``.  A stray
+``store.latest_messages[i] = ...`` anywhere else silently desynchronizes
+the two vote stores.
+
+ST01 — per-item ``bls.Verify`` / ``bls.FastAggregateVerify`` loops are
+the one-pairing-at-a-time pattern the batched block engine deletes; new
+code must batch through ``stf/verify.py`` or the facade's deferred scope
+(one shared final exponentiation for the whole set).  Spec sources keep
+the reference's sequential shape and ``crypto/`` implements both paths,
+so both stay exempt; measurement baselines mark themselves ``# noqa``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register
+from ..symbols import written_targets
+
+_MUTATING_DICT_METHODS = {"update", "pop", "popitem", "clear", "setdefault",
+                          "__setitem__", "__delitem__"}
+
+
+def _is_latest_messages(expr) -> bool:
+    return isinstance(expr, ast.Attribute) and expr.attr == "latest_messages"
+
+
+@register
+class LatestMessagesMutationRule(Rule):
+    """Direct ``store.latest_messages`` mutation outside ``specs/`` and
+    ``forkchoice/``: subscript assignment / augmented assignment /
+    deletion, mutating dict-method calls, and rebinding the attribute."""
+
+    code = "FC01"
+    summary = "direct store.latest_messages mutation outside specs/+forkchoice/"
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.in_dir("specs", "forkchoice"):
+            return
+        msg = ("direct store.latest_messages mutation "
+               "(route through spec handlers or forkchoice/batch.py)")
+        for node in ast.walk(ctx.tree):
+            for kind, expr, method in written_targets(node):
+                if kind == "method":
+                    if (method in _MUTATING_DICT_METHODS
+                            and _is_latest_messages(expr)):
+                        yield (node.lineno, msg)
+                elif isinstance(expr, ast.Subscript) and _is_latest_messages(
+                        expr.value):
+                    yield (node.lineno, msg)
+                elif _is_latest_messages(expr):
+                    yield (node.lineno, msg)
+
+
+_PER_ITEM_VERIFY_FNS = {"Verify", "FastAggregateVerify"}
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While,
+               ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+@register
+class PerItemVerifyLoopRule(Rule):
+    """``bls.Verify`` / ``bls.FastAggregateVerify`` issued inside a loop
+    or comprehension outside ``specs/`` and ``crypto/``."""
+
+    code = "ST01"
+    summary = "per-item bls verification in a loop"
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.in_dir("specs", "crypto"):
+            return
+        lines = set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, _LOOP_NODES):
+                continue
+            for node in ast.walk(loop):
+                if node is loop:
+                    continue
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                    if node.func.attr in _PER_ITEM_VERIFY_FNS:
+                        lines.add(node.lineno)
+        for lineno in sorted(lines):
+            yield (lineno,
+                   "per-item bls verification in a loop "
+                   "(batch via stf/verify.py or the facade's deferred scope)")

@@ -1,0 +1,228 @@
+"""Line/AST hygiene rules: the flake8-class checks the reference CI gates
+on (``linter.ini`` + ``make lint``), ported from the legacy single-file
+checker with identical findings, plus W605/B006.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+
+from ..core import Rule, register
+
+MAX_LINE = 120
+
+
+@register
+class SyntaxErrorRule(Rule):
+    """A file that does not parse produces exactly one finding; every
+    AST-based rule skips it."""
+
+    code = "E999"
+    summary = "syntax error"
+
+    def check(self, ctx):
+        if ctx.syntax_error is not None:
+            e = ctx.syntax_error
+            yield (e.lineno or 0, f"syntax error: {e.msg}")
+
+
+@register
+class LineLengthRule(Rule):
+    """Lines over 120 columns (the reference flake8 max).  specs/src
+    modules are exempt: their bodies are pinned AST-for-AST to the
+    reference markdown and must not be rewrapped."""
+
+    code = "E501"
+    summary = "line too long (>120)"
+
+    def check(self, ctx):
+        if ctx.is_spec_source:
+            return
+        for i, line in enumerate(ctx.lines, 1):
+            if len(line) > MAX_LINE:
+                yield (i, f"line too long ({len(line)} > {MAX_LINE})")
+
+
+@register
+class TrailingWhitespaceRule(Rule):
+    """Trailing whitespace on a non-blank line."""
+
+    code = "W291"
+    summary = "trailing whitespace"
+
+    def check(self, ctx):
+        for i, line in enumerate(ctx.lines, 1):
+            if line != line.rstrip() and line.strip():
+                yield (i, "trailing whitespace")
+
+
+@register
+class TabIndentRule(Rule):
+    """Tab indentation (the tree is uniformly space-indented)."""
+
+    code = "W191"
+    summary = "tab indentation"
+
+    def check(self, ctx):
+        for i, line in enumerate(ctx.lines, 1):
+            if line.startswith("\t"):
+                yield (i, "tab indentation")
+
+
+@register
+class BareExceptRule(Rule):
+    """``except:`` catches SystemExit/KeyboardInterrupt; name the types
+    (or ``Exception`` for genuinely-anything handlers)."""
+
+    code = "B001"
+    summary = "bare except"
+
+    def check(self, ctx):
+        if ctx.tree is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield (node.lineno, "bare except")
+
+
+class _ImportUse(ast.NodeVisitor):
+    """Collect imported names and every name usage (legacy F401 logic)."""
+
+    def __init__(self):
+        self.imports = {}  # name -> (lineno, display)
+        self.used = set()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imports[name] = (node.lineno, alias.name)
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+@register
+class UnusedImportRule(Rule):
+    """An imported name never referenced.  ``__init__.py`` imports are
+    re-exports (the public API surface, flake8 per-file-ignores
+    equivalent); a whole-word occurrence anywhere else in the source (an
+    ``__all__`` entry, a docstring doctest, a string annotation) counts
+    as a use."""
+
+    code = "F401"
+    summary = "imported but unused"
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.path.name == "__init__.py":
+            return
+        checker = _ImportUse()
+        checker.visit(ctx.tree)
+        for name, (lineno, display) in checker.imports.items():
+            if name in checker.used or name.startswith("_"):
+                continue
+            occurrences = len(re.findall(
+                rf"\b{re.escape(name)}\b", ctx.text))
+            if occurrences <= 1:
+                yield (lineno, f"'{display}' imported but unused")
+
+
+# -- W605: invalid escape sequence -------------------------------------------
+
+_VALID_STR_ESCAPES = set("\n\r\\'\"abfnrtv01234567xNuU")
+_VALID_BYTES_ESCAPES = set("\n\r\\'\"abfnrtv01234567x")
+_PREFIX_RE = re.compile(r"^[A-Za-z]*")
+
+
+@register
+class InvalidEscapeRule(Rule):
+    """``"\\d"`` in a non-raw string is a DeprecationWarning today and a
+    SyntaxError in a future Python; write ``r"\\d"`` (or escape the
+    backslash)."""
+
+    code = "W605"
+    summary = "invalid escape sequence in non-raw string"
+
+    def check(self, ctx):
+        if ctx.tree is None:
+            return
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(ctx.text).readline))
+        except (tokenize.TokenError, IndentationError):
+            return
+        for tok in tokens:
+            if tok.type != tokenize.STRING:
+                continue
+            prefix = _PREFIX_RE.match(tok.string).group().lower()
+            if "r" in prefix:
+                continue
+            valid = _VALID_BYTES_ESCAPES if "b" in prefix \
+                else _VALID_STR_ESCAPES
+            body = tok.string[len(prefix):]
+            quote = body[:3] if body[:3] in ('"""', "'''") else body[:1]
+            inner = body[len(quote):-len(quote)]
+            i, line, col = 0, tok.start[0], None
+            while i < len(inner) - 1:
+                ch = inner[i]
+                if ch == "\n":
+                    line += 1
+                    i += 1
+                    continue
+                if ch == "\\":
+                    esc = inner[i + 1]
+                    if esc == "\n":
+                        line += 1  # line continuation: valid, but advances
+                    elif esc not in valid:
+                        yield (line, f"invalid escape sequence '\\{esc}'")
+                    i += 2
+                    continue
+                i += 1
+
+
+# -- B006: mutable default argument -------------------------------------------
+
+_MUTABLE_DISPLAYS = (ast.List, ast.Dict, ast.Set,
+                     ast.ListComp, ast.DictComp, ast.SetComp)
+_MUTABLE_CALLS = {"list", "dict", "set", "bytearray",
+                  "defaultdict", "OrderedDict", "Counter", "deque"}
+
+
+@register
+class MutableDefaultRule(Rule):
+    """A mutable default argument is evaluated once at def time and shared
+    across calls; default to None and materialize inside the function."""
+
+    code = "B006"
+    summary = "mutable default argument"
+
+    def check(self, ctx):
+        if ctx.tree is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if isinstance(d, _MUTABLE_DISPLAYS):
+                    yield (d.lineno, "mutable default argument")
+                elif isinstance(d, ast.Call):
+                    fn = d.func
+                    name = fn.id if isinstance(fn, ast.Name) else (
+                        fn.attr if isinstance(fn, ast.Attribute) else None)
+                    if name in _MUTABLE_CALLS:
+                        yield (d.lineno, "mutable default argument")

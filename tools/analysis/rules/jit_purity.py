@@ -1,0 +1,197 @@
+"""JX01 — jit purity.
+
+``jax.jit`` / ``shard_map`` trace a function ONCE per input shape and
+replay the recorded computation forever after.  Side effects run at trace
+time only: a ``print`` shows up once and never again, a mutation of
+module state (``stats["x"] += 1``) counts one epoch instead of thousands,
+and an in-place numpy write on a traced argument either throws at trace
+time (tracers are immutable) or — worse, when the argument arrives as a
+concrete numpy array during warm-up — silently corrupts the caller's
+buffer while doing nothing in the compiled run.  Every one of these is a
+works-in-the-small-test, wrong-at-scale bug.
+
+JX01 marks a function as traced when it is decorated with
+``jax.jit``/``shard_map``/``pjit`` (directly, as a call, or through
+``functools.partial``) or passed by name to such a call
+(``_jit_reduce = jax.jit(_reduce_to_root)``), resolving spellings
+through the import table.  Inside a traced function it flags:
+
+* ``print(...)`` calls;
+* ``global``/``nonlocal`` declarations whose names the function assigns;
+* subscript/attribute stores into module-level state (a name the
+  function neither binds nor receives);
+* in-place writes on traced arguments: subscript stores, and mutating
+  ndarray methods (``fill``/``sort``/``setflags``/...) or
+  ``np.put``/``np.place``/``np.copyto``/``np.putmask`` on a parameter.
+
+The functional forms (``x.at[i].set(v)``, ``lax.dynamic_update_slice``)
+express the same updates purely and stay legal.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Rule, register
+from ..symbols import name_matches, root_name
+
+_TRACERS = {"jit", "pjit", "shard_map"}
+_NP_MUTATORS = {"put", "place", "copyto", "putmask"}
+_METHOD_MUTATORS = {"fill", "sort", "setflags", "put", "itemset",
+                    "partition", "resize"}
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_tracer(resolved) -> bool:
+    if not resolved:
+        return False
+    r = resolved.lstrip(".")
+    return (r in {"jax.jit", "jax.pjit"}
+            or r.endswith(".jit") and r.startswith("jax")
+            or r == "shard_map" or r.endswith(".shard_map")
+            or r.endswith(".pjit"))
+
+
+@register
+class JitPurityRule(Rule):
+    """Side effects inside a jit/shard_map-traced function."""
+
+    code = "JX01"
+    summary = "impure operation inside a jit/shard_map-traced function"
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.in_dir("specs"):
+            return
+        sym = ctx.symbols
+        traced: List[ast.AST] = []
+        seen: Set[ast.AST] = set()
+
+        def mark(fn):
+            if fn not in seen:
+                seen.add(fn)
+                traced.append(fn)
+
+        def mark_call_args(call):
+            for arg in call.args:
+                if isinstance(arg, ast.Name):
+                    for fn in sym.functions.get(arg.id, ()):
+                        mark(fn)
+                elif isinstance(arg, ast.Lambda):
+                    mark(arg)
+                # nested tracer calls (jax.jit(shard_map(step, ...))) are
+                # themselves Call nodes and get walked independently
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FUNC_NODES):
+                for dec in node.decorator_list:
+                    if _is_tracer(sym.resolve(dec)):
+                        mark(node)
+                    elif isinstance(dec, ast.Call):
+                        if _is_tracer(sym.resolve(dec.func)):
+                            mark(node)
+                        elif (name_matches(sym.resolve(dec.func), {"partial"})
+                              and dec.args
+                              and _is_tracer(sym.resolve(dec.args[0]))):
+                            mark(node)
+            elif isinstance(node, ast.Call) and _is_tracer(
+                    sym.resolve(node.func)):
+                mark_call_args(node)
+            elif isinstance(node, ast.Call) and name_matches(
+                    sym.resolve(node.func), {"partial"}):
+                if node.args and _is_tracer(sym.resolve(node.args[0])):
+                    mark_call_args(ast.Call(func=node.args[0],
+                                            args=node.args[1:], keywords=[]))
+
+        for fn in traced:
+            yield from self._check_traced(fn, sym, ctx)
+
+    # -- per-traced-function checks -------------------------------------------
+
+    def _check_traced(self, fn, sym, ctx):
+        if isinstance(fn, ast.Lambda):
+            return  # a lambda body can only be an expression; nothing to flag
+        info = sym.scope_info(fn)
+        name = fn.name
+        declared: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared.update(node.names)
+        local = info.params | info.assigned - declared
+
+        def is_local(node, base: str) -> bool:
+            """Bound in ANY scope from the write site out to the traced
+            function (a nested helper's own locals are not module state)."""
+            if base in local:
+                return True
+            for f in sym.enclosing_functions(node):
+                scope = sym.scope_info(f)
+                if base in scope.params | scope.assigned:
+                    return True
+                if f is fn:
+                    break
+            return False
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                written = [n for n in node.names if self._assigns(fn, n)]
+                if written:
+                    kind = ("global" if isinstance(node, ast.Global)
+                            else "nonlocal")
+                    yield (node.lineno,
+                           f"'{name}' is traced by jax.jit/shard_map but "
+                           f"rebinds {kind} {', '.join(written)} (trace-time "
+                           "side effect; return the value instead)")
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id == "print":
+                    yield (node.lineno,
+                           f"print() inside traced function '{name}' runs "
+                           "at trace time only (use jax.debug.print)")
+                elif isinstance(f, ast.Attribute):
+                    if f.attr in _METHOD_MUTATORS:
+                        base = root_name(f.value)
+                        if base and info.resolve_root(base) in info.params:
+                            yield (node.lineno,
+                                   f".{f.attr}() mutates traced argument "
+                                   f"'{base}' in '{name}' (use the "
+                                   "functional .at[] / jnp form)")
+                    if f.attr in _NP_MUTATORS and name_matches(
+                            sym.resolve(f), {f.attr}) and node.args:
+                        resolved = sym.resolve(f)
+                        if resolved and resolved.lstrip(".").startswith("numpy."):
+                            base = root_name(node.args[0])
+                            if base and info.resolve_root(base) in info.params:
+                                yield (node.lineno,
+                                       f"np.{f.attr} writes into traced "
+                                       f"argument '{base}' in '{name}'")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if not isinstance(t, (ast.Subscript, ast.Attribute)):
+                        continue
+                    base = root_name(t)
+                    if base is None:
+                        continue
+                    base = info.resolve_root(base)
+                    if base in info.params:
+                        yield (node.lineno,
+                               f"in-place write to traced argument '{base}' "
+                               f"in '{name}' (tracers are immutable; use "
+                               ".at[i].set(v))")
+                    elif not is_local(node, base) and base not in ("self", "cls"):
+                        yield (node.lineno,
+                               f"'{name}' is traced but mutates module-"
+                               f"level state through '{base}' (trace-time "
+                               "side effect)")
+
+    @staticmethod
+    def _assigns(fn, name: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return True
+        return False

@@ -1,0 +1,179 @@
+"""Analysis runner: file iteration, the incremental cache, noqa and
+baseline filtering, and the JSON report.
+
+``run()`` is the one entry point every consumer shares — the ``make
+lint`` / ``make analyze`` CLI (tools/lint.py), the tier-1 gate
+(tests/analysis/test_live_tree_clean.py), and the mutation tests (via
+``overrides``, which analyze hypothetical file contents against the real
+tree without touching disk).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .baseline import Baseline
+from .cachefile import AnalysisCache, text_digest
+from .core import FileContext, Finding, all_rules
+from .noqa import parse_noqa, suppressed
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_ROOTS = ("consensus_specs_tpu", "tests", "tools",
+                 "bench.py", "__graft_entry__.py")
+DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
+DEFAULT_CACHE = REPO_ROOT / ".cache" / "analysis_cache.json"
+
+
+def iter_py_files(roots):
+    for root in roots:
+        p = Path(root)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if ".cache" not in f.parts:
+                    yield f
+
+
+def _display(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return str(path)
+
+
+def analyzer_version() -> str:
+    """Digest of the analyzer's own sources — the cache drops wholesale
+    when any rule changes (baseline.json excluded: it applies post-cache)."""
+    h = hashlib.sha256()
+    for f in sorted(Path(__file__).parent.rglob("*.py")):
+        h.update(f.name.encode())
+        h.update(f.read_bytes())
+    return h.hexdigest()
+
+
+def analyze_text(path, text: str, display: Optional[str] = None,
+                 rules=None) -> List[Finding]:
+    """Analyze one file's content: all rules + per-code noqa filtering.
+    Baseline matching is the caller's concern (``run`` applies it)."""
+    ctx = FileContext.build(path, text, display=display)
+    noqa = parse_noqa(ctx.lines)
+    findings: List[Finding] = []
+    for rule in (rules if rules is not None else all_rules()):
+        for line, message in rule.check(ctx):
+            if suppressed(noqa, line, rule.code):
+                continue
+            findings.append(Finding(ctx.display, line, rule.code, message,
+                                    ctx.snippet(line)))
+    findings.sort(key=lambda f: (f.line, f.code))
+    return findings
+
+
+def analyze_file(path, text: Optional[str] = None, root: Optional[Path] = None,
+                 rules=None) -> List[Finding]:
+    p = Path(path)
+    display = _display(p, root or REPO_ROOT)
+    if text is None:
+        try:
+            text = p.read_text()
+        except UnicodeDecodeError as e:
+            return [Finding(display, 0, "E902",
+                            f"not valid UTF-8: {e.reason}")]
+    return analyze_text(p, text, display=display, rules=rules)
+
+
+@dataclass
+class Result:
+    findings: List[Finding] = field(default_factory=list)    # unbaselined
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+    n_files: int = 0
+    cache_hits: int = 0
+    duration_s: float = 0.0
+
+    def to_json(self) -> dict:
+        def row(f: Finding) -> dict:
+            return {"file": f.file, "line": f.line, "code": f.code,
+                    "message": f.message, "snippet": f.snippet}
+
+        return {
+            "files_analyzed": self.n_files,
+            "cache_hits": self.cache_hits,
+            "duration_s": round(self.duration_s, 3),
+            "findings": [row(f) for f in self.findings],
+            "baselined": [row(f) for f in self.baselined],
+            "stale_baseline": self.stale_baseline,
+        }
+
+
+def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
+        cache_path=None, baseline_path=None, rules=None,
+        overrides: Optional[Dict[str, str]] = None) -> Result:
+    """Analyze a tree.
+
+    ``overrides`` maps display paths (repo-relative posix) to replacement
+    text: those files are analyzed with the given content instead of what
+    is on disk (and bypass the cache) — the seeded-mutation tests use this
+    to prove a reintroduced bug turns the gate red.
+    """
+    t0 = time.perf_counter()
+    root = Path(root) if root else REPO_ROOT
+    roots = list(roots) if roots else [root / r for r in DEFAULT_ROOTS]
+    rule_objs = rules if rules is not None else all_rules()
+    baseline = Baseline.load(
+        baseline_path if baseline_path is not None else DEFAULT_BASELINE)
+    # cached findings are only valid for the FULL registry: a rules=
+    # subset run must never seed entries a later full run would trust
+    use_cache = use_cache and rules is None
+    cache = AnalysisCache(
+        (cache_path if cache_path is not None else DEFAULT_CACHE)
+        if use_cache else None,
+        analyzer_version())
+    overrides = overrides or {}
+
+    result = Result()
+    scanned = set()
+    for path in iter_py_files(roots):
+        display = _display(path, root)
+        if display in scanned:
+            continue  # overlapping roots must not double-report findings
+        scanned.add(display)
+        result.n_files += 1
+        if display in overrides:
+            findings = analyze_text(path, overrides[display],
+                                    display=display, rules=rule_objs)
+        else:
+            try:
+                text = path.read_text()
+            except UnicodeDecodeError as e:
+                result.findings.append(Finding(
+                    display, 0, "E902", f"not valid UTF-8: {e.reason}"))
+                continue
+            digest = text_digest(text)
+            findings = cache.get(display, digest) if use_cache else None
+            if findings is None:
+                findings = analyze_text(path, text, display=display,
+                                        rules=rule_objs)
+                cache.put(display, digest, findings)
+        for f in findings:
+            (result.baselined if baseline.matches(f)
+             else result.findings).append(f)
+    if use_cache and not overrides:
+        cache.save()
+    result.cache_hits = cache.hits
+    # stale = the entry's file was scanned and produced no matching
+    # finding, OR the file is gone entirely (deleted/renamed); a file
+    # merely outside this run's roots is not evidence either way
+    result.stale_baseline = [
+        e for e in baseline.stale_entries()
+        if e["file"] in scanned or not (root / e["file"]).exists()]
+    result.duration_s = time.perf_counter() - t0
+    return result
+
+
+def write_report(result: Result, out_path) -> None:
+    Path(out_path).write_text(json.dumps(result.to_json(), indent=2) + "\n")

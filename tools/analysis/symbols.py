@@ -1,0 +1,222 @@
+"""Shared symbol-resolution pass.
+
+The single-file checker this package replaced matched raw attribute names,
+which made every rule regex-grade: ``st = state`` hid a rollback-unsafe
+write, ``from jax import jit as J`` hid a jit decoration, and any class
+with a ``_cache`` attribute tripped the shuffle-cache rule.  This pass
+gives rules three resolutions:
+
+* **dotted names** — ``resolve(node)`` expands a Name/Attribute chain
+  through the file's import table (``import jax`` / ``from jax import jit
+  as J`` / ``from consensus_specs_tpu.ops import shuffle``), so a rule can
+  ask "is this call jax.jit?" regardless of spelling;
+* **scope aliases** — per function, ``scope_info`` tracks plain
+  rebindings (``st = state``) down to their root name, plus value origins
+  (``perm = compute_shuffle_permutation(...)`` marks ``perm`` — and
+  derived names like ``row = perm[i]`` — as produced by a registered cache
+  so mutations can be flagged);
+* **structure** — parent links, the enclosing-function chain, and all
+  function definitions by name (for "this function is passed to
+  jax.jit" marking).
+
+Relative imports resolve to a leading-dot form (``from . import shuffle``
+-> ``.shuffle``); ``module_matches`` treats dotted suffixes as equal so
+rules work for both absolute and relative spellings.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def walk_scope(scope_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function (or module) body WITHOUT descending into nested
+    function definitions — their bindings belong to their own scope."""
+    stack = list(ast.iter_child_nodes(scope_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def module_matches(resolved: Optional[str], module: str) -> bool:
+    """True when ``resolved`` names ``module`` up to package prefixes
+    (``shuffle`` vs ``consensus_specs_tpu.ops.shuffle``)."""
+    if not resolved:
+        return False
+    r = resolved.lstrip(".")
+    return r == module or module.endswith("." + r) or r.endswith("." + module)
+
+
+def name_matches(resolved: Optional[str], names) -> bool:
+    """True when the last dotted component of ``resolved`` is in ``names``."""
+    return bool(resolved) and resolved.lstrip(".").rsplit(".", 1)[-1] in names
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """The base Name of an Attribute/Subscript chain (``a.b[c].d`` -> a)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def written_targets(node: ast.AST):
+    """The expressions a statement writes through, as ``(kind, expr,
+    method)`` tuples — the one write-shape decomposition every mutation
+    rule (FC01/CC01/RB01) shares, so a new write form lands in all of
+    them at once.
+
+    kinds: ``assign`` / ``augassign`` / ``annassign`` (``expr`` is the
+    target; bare annotations declare and are omitted), ``delete``, and
+    ``method`` (``expr`` is the receiver, ``method`` the attribute name —
+    the caller decides which method names mutate in its domain).
+    """
+    if isinstance(node, ast.Assign):
+        return [("assign", t, None) for t in node.targets]
+    if isinstance(node, ast.AugAssign):
+        return [("augassign", node.target, None)]
+    if isinstance(node, ast.AnnAssign):
+        if node.value is None:
+            return []
+        return [("annassign", node.target, None)]
+    if isinstance(node, ast.Delete):
+        return [("delete", t, None) for t in node.targets]
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return [("method", node.func.value, node.func.attr)]
+    return []
+
+
+class ScopeInfo:
+    """Alias/origin facts for one function (or the module body)."""
+
+    def __init__(self, scope_node: ast.AST, table: "SymbolTable"):
+        self.params: Set[str] = set()
+        self.assigned: Set[str] = set()
+        self.aliases: Dict[str, str] = {}   # name -> immediate source name
+        self.origins: Dict[str, str] = {}   # name -> dotted producer call
+        if isinstance(scope_node, _FUNC_NODES):
+            a = scope_node.args
+            for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+                self.params.add(arg.arg)
+            for arg in (a.vararg, a.kwarg):
+                if arg is not None:
+                    self.params.add(arg.arg)
+        for node in walk_scope(scope_node):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                self.assigned.add(node.id)  # any binding form (for/with/...)
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            self.assigned.add(t.id)
+            v = node.value
+            if isinstance(v, ast.Name):
+                self.aliases[t.id] = v.id
+            elif isinstance(v, ast.Call):
+                dotted = table.resolve(v.func)
+                if dotted:
+                    self.origins[t.id] = dotted
+            elif isinstance(v, (ast.Subscript, ast.Attribute)):
+                base = root_name(v)
+                if base:  # derived view of another name: share its origin
+                    self.aliases[t.id] = base
+
+    def resolve_root(self, name: str) -> str:
+        """Follow plain rebinding chains to the earliest source name."""
+        seen = set()
+        while name in self.aliases and name not in seen:
+            seen.add(name)
+            name = self.aliases[name]
+        return name
+
+    def origin_of(self, name: str) -> Optional[str]:
+        """Dotted producer whose return value ``name`` (or a view derived
+        from it) holds, if any."""
+        return self.origins.get(self.resolve_root(name))
+
+
+class SymbolTable:
+    """Per-file symbol facts shared by every rule."""
+
+    def __init__(self, tree: Optional[ast.AST]):
+        self.tree = tree
+        self.imports: Dict[str, str] = {}
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        self.functions: Dict[str, List[ast.AST]] = {}
+        self._scopes: Dict[ast.AST, ScopeInfo] = {}
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:  # ``import a.b as c`` binds c = a.b
+                        self.imports[alias.asname] = alias.name
+                    else:  # ``import a.b`` binds only the root package ``a``
+                        top = alias.name.split(".")[0]
+                        self.imports[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                mod = ("." * node.level) + (node.module or "")
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports[local] = f"{mod}.{alias.name}" if mod else alias.name
+            elif isinstance(node, _FUNC_NODES):
+                self.functions.setdefault(node.name, []).append(node)
+
+    # -- dotted resolution ---------------------------------------------------
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with imports expanded
+        (``jnp.sum`` -> ``jax.numpy.sum``); None for other expressions."""
+        chain: List[str] = []
+        while isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.imports.get(node.id, node.id)
+        chain.append(base)
+        return ".".join(reversed(chain))
+
+    # -- structure -----------------------------------------------------------
+
+    def enclosing_functions(self, node: ast.AST) -> Iterator[ast.AST]:
+        """Innermost-out chain of function definitions containing ``node``."""
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                yield cur
+            cur = self.parent.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        return next(self.enclosing_functions(node), None)
+
+    def scope_info(self, scope_node: Optional[ast.AST]) -> ScopeInfo:
+        """Alias/origin facts for a function (or the module body when
+        ``scope_node`` is None)."""
+        key = scope_node if scope_node is not None else self.tree
+        info = self._scopes.get(key)
+        if info is None:
+            info = self._scopes[key] = ScopeInfo(key, self)
+        return info
+
+    def scope_of(self, node: ast.AST) -> ScopeInfo:
+        return self.scope_info(self.enclosing_function(node))
+
+    def calls_function(self, scope_node: ast.AST, names) -> bool:
+        """True when ``scope_node``'s own body (nested defs excluded from
+        the pairing, included if inline) calls any function in ``names``."""
+        for node in ast.walk(scope_node):
+            if isinstance(node, ast.Call) and name_matches(
+                    self.resolve(node.func), names):
+                return True
+        return False

@@ -1,215 +1,73 @@
-"""From-scratch lint checker (reference capability: `linter.ini` flake8
-config + `make lint`, /root/reference/Makefile:140-147).
+"""Thin CLI over the ``tools/analysis`` semantic analyzer (reference
+capability: `linter.ini` flake8 config + `make lint`,
+/root/reference/Makefile:140-147 — the image ships no flake8/ruff and
+installs are barred).
 
-The image ships no flake8/ruff and installs are barred, so this is a
-minimal AST-based checker enforcing the same hygiene class the reference
-CI does:
-
-  F401  unused import
-  E501  line too long (>120, matching the reference's flake8 max)
-  E999  syntax error
-  W291  trailing whitespace
-  W191  tab indentation
-  B001  bare except
-  FC01  direct store.latest_messages mutation outside specs/ + forkchoice/
-  ST01  per-item bls.Verify/FastAggregateVerify loop outside specs/ + crypto/
-
-Spec-source files (`specs/src/*.py`) are exempt from E501: their bodies
-are pinned AST-for-AST to the reference markdown and must not be
-rewrapped.  FC01 is a project rule, not a flake8 one: the spec ``Store``
-and the proto-array engine each hold a latest-message view, and they stay
-in lockstep only if every write goes through the spec handlers or
-``forkchoice/batch.py`` — a stray ``store.latest_messages[i] = ...``
-anywhere else silently desynchronizes the two vote stores.  Usage:
-python tools/lint.py [paths...]; exit 1 on findings.
+All checking lives in ``tools/analysis/``: a rule-plugin registry
+(hygiene codes E501/E999/W191/W291/W605/F401/B001/B006 plus the
+engine-invariant rules FC01/ST01/CC01/RB01/JX01/DT01), per-code
+``# noqa`` suppression, a reviewed baseline for grandfathered findings
+(tools/analysis/baseline.json), and a content-hash incremental cache.
+This wrapper keeps the historical interface: ``python tools/lint.py
+[paths...]`` prints ``path:line: CODE message`` rows plus a summary line
+and exits 1 on unbaselined findings; ``--json OUT`` additionally writes
+the full report (``make analyze`` -> ANALYSIS.json).  ``check_file`` /
+``iter_py_files`` remain importable for scripts that drove the legacy
+checker.
 """
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-MAX_LINE = 120
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from analysis import runner as _runner  # noqa: E402
+
+iter_py_files = _runner.iter_py_files
 
 
-def iter_py_files(roots):
-    for root in roots:
-        p = Path(root)
-        if p.is_file() and p.suffix == ".py":
-            yield p
-        elif p.is_dir():
-            for f in sorted(p.rglob("*.py")):
-                if ".cache" not in f.parts:
-                    yield f
-
-
-class ImportUseChecker(ast.NodeVisitor):
-    """Collect imported names and every name usage; unused = F401."""
-
-    def __init__(self):
-        self.imports = {}  # name -> (lineno, display)
-        self.used = set()
-
-    def visit_Import(self, node):
-        for alias in node.names:
-            name = alias.asname or alias.name.split(".")[0]
-            self.imports[name] = (node.lineno, alias.name)
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":
-            return
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            name = alias.asname or alias.name
-            self.imports[name] = (node.lineno, alias.name)
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-
-def check_file(path: Path) -> list:
-    findings = []
-    try:
-        text = path.read_text()
-    except UnicodeDecodeError as e:
-        return [(path, 0, f"E902 not valid UTF-8: {e.reason}")]
-    lines = text.splitlines()
-    is_spec_src = "specs/src" in str(path)
-    noqa_lines = {i for i, line in enumerate(lines, 1) if "# noqa" in line}
-
-    for i, line in enumerate(lines, 1):
-        if i in noqa_lines:
-            continue
-        if not is_spec_src and len(line) > MAX_LINE:
-            findings.append((path, i, f"E501 line too long ({len(line)} > {MAX_LINE})"))
-        if line != line.rstrip() and line.strip():
-            findings.append((path, i, "W291 trailing whitespace"))
-        if line.startswith("\t"):
-            findings.append((path, i, "W191 tab indentation"))
-
-    try:
-        tree = ast.parse(text, filename=str(path))
-    except SyntaxError as e:
-        findings.append((path, e.lineno or 0, f"E999 syntax error: {e.msg}"))
-        return findings
-
-    checker = ImportUseChecker()
-    checker.visit(tree)
-    # package __init__ imports are re-exports (the public API surface);
-    # same as flake8 per-file-ignores = __init__.py:F401
-    if path.name == "__init__.py":
-        checker.imports = {}
-    # names referenced in module docstring-level __all__ or via string
-    # annotations count as used if they appear anywhere in the source text
-    for name, (lineno, display) in checker.imports.items():
-        if name in checker.used or name.startswith("_") or lineno in noqa_lines:
-            continue
-        # whole-word occurrence elsewhere (in __all__, a docstring doctest,
-        # or a string annotation) counts as a use; substrings do not
-        occurrences = len(re.findall(rf"\b{re.escape(name)}\b", text))
-        if occurrences <= 1:
-            findings.append((path, lineno, f"F401 '{display}' imported but unused"))
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            if node.lineno not in noqa_lines:
-                findings.append((path, node.lineno, "B001 bare except"))
-
-    parts = Path(path).parts
-    if "specs" not in parts and "forkchoice" not in parts:
-        for lineno in _latest_messages_mutations(tree):
-            if lineno not in noqa_lines:
-                findings.append((path, lineno,
-                                 "FC01 direct store.latest_messages mutation "
-                                 "(route through spec handlers or "
-                                 "forkchoice/batch.py)"))
-
-    if "specs" not in parts and "crypto" not in parts:
-        for lineno in sorted(set(_per_item_verify_loops(tree))):
-            if lineno not in noqa_lines:
-                findings.append((path, lineno,
-                                 "ST01 per-item bls verification in a loop "
-                                 "(batch via stf/verify.py or the facade's "
-                                 "deferred scope)"))
-
-    return findings
-
-
-_MUTATING_DICT_METHODS = {"update", "pop", "popitem", "clear", "setdefault",
-                          "__setitem__", "__delitem__"}
-
-
-def _is_latest_messages(expr) -> bool:
-    return isinstance(expr, ast.Attribute) and expr.attr == "latest_messages"
-
-
-def _latest_messages_mutations(tree):
-    """Line numbers of writes into a ``.latest_messages`` mapping: subscript
-    assignment / augmented assignment / deletion, mutating dict-method
-    calls, and rebinding the attribute itself."""
-    for node in ast.walk(tree):
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-        elif isinstance(node, ast.AugAssign):
-            targets = [node.target]
-        elif isinstance(node, ast.AnnAssign):
-            if node.value is not None:  # bare annotations declare, not write
-                targets = [node.target]
-        elif isinstance(node, ast.Delete):
-            targets = node.targets
-        for t in targets:
-            if isinstance(t, ast.Subscript) and _is_latest_messages(t.value):
-                yield node.lineno
-            elif _is_latest_messages(t):
-                yield node.lineno
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-            if (node.func.attr in _MUTATING_DICT_METHODS
-                    and _is_latest_messages(node.func.value)):
-                yield node.lineno
-
-
-_PER_ITEM_VERIFY_FNS = {"Verify", "FastAggregateVerify"}
-_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While,
-               ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
-
-
-def _per_item_verify_loops(tree):
-    """Line numbers of ``bls.Verify`` / ``bls.FastAggregateVerify`` calls
-    issued inside a loop or comprehension: the one-pairing-at-a-time
-    pattern the batched block engine exists to delete.  One batched
-    multi-pairing (``BatchFastAggregateVerify`` via ``stf/verify.py`` or
-    the facade's deferred scope) settles the whole set with a single
-    shared final exponentiation.  Spec sources keep the reference's
-    sequential shape and ``crypto/`` implements both paths, so both are
-    exempt; measurement baselines mark themselves ``# noqa``."""
-    for loop in ast.walk(tree):
-        if not isinstance(loop, _LOOP_NODES):
-            continue
-        for node in ast.walk(loop):
-            if node is loop:
-                continue
-            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-                if node.func.attr in _PER_ITEM_VERIFY_FNS:
-                    yield node.lineno
+def check_file(path) -> list:
+    """Legacy single-file API: [(path, lineno, "CODE message"), ...]
+    (noqa applied, baseline NOT applied — same contract as the old
+    checker)."""
+    findings = _runner.analyze_file(path)
+    return [(Path(path), f.line, f"{f.code} {f.message}") for f in findings]
 
 
 def main(argv):
-    roots = argv or ["consensus_specs_tpu", "tests", "tools", "bench.py", "__graft_entry__.py"]
-    all_findings = []
-    n_files = 0
-    for f in iter_py_files(roots):
-        n_files += 1
-        all_findings.extend(check_file(f))
-    for path, lineno, msg in all_findings:
-        print(f"{path}:{lineno}: {msg}")
-    print(f"lint: {n_files} files checked, {len(all_findings)} findings")
-    return 1 if all_findings else 0
+    args = list(argv)
+    json_out = None
+    if "--json" in args:
+        i = args.index("--json")
+        try:
+            json_out = args[i + 1]
+        except IndexError:
+            print("usage: lint.py [--json OUT.json] [paths...]")
+            return 2
+        del args[i:i + 2]
+    no_cache = "--no-cache" in args
+    if no_cache:
+        args.remove("--no-cache")
+
+    result = _runner.run(
+        [Path(a) for a in args] if args else None,
+        use_cache=not no_cache)
+    for f in result.findings:
+        print(f.render())
+    extra = ""
+    if result.baselined:
+        extra += f", {len(result.baselined)} baselined"
+    if result.stale_baseline:
+        extra += f", {len(result.stale_baseline)} STALE baseline entries"
+        for e in result.stale_baseline:
+            print(f"stale baseline entry (fixed? remove it): "
+                  f"{e['file']}: {e['code']} {e['snippet']!r}")
+    print(f"lint: {result.n_files} files checked, "
+          f"{len(result.findings)} findings{extra}")
+    if json_out:
+        _runner.write_report(result, json_out)
+    return 1 if (result.findings or result.stale_baseline) else 0
 
 
 if __name__ == "__main__":
