@@ -12,10 +12,15 @@ Layers (see docs/architecture.md, "The block path"):
   permutation, bulk counts via ``ops/segment.py``, registry affine matrix;
 * ``verify``       — per-block signature batch: preflattened
   ``BatchFastAggregateVerify`` entries, verified-triple memo, bisection;
+* ``sync``         — altair-lineage sync aggregates: seat rows memoized
+  per sync period, the signature folded into the block batch, rewards as
+  net per-validator deltas;
 * ``slot_roots``   — spec-identical ``process_slots`` with dirty bulk
   subtrees routed through the resident merkle path;
 * ``engine``       — the optimistic fast path + exact-spec replay
-  fallback that makes failure behavior literally the spec's.
+  fallback that makes failure behavior literally the spec's
+  (fork families: phase0, and altair/bellatrix with the execution
+  payload run literally inside the snapshot region).
 """
 from .attestations import FastPathViolation
 from .engine import apply_signed_blocks, reset_stats, stats

@@ -48,8 +48,8 @@ _CTX_LOOKUP: dict = {}
 _CACHE_MAX = 8
 
 
-def _fifo_put(cache: dict, key, value):
-    if len(cache) >= _CACHE_MAX:
+def _fifo_put(cache: dict, key, value, cap: int = _CACHE_MAX):
+    if len(cache) >= cap:
         cache.pop(next(iter(cache)))
     cache[key] = value
     return value
@@ -93,6 +93,15 @@ class _CommitteeContext:
         return self.active[self.perm[lo:hi]]
 
 
+def _spec_geometry_key(spec) -> tuple:
+    """The spec constants the committee computation reads — every memo
+    key below must bind them (CC02): two spec builds sharing registry and
+    randao roots but differing in preset geometry must never share a
+    context."""
+    return (int(spec.SLOTS_PER_EPOCH), int(spec.MAX_COMMITTEES_PER_SLOT),
+            int(spec.TARGET_COMMITTEE_SIZE), int(spec.SHUFFLE_ROUND_COUNT))
+
+
 def committee_context(spec, state, epoch: int) -> _CommitteeContext:
     """Cached committee geometry.  The context itself is keyed on registry
     root + attester seed (the full input set of the spec's committee
@@ -103,13 +112,14 @@ def committee_context(spec, state, epoch: int) -> _CommitteeContext:
         bytes(state.validators.hash_tree_root()),
         bytes(state.randao_mixes.hash_tree_root()),
         int(epoch),
+        _spec_geometry_key(spec),
     )
     ctx = _CTX_LOOKUP.get(lookup_key)
     if ctx is not None:
         return ctx
     seed = bytes(spec.get_seed(
         state, spec.Epoch(epoch), spec.DOMAIN_BEACON_ATTESTER))
-    key = (lookup_key[0], int(epoch), seed)
+    key = (lookup_key[0], int(epoch), seed, _spec_geometry_key(spec))
     ctx = _CTX_CACHE.get(key)
     if ctx is None:
         ctx = _fifo_put(
@@ -133,7 +143,8 @@ def beacon_proposer_index(spec, state):
     seed = bytes(spec.hash(
         spec.get_seed(state, epoch, spec.DOMAIN_BEACON_PROPOSER)
         + spec.uint_to_bytes(spec.uint64(state.slot))))
-    key = (bytes(state.validators.hash_tree_root()), seed)
+    key = (bytes(state.validators.hash_tree_root()), seed,
+           _spec_geometry_key(spec), int(spec.MAX_EFFECTIVE_BALANCE))
     hit = _PROPOSER_CACHE.get(key)
     if hit is not None:
         return hit
@@ -193,13 +204,17 @@ def affine_matrix(validators) -> dict:
 
 def reset_caches() -> None:
     """Drop every derived-geometry cache (committee contexts, active sets,
-    proposer walks, affine matrices) plus the native decompression cache —
-    bench cold-start control and test isolation."""
+    proposer walks, affine matrices, sync-committee seat rows) plus the
+    native decompression cache — bench cold-start control and test
+    isolation."""
+    from . import sync
+
     _ACTIVE_CACHE.clear()
     _CTX_CACHE.clear()
     _CTX_LOOKUP.clear()
     _PROPOSER_CACHE.clear()
     _AFFINE_MATRIX_CACHE._store.clear()
+    sync.reset_caches()
     try:
         from consensus_specs_tpu.crypto.bls import native
 

@@ -33,7 +33,7 @@ import time
 
 from consensus_specs_tpu import tracing
 
-from . import slot_roots, verify
+from . import slot_roots, sync, verify
 from .attestations import (
     FastPathViolation,
     affine_rows,
@@ -42,12 +42,20 @@ from .attestations import (
     resolve_block_attestations,
 )
 
+# the fork families the fast path covers: phase0's pending-attestation
+# shape, and the altair lineage (participation flags + sync aggregates;
+# bellatrix adds the execution payload, run literally in the snapshot
+# region).  capella+ (withdrawals, bls_to_execution_changes) replay
+# through the literal spec until the engine grows those operations.
+FAST_FORKS = ("phase0", "altair", "bellatrix")
+
 stats = {
     "fast_blocks": 0,
     "replayed_blocks": 0,
     "fast_path_errors": 0,
     "sig_verify_s": 0.0,
     "attestation_apply_s": 0.0,
+    "sync_apply_s": 0.0,
     "slot_roots_s": 0.0,
     "other_s": 0.0,
 }
@@ -59,8 +67,7 @@ def reset_stats() -> None:
     bench rows can't accidentally report cumulative halves)."""
     for k in stats:
         stats[k] = 0.0 if isinstance(stats[k], float) else 0
-    for k in verify.stats:
-        verify.stats[k] = 0
+    verify.reset_stats()
 
 
 def _native_available() -> bool:
@@ -84,11 +91,11 @@ def apply_signed_blocks(spec, state, signed_blocks, validate_result: bool = True
 def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
     pre_backing = state.get_backing()
     try:
-        if getattr(spec, "fork", None) != "phase0" or not _native_available():
-            # later forks keep their own kernel substitutions + the
-            # facade's deferred per-block batch; the fast path below is
-            # the phase0 shape (ROADMAP follow-up: altair lineage)
-            raise FastPathViolation("fast path covers phase0 + native BLS")
+        if getattr(spec, "fork", None) not in FAST_FORKS or not _native_available():
+            # uncovered forks keep their own kernel substitutions + the
+            # facade's deferred per-block batch
+            raise FastPathViolation(
+                "fast path covers phase0/altair/bellatrix + native BLS")
         _fast_transition(spec, state, signed_block, validate_result)
         stats["fast_blocks"] += 1
         tracing.count("stf.fast_block")
@@ -105,6 +112,7 @@ def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
     from consensus_specs_tpu.crypto import bls
 
     block = signed_block.message
+    altair_lineage = spec.fork != "phase0"
     t0 = time.perf_counter()
     slot_roots.process_slots(spec, state, block.slot)
     t1 = time.perf_counter()
@@ -124,10 +132,16 @@ def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
         _proposer_entry(spec, state, signed_block, collect)
     t2 = time.perf_counter()
 
-    # process_block, phase0 shape (phase0.py:1149-1154): header/RANDAO/
-    # attestations run the vectorized or collect-don't-verify variants
+    # process_block shape of the block's own fork (phase0.py:1149-1154,
+    # altair.py:405-410, bellatrix.py:242-249): header/RANDAO/attestations/
+    # sync aggregate run the vectorized or collect-don't-verify variants
     # below; the remaining operations are the spec's own functions
     _header(spec, state, block)
+    if spec.fork == "bellatrix" and spec.is_execution_enabled(state, block.body):
+        # [New in Bellatrix] — literal, inside the snapshot-protected
+        # region: payload checks raise straight into the replay contract
+        spec.process_execution_payload(
+            state, block.body.execution_payload, spec.EXECUTION_ENGINE)
     _randao_collect(spec, state, block.body, collect, bls_on)
     spec.process_eth1_data(state, block.body)
     t3 = time.perf_counter()
@@ -135,9 +149,14 @@ def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
     # operations (slashings, deposits, exits) belong to other_s so a
     # regression in e.g. process_deposit localizes honestly
     apply_before = stats["attestation_apply_s"]
-    _operations(spec, state, block.body, collect, bls_on)
+    _operations(spec, state, block.body, collect, bls_on, altair_lineage)
     t4 = time.perf_counter()
     non_attestation_ops = (t4 - t3) - (stats["attestation_apply_s"] - apply_before)
+    if altair_lineage:
+        sync.process_sync_aggregate(
+            spec, state, block.body.sync_aggregate, collect, bls_on)
+    t4s = time.perf_counter()
+    stats["sync_apply_s"] += t4s - t4
 
     bad = verify.settle(entries, keys)
     if bad is not None:
@@ -147,7 +166,7 @@ def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
         if bytes(block.state_root) != bytes(slot_roots.state_root(spec, state)):
             raise FastPathViolation("state root mismatch")
     t6 = time.perf_counter()
-    stats["sig_verify_s"] += (t2 - t1) + (t5 - t4)
+    stats["sig_verify_s"] += (t2 - t1) + (t5 - t4s)
     stats["other_s"] += (t3 - t2) + non_attestation_ops + (t6 - t5)
 
 
@@ -205,9 +224,10 @@ def _randao_collect(spec, state, body, collect, bls_on) -> None:
     state.randao_mixes[epoch % spec.EPOCHS_PER_HISTORICAL_VECTOR] = mix
 
 
-def _operations(spec, state, body, collect, bls_on) -> None:
-    """process_operations (phase0.py:1196-1208) with the attestation loop
-    replaced by the whole-block vectorized path."""
+def _operations(spec, state, body, collect, bls_on, altair_lineage) -> None:
+    """process_operations (phase0.py:1196-1208; altair inherits the same
+    dispatch shape) with the attestation loop replaced by the whole-block
+    vectorized path of the block's fork family."""
     assert len(body.deposits) == min(
         spec.MAX_DEPOSITS,
         state.eth1_data.deposit_count - state.eth1_deposit_index)
@@ -216,23 +236,30 @@ def _operations(spec, state, body, collect, bls_on) -> None:
         spec.process_proposer_slashing(state, operation)
     for operation in body.attester_slashings:
         spec.process_attester_slashing(state, operation)
-    _attestations(spec, state, body.attestations, collect, bls_on)
+    _attestations(spec, state, body.attestations, collect, bls_on,
+                  altair_lineage)
     for operation in body.deposits:
         spec.process_deposit(state, operation)
     for operation in body.voluntary_exits:
         spec.process_voluntary_exit(state, operation)
 
 
-def _attestations(spec, state, attestations, collect, bls_on) -> None:
-    """The block's process_attestation loop (phase0.py:1249-1275),
-    vectorized: one resolution pass, one bulk attester-set reduction, then
-    the spec-mandated pending-attestation appends and one signature entry
-    per aggregate."""
+def _attestations(spec, state, attestations, collect, bls_on,
+                  altair_lineage) -> None:
+    """The block's process_attestation loop, vectorized: one resolution
+    pass, one bulk attester-set reduction, then the fork family's state
+    application — pending-attestation appends (phase0.py:1249-1275) or
+    participation-flag scatter (altair.py:413-446) — and one signature
+    entry per aggregate."""
     if len(attestations) == 0:
         return
     t0 = time.perf_counter()
     try:
-        _attestations_inner(spec, state, attestations, collect, bls_on)
+        if altair_lineage:
+            _attestations_inner_altair(spec, state, attestations, collect,
+                                       bls_on)
+        else:
+            _attestations_inner(spec, state, attestations, collect, bls_on)
     finally:
         stats["attestation_apply_s"] += time.perf_counter() - t0
 
@@ -273,3 +300,120 @@ def _attestations_inner(spec, state, attestations, collect, bls_on) -> None:
             collect(registry_root + attesters.tobytes(), len(attesters),
                     lambda a=attesters: affine_rows(validators, a),
                     bytes(signing_root), bytes(att.signature))
+
+
+def _participation_flag_mask(spec, state, resolver, data, is_current) -> int:
+    """``get_attestation_participation_flag_indices`` (altair.py:303-330)
+    as a bit mask, with the spec's ``assert is_matching_source`` mapped to
+    the replay contract.  The matching-target/head short-circuits are
+    preserved so ``get_block_root*`` raises exactly when the spec's
+    would."""
+    justified = (state.current_justified_checkpoint if is_current
+                 else state.previous_justified_checkpoint)
+    if data.source != justified:
+        raise FastPathViolation("source != justified checkpoint")
+    inclusion_delay = resolver.state_slot - int(data.slot)
+    is_matching_target = bytes(data.target.root) == bytes(
+        spec.get_block_root(state, data.target.epoch))
+    is_matching_head = is_matching_target and bytes(
+        data.beacon_block_root) == bytes(
+        spec.get_block_root_at_slot(state, data.slot))
+    mask = 0
+    if inclusion_delay <= int(spec.integer_squareroot(spec.SLOTS_PER_EPOCH)):
+        mask |= 1 << int(spec.TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= int(spec.SLOTS_PER_EPOCH):
+        mask |= 1 << int(spec.TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == int(
+            spec.MIN_ATTESTATION_INCLUSION_DELAY):
+        mask |= 1 << int(spec.TIMELY_HEAD_FLAG_INDEX)
+    return mask
+
+
+def _attestations_inner_altair(spec, state, attestations, collect, bls_on) -> None:
+    """The altair-lineage process_attestation loop (altair.py:413-446),
+    vectorized: the same whole-block resolution as phase0, then per
+    attestation a participation-flag OR-scatter on a numpy mirror of the
+    epoch participation column, the proposer-reward numerator as one
+    masked increment sum per newly-set flag, and one signature entry per
+    aggregate.  Mirrors flush as ONE packed write per dirtied column and
+    the proposer reward lands as one balance write (per-attestation floor
+    division preserved — the spec divides before each increase)."""
+    import numpy as np
+
+    from consensus_specs_tpu.ops.epoch_jax import registry_columns
+    from consensus_specs_tpu.ssz import bulk
+
+    resolver = resolve_block_attestations(spec, state)
+    resolved = resolver.resolve(attestations)
+    index_sets = attesting_index_sets(resolved)
+    tracing.count("stf.attestations", len(index_sets))
+
+    proposer_index = beacon_proposer_index(spec, state)
+    current_epoch = resolver.current_epoch
+    validators = state.validators
+    registry_root = bytes(validators.hash_tree_root())
+
+    # participation mirrors: read lazily once per block, written back once
+    # per dirtied column after the loop (deposits append only later in
+    # process_operations, so the column length is stable here)
+    columns = {}
+
+    def column_for(is_current):
+        col = columns.get(is_current)
+        if col is None:
+            view = (state.current_epoch_participation if is_current
+                    else state.previous_epoch_participation)
+            col = columns[is_current] = bulk.packed_uint8_to_numpy(view)
+        return col
+
+    # exact get_base_reward column: effective // increment * per-increment
+    # (both constant within a block — effective balances only move at the
+    # epoch boundary)
+    increments = (registry_columns(state)["effective_balance"]
+                  // int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    per_increment = int(spec.get_base_reward_per_increment(state))
+    weights = [int(w) for w in spec.PARTICIPATION_FLAG_WEIGHTS]
+    weight_denominator = int(spec.WEIGHT_DENOMINATOR)
+    proposer_weight = int(spec.PROPOSER_WEIGHT)
+    denominator = ((weight_denominator - proposer_weight)
+                   * weight_denominator // proposer_weight)
+    proposer_reward_total = 0
+
+    for att, attesters in zip(attestations, index_sets):
+        data = att.data
+        is_current = int(data.target.epoch) == current_epoch
+        mask = _participation_flag_mask(spec, state, resolver, data, is_current)
+        column = column_for(is_current)
+        held = column[attesters]
+        numerator = 0
+        for flag_index, weight in enumerate(weights):
+            bit = 1 << flag_index
+            if not mask & bit:
+                continue
+            newly = attesters[(held & bit) == 0]
+            if len(newly):
+                numerator += int(
+                    np.sum(increments[newly], dtype=np.uint64)) * weight
+        column[attesters] = held | np.uint8(mask)
+        # the spec floors the division per attestation, then increases the
+        # proposer balance; summing the floored rewards is exact
+        proposer_reward_total += numerator * per_increment // denominator
+        if bls_on:
+            signing_root = spec.compute_signing_root(
+                data, spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                                      data.target.epoch))
+            collect(registry_root + attesters.tobytes(), len(attesters),
+                    lambda a=attesters: affine_rows(validators, a),
+                    bytes(signing_root), bytes(att.signature))
+
+    if True in columns:
+        bulk.set_packed_uint8_from_numpy(
+            state.current_epoch_participation, columns[True])
+    if False in columns:
+        bulk.set_packed_uint8_from_numpy(
+            state.previous_epoch_participation, columns[False])
+    if proposer_reward_total:
+        # Gwei() raises on uint64 overflow exactly where the spec's
+        # sequential += would have (increments are non-negative)
+        state.balances[proposer_index] = spec.Gwei(
+            int(state.balances[proposer_index]) + proposer_reward_total)

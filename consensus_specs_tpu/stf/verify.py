@@ -34,15 +34,25 @@ from consensus_specs_tpu import tracing
 # (count, flat affine members, message, signature): one pairing equation
 SigEntry = Tuple[int, bytes, bytes, bytes]
 
+_VERIFIED_MEMO: dict = {}
+_VERIFIED_MEMO_MAX = 1 << 16
+
 stats = {
     "batches": 0,
     "entries": 0,
     "memo_hits": 0,
     "bisections": 0,
+    "memo_evictions": 0,
+    "memo_cap": _VERIFIED_MEMO_MAX,
 }
 
-_VERIFIED_MEMO: dict = {}
-_VERIFIED_MEMO_MAX = 1 << 16
+
+def reset_stats() -> None:
+    """Zero the settlement counters (``memo_cap`` is a constant readout,
+    not a counter — it survives the reset)."""
+    for k in stats:
+        stats[k] = 0
+    stats["memo_cap"] = _VERIFIED_MEMO_MAX
 
 
 def triple_key(members_id: bytes, message: bytes, signature: bytes) -> bytes:
@@ -111,11 +121,23 @@ def settle(entries: List[SigEntry], keys: List[bytes],
     bad = first_invalid(entries, seed=seed)
     if bad is not None:
         return bad
-    if len(_VERIFIED_MEMO) + len(keys) > _VERIFIED_MEMO_MAX:
-        _VERIFIED_MEMO.clear()
     for k in keys:
-        _VERIFIED_MEMO[k] = True
+        _memo_put(k)
     return None
+
+
+def _memo_put(key: bytes) -> None:
+    """Insert one settled triple, bounding the memo at
+    ``_VERIFIED_MEMO_MAX`` with FIFO eviction (dicts iterate in insertion
+    order) — a long multi-epoch replay sheds its oldest triples instead of
+    growing without limit, and the blocks re-carrying recent aggregates
+    still hit.  Evictions are counted in ``stats`` next to the cap."""
+    if key in _VERIFIED_MEMO:
+        return
+    while len(_VERIFIED_MEMO) >= _VERIFIED_MEMO_MAX:
+        _VERIFIED_MEMO.pop(next(iter(_VERIFIED_MEMO)))
+        stats["memo_evictions"] += 1
+    _VERIFIED_MEMO[key] = True
 
 
 def reset_memo() -> None:

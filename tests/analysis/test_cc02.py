@@ -1,0 +1,145 @@
+"""CC02 key coverage: registered-memo lookups whose key omits a
+parameter the cached computation reads — fixtures for the canonical memo
+shape, the put-helper form, coverage through derived locals, and the
+skip conditions (no insertion in scope, non-owner files, builder-form
+RootKeyedCache gets)."""
+from analysis import REPO_ROOT, analyze_text, run
+
+
+def cc02(path, src):
+    return [f for f in analyze_text(path, src) if f.code == "CC02"]
+
+
+_OWNER = "consensus_specs_tpu/stf/sync.py"
+
+
+_OMITTED_PARAM = """\
+def sync_committee_rows(spec, state, period):
+    key = (bytes(state.validators.hash_tree_root()),)
+    hit = _SYNC_ROWS_CACHE.get(key)
+    if hit is not None:
+        return hit
+    rows = resolve(state, period)
+    _SYNC_ROWS_CACHE[key] = rows
+    return rows
+"""
+
+
+def test_omitted_parameter_is_flagged():
+    found = cc02(_OWNER, _OMITTED_PARAM)
+    assert len(found) == 1
+    assert "period" in found[0].message
+    assert "_SYNC_ROWS_CACHE" in found[0].message
+
+
+_PUT_HELPER = """\
+def committee_context(spec, state, epoch):
+    lookup_key = (bytes(state.validators.hash_tree_root()), int(epoch))
+    ctx = _CTX_LOOKUP.get(lookup_key)
+    if ctx is not None:
+        return ctx
+    seed = bytes(spec.get_seed(state, epoch))
+    ctx = _fifo_put(_CTX_CACHE, (lookup_key[0], seed),
+                    build_ctx(spec, state, epoch, seed))
+    return _fifo_put(_CTX_LOOKUP, lookup_key, ctx)
+"""
+
+
+def test_put_helper_insertion_is_seen():
+    """The committee-context shape that motivated the rule: the lookup
+    layer's key binds registry/randao roots and the epoch but not the
+    spec, while the stored context reads the spec's geometry."""
+    found = cc02("consensus_specs_tpu/stf/attestations.py", _PUT_HELPER)
+    assert any("_CTX_LOOKUP" in f.message and "spec" in f.message
+               for f in found), found
+
+
+_COVERED_TRANSITIVELY = """\
+def sync_committee_rows(spec, state):
+    root = bytes(state.validators.hash_tree_root())
+    geometry = (int(spec.SYNC_COMMITTEE_SIZE),)
+    key = (root, geometry)
+    hit = _SYNC_ROWS_CACHE.get(key)
+    if hit is not None:
+        return hit
+    rows = resolve(spec, state)
+    _SYNC_ROWS_CACHE[key] = rows
+    return rows
+"""
+
+
+def test_coverage_through_derived_locals():
+    """A key built from locals derived from the parameters covers them —
+    the rule follows assignment chains, not spellings."""
+    assert cc02(_OWNER, _COVERED_TRANSITIVELY) == []
+
+
+_SETDEFAULT_FORM = """\
+def sync_committee_rows(spec, state, period):
+    key = (bytes(state.hash_tree_root()),)
+    hit = _SYNC_ROWS_CACHE.get(key)
+    if hit is not None:
+        return hit
+    return _SYNC_ROWS_CACHE.setdefault(key, resolve(state, period))
+"""
+
+
+def test_setdefault_insertion_is_seen():
+    found = cc02(_OWNER, _SETDEFAULT_FORM)
+    assert len(found) == 1 and "period" in found[0].message
+
+
+_NO_INSERTION = """\
+def peek(spec, state, key):
+    return _SYNC_ROWS_CACHE.get(key)
+"""
+
+
+def test_lookup_without_insertion_is_skipped():
+    """No paired insertion in scope -> no evidence about the key/value
+    contract -> no finding (read-only probes stay legal)."""
+    assert cc02(_OWNER, _NO_INSERTION) == []
+
+
+_BUILDER_FORM = """\
+def cached_rows(state):
+    return _SYNC_ROWS_CACHE.get(state.validators, build_rows)
+"""
+
+
+def test_two_arg_builder_get_is_skipped():
+    """RootKeyedCache-style ``get(view, build)`` carries no inline key
+    expression; its keying is the view's root by construction."""
+    assert cc02(_OWNER, _BUILDER_FORM) == []
+
+
+def test_non_owner_file_is_skipped():
+    """CC02 is the owner's discipline (CC01 already polices outsiders):
+    the same source outside stf/sync.py is someone else's dict."""
+    assert cc02("consensus_specs_tpu/forkchoice/batch.py", _OMITTED_PARAM) == []
+
+
+def test_noqa_suppresses():
+    src = _OMITTED_PARAM.replace(
+        "    hit = _SYNC_ROWS_CACHE.get(key)",
+        "    hit = _SYNC_ROWS_CACHE.get(key)  # noqa: CC02")
+    assert cc02(_OWNER, src) == []
+
+
+# -- the live tree, gate-shaped ----------------------------------------------
+
+
+def test_cc02_mutation_turns_gate_red():
+    """Dropping the spec-geometry component from the committee-context
+    lookup key reintroduces exactly the staleness class the rule exists
+    for — the full gate (baseline applied) must go red."""
+    rel = "consensus_specs_tpu/stf/attestations.py"
+    path = REPO_ROOT / rel
+    text = path.read_text()
+    mutated = text.replace(
+        "        int(epoch),\n        _spec_geometry_key(spec),\n    )",
+        "        int(epoch),\n    )")
+    assert mutated != text, "mutation did not apply"
+    result = run([path], overrides={rel: mutated}, use_cache=False)
+    assert any(f.code == "CC02" and "spec" in f.message
+               for f in result.findings), [f.render() for f in result.findings]

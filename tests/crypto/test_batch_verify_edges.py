@@ -4,8 +4,12 @@ engine's bisection fallback leans on (stf/verify.py, crypto/bls/native.py).
 Covered: the vacuous empty batch, duplicate messages across items (the
 RLC scalars must keep the equations independent), a single tampered
 signature hiding inside a 128-item batch (bisection must name exactly it),
-and deterministic-seed replay (same seed -> same verdict, byte-for-byte
-reproducible batches for test vectors)."""
+deterministic-seed replay (same seed -> same verdict, byte-for-byte
+reproducible batches for test vectors), the sync-aggregate entry shape
+the altair lineage folds into the block batch (stf/sync.py: empty
+participation, duplicate seats, bisection naming the sync entry, triple-
+memo dedup of a re-carried aggregate), and the verified-triple memo's
+FIFO bound."""
 import hashlib
 
 import pytest
@@ -121,3 +125,176 @@ def test_verified_triple_memo_roundtrip(batch128):
     assert all(stf_verify.is_verified(k) for k in keys)
     stf_verify.reset_memo()
     assert not stf_verify.is_verified(keys[0])
+
+
+def test_verified_triple_memo_fifo_bound(batch128, monkeypatch):
+    """The memo is bounded: past the cap the OLDEST triples evict first
+    (FIFO), eviction count and cap are visible in stats, and a replay can
+    never grow the memo without limit."""
+    monkeypatch.setattr(stf_verify, "_VERIFIED_MEMO_MAX", 4)
+    stf_verify.reset_memo()
+    stf_verify.reset_stats()
+    assert stf_verify.stats["memo_cap"] == 4
+    entries = [_flat(*it) for it in batch128[:6]]
+    keys = [stf_verify.triple_key(e[1], e[2], e[3]) for e in entries]
+    assert stf_verify.settle(entries[:4], keys[:4]) is None
+    assert stf_verify.stats["memo_evictions"] == 0
+    assert all(stf_verify.is_verified(k) for k in keys[:4])
+    # two more distinct triples: the two oldest fall out
+    assert stf_verify.settle(entries[4:], keys[4:]) is None
+    assert stf_verify.stats["memo_evictions"] == 2
+    assert len(stf_verify._VERIFIED_MEMO) == 4
+    assert not stf_verify.is_verified(keys[0])
+    assert not stf_verify.is_verified(keys[1])
+    assert all(stf_verify.is_verified(k) for k in keys[2:])
+    # re-settling an evicted triple re-inserts without double-counting
+    assert stf_verify.settle(entries[:1], keys[:1]) is None
+    assert stf_verify.stats["memo_evictions"] == 3
+    stf_verify.reset_memo()
+    stf_verify.reset_stats()
+    assert stf_verify.stats["memo_cap"] == 4  # cap is a readout, not a counter
+
+
+# -- sync-aggregate entries (the altair lineage's addition to the batch) ------
+
+
+@pytest.fixture(scope="module")
+def altair_env():
+    """Minimal altair genesis + a collector matching the engine's
+    per-block entry protocol (stf/engine.py collect)."""
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+    spec = get_spec("altair", "minimal")
+    state = create_genesis_state(
+        spec=spec,
+        validator_balances=default_balances(spec),
+        activation_threshold=default_activation_threshold(spec))
+    # a couple of slots of history so the previous-slot block root the
+    # sync message signs over exists (sync aggregates ride blocks >= 1)
+    spec.process_slots(state, spec.Slot(2))
+    return spec, state
+
+
+def _collector():
+    entries, keys = [], []
+
+    def collect(members_id, count, flat, message, signature):
+        key = stf_verify.triple_key(members_id, message, signature)
+        if stf_verify.is_verified(key):
+            return
+        entries.append((count, flat(), message, signature))
+        keys.append(key)
+
+    return entries, keys, collect
+
+
+def _signed_sync_aggregate(spec, state, participants, bits):
+    from consensus_specs_tpu.testing.helpers.sync_committee import (
+        compute_aggregate_sync_committee_signature,
+    )
+
+    return spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, int(state.slot) - 1, participants))
+
+
+def test_sync_empty_participation_bitvector(altair_env):
+    """Empty participation contributes NO batch entry when it carries the
+    infinity signature (eth_fast_aggregate_verify's one non-pairing
+    acceptance), and trips the fast path for any other signature."""
+    from consensus_specs_tpu.stf import sync as stf_sync
+    from consensus_specs_tpu.stf.attestations import FastPathViolation
+
+    spec, state = altair_env
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    stf_verify.reset_memo()
+    entries, keys, collect = _collector()
+    stf_sync.process_sync_aggregate(
+        spec, state.copy(),
+        spec.SyncAggregate(sync_committee_bits=[False] * size,
+                           sync_committee_signature=spec.G2_POINT_AT_INFINITY),
+        collect, True)
+    assert entries == [] and keys == []
+    with pytest.raises(FastPathViolation, match="non-infinity"):
+        stf_sync.process_sync_aggregate(
+            spec, state.copy(),
+            spec.SyncAggregate(
+                sync_committee_bits=[False] * size,
+                sync_committee_signature=spec.BLSSignature(b"\x01" * 96)),
+            collect, True)
+
+
+def test_sync_duplicate_participant_keys(altair_env):
+    """A committee seating the SAME validator in every seat (duplicates
+    are legal — sync sampling is with replacement): the entry carries the
+    duplicated affine rows and the aggregate still verifies."""
+    from consensus_specs_tpu.stf import sync as stf_sync
+
+    spec, state = altair_env
+    state = state.copy()
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    pk0 = state.validators[0].pubkey
+    state.current_sync_committee = spec.SyncCommittee(
+        pubkeys=[pk0] * size,
+        aggregate_pubkey=spec.eth_aggregate_pubkeys([pk0] * size))
+    aggregate = _signed_sync_aggregate(spec, state, [0] * size, [True] * size)
+    stf_verify.reset_memo()
+    entries, keys, collect = _collector()
+    stf_sync.process_sync_aggregate(spec, state, aggregate, collect, True)
+    assert len(entries) == 1
+    count, flat, _, _ = entries[0]
+    assert count == size and len(flat) == size * 96
+    assert flat == flat[:96] * size  # every member row is validator 0's
+    assert stf_verify.settle(entries, keys) is None
+
+
+def test_sync_tampered_signature_bisected_to_sync_entry(altair_env, batch128):
+    """A block-shaped batch (attestation entries first, the sync entry
+    last) with a tampered sync signature: bisection must name exactly the
+    sync entry."""
+    from consensus_specs_tpu.stf import sync as stf_sync
+
+    spec, state = altair_env
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    participants = list(range(size))
+    aggregate = _signed_sync_aggregate(
+        spec, state, participants[:-1], [True] * size)  # one signer short
+    stf_verify.reset_memo()
+    entries, keys, collect = _collector()
+    stf_sync.process_sync_aggregate(spec, state.copy(), aggregate, collect, True)
+    assert len(entries) == 1
+    full = [_flat(*it) for it in batch128[:5]] + entries
+    assert stf_verify.first_invalid(full) == 5
+
+
+def test_sync_entry_deduped_across_blocks_via_triple_memo(altair_env):
+    """A re-carried sync aggregate (same previous-slot root, same
+    signature — gossip re-delivery, or the same block replayed on a fork
+    sharing the parent) settles once: the second collection is dropped by
+    the verified-triple memo."""
+    from consensus_specs_tpu.stf import sync as stf_sync
+
+    spec, state = altair_env
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    from consensus_specs_tpu.testing.helpers.sync_committee import (
+        compute_committee_indices,
+    )
+
+    participants = compute_committee_indices(spec, state)
+    aggregate = _signed_sync_aggregate(spec, state, participants, [True] * size)
+    stf_verify.reset_memo()
+    stf_verify.reset_stats()
+    entries, keys, collect = _collector()
+    stf_sync.process_sync_aggregate(spec, state.copy(), aggregate, collect, True)
+    assert len(entries) == 1
+    assert stf_verify.settle(entries, keys) is None
+    entries2, keys2, collect2 = _collector()
+    stf_sync.process_sync_aggregate(spec, state.copy(), aggregate, collect2, True)
+    assert entries2 == [] and keys2 == []  # memoized: no second pairing
+    assert stf_verify.stats["memo_hits"] >= 1

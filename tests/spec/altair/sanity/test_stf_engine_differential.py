@@ -1,0 +1,288 @@
+"""Differential suite: the batched block-transition engine's ALTAIR
+LINEAGE fast path vs the literal ``spec.state_transition``.
+
+Same three-layer contract as the phase0 suite
+(tests/spec/phase0/sanity/test_stf_engine_differential.py), now covering
+the altair-specific state application — participation-flag scatter, sync
+aggregates folded into the per-block signature batch, net-delta sync
+rewards — and bellatrix's execution payload run literally inside the
+snapshot region:
+
+* **Sanity replays** — every altair and bellatrix sanity-blocks scenario
+  re-runs under ``engine_mode()`` with post-state parity (or shared
+  rejection) asserted after every helper-driven transition;
+
+* **BLS-on chains** — a 2-epoch attestation-bearing altair chain, sync-
+  aggregate-bearing blocks (full and partial participation), a seeded
+  random-operation walk, and a bellatrix payload chain, each with
+  per-block root parity and the no-silent-fallback counter assert;
+
+* **Failure behavior** — an exception-parity battery for the new
+  surfaces: invalid sync-committee signature, empty participation with a
+  non-infinity signature, tampered attestation signatures (bisection
+  path), bad state roots, and an invalid execution payload — each must
+  raise the literal spec's exact exception and leave the state
+  byte-identically poisoned.  A wrong-length participation bitvector is
+  unrepresentable by construction (Bitvector[SYNC_COMMITTEE_SIZE]
+  rejects it at the SSZ layer) and is pinned as such.
+"""
+import pytest
+
+from consensus_specs_tpu import stf
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.helpers.attestations import (
+    next_slots_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testing.helpers.block_processing import engine_mode
+from consensus_specs_tpu.testing.helpers.execution_payload import (
+    build_empty_execution_payload,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testing.helpers.sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+from consensus_specs_tpu.testing.random_scenarios import run_random_scenario
+
+from . import test_blocks as _altair_blocks
+
+# -- adversarial sanity replays ----------------------------------------------
+
+from ...bellatrix.sanity import test_blocks as _bellatrix_blocks
+
+_ALTAIR_REPLAYS = [name for name in sorted(dir(_altair_blocks))
+                   if name.startswith("test_")]
+_BELLATRIX_REPLAYS = [name for name in sorted(dir(_bellatrix_blocks))
+                      if name.startswith("test_")]
+
+
+@pytest.mark.parametrize("phase", ["altair", "bellatrix"])
+@pytest.mark.parametrize("name", _ALTAIR_REPLAYS)
+def test_replay_altair_sanity_scenario_through_engine(name, phase):
+    """Re-run the altair+ sanity scenarios (sync aggregates, participation
+    flags, epoch rollover) with the engine mirror attached — for both the
+    altair and bellatrix builds of the scenario."""
+    with engine_mode():
+        getattr(_altair_blocks, name)(phase=phase, bls_active=False)
+
+
+@pytest.mark.parametrize("name", _BELLATRIX_REPLAYS)
+def test_replay_bellatrix_sanity_scenario_through_engine(name):
+    """Execution-payload sanity scenarios (pre/post merge) mirrored
+    through the engine: process_execution_payload runs literally inside
+    the snapshot-protected region."""
+    with engine_mode():
+        getattr(_bellatrix_blocks, name)(phase="bellatrix", bls_active=False)
+
+
+# -- BLS-on chains ------------------------------------------------------------
+
+
+def _per_block_differential(spec, state, signed_blocks):
+    """Replay block-by-block through both paths, roots compared at every
+    block boundary; the engine must take its fast path on every block."""
+    s_spec, s_eng = state.copy(), state.copy()
+    stf.reset_stats()
+    for i, sb in enumerate(signed_blocks):
+        spec.state_transition(s_spec, sb, True)
+        stf.apply_signed_blocks(spec, s_eng, [sb], True)
+        assert bytes(s_spec.hash_tree_root()) == bytes(s_eng.hash_tree_root()), \
+            f"post-state diverged at block {i}"
+    assert stf.stats["replayed_blocks"] == 0 and \
+        stf.stats["fast_blocks"] == len(signed_blocks), \
+        f"engine silently replayed {stf.stats['replayed_blocks']} blocks"
+    return s_eng
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_stf_differential_full_epochs_bls_altair(spec, state):
+    """Two attestation-bearing altair epochs, BLS ON: participation-flag
+    scatter + proposer rewards against the literal kernel, every block's
+    signatures settled in one engine batch."""
+    next_epoch(spec, state)
+    _, signed_blocks, _ = next_slots_with_attestations(
+        spec, state.copy(), int(spec.SLOTS_PER_EPOCH) * 2, True, True)
+    _per_block_differential(spec, state, signed_blocks)
+    yield None
+
+
+def _sync_block(spec, state, participation):
+    """A signed block carrying a sync aggregate with the given per-seat
+    participation filter (state is advanced through the block)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    committee_indices = compute_committee_indices(spec, state)
+    bits = [participation(i) for i in range(len(committee_indices))]
+    participants = [v for i, v in enumerate(committee_indices) if bits[i]]
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participants))
+    return block, state_transition_and_sign_block(spec, state, block)
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_stf_differential_sync_aggregates_bls(spec, state):
+    """Full, partial, and empty sync participation, BLS ON: the sync
+    entry joins the block batch (or the infinity-signature acceptance
+    short-circuits it) and net-delta rewards land byte-identical."""
+    next_epoch(spec, state)
+    pre = state.copy()
+    signed = []
+    for participation in (lambda i: True, lambda i: i % 2 == 0,
+                          lambda i: False):
+        signed.append(_sync_block(spec, state, participation)[1])
+    _per_block_differential(spec, pre, signed)
+    yield None
+
+
+@pytest.mark.parametrize("seed", [13])
+def test_stf_differential_random_scenario_altair(seed):
+    """Seeded randomized-operation walk (random sync aggregates included)
+    mirrored through the engine by the helper hook; BLS on."""
+    @with_phases(["altair"])
+    @spec_state_test
+    def case(spec, state):
+        with engine_mode():
+            yield from run_random_scenario(spec, state, seed=seed, stages=4)
+
+    case(phase="altair", bls_active=True)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_stf_differential_bellatrix_payload_chain(spec, state):
+    """A post-merge payload-bearing chain, BLS ON: the literal
+    process_execution_payload runs inside the snapshot region and the
+    header caching lands byte-identical."""
+    pre = state.copy()
+    assert spec.is_merge_transition_complete(state)
+    signed = []
+    for _ in range(4):
+        block = build_empty_block_for_next_slot(spec, state)
+        advanced = state.copy()
+        spec.process_slots(advanced, block.slot)
+        block.body.execution_payload = build_empty_execution_payload(
+            spec, advanced)
+        signed.append(state_transition_and_sign_block(spec, state, block))
+    _per_block_differential(spec, pre, signed)
+    yield None
+
+
+# -- identical failure behavior ----------------------------------------------
+
+
+def _exception_parity(spec, state, signed_block):
+    """Both paths must raise the same exception type/message and leave the
+    state byte-identically (partially) mutated."""
+    exc_spec = exc_eng = None
+    s_spec, s_eng = state.copy(), state.copy()
+    try:
+        spec.state_transition(s_spec, signed_block, True)
+    except Exception as e:  # noqa: B001 - parity harness captures anything
+        exc_spec = e
+    try:
+        stf.apply_signed_blocks(spec, s_eng, [signed_block], True)
+    except Exception as e:  # noqa: B001
+        exc_eng = e
+    assert exc_spec is not None, "scenario was supposed to be invalid"
+    assert type(exc_spec) is type(exc_eng), (exc_spec, exc_eng)
+    assert str(exc_spec) == str(exc_eng), (exc_spec, exc_eng)
+    assert bytes(s_spec.hash_tree_root()) == bytes(s_eng.hash_tree_root()), \
+        "poisoned post-states diverged"
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_stf_invalid_altair_blocks_fail_identically(spec, state):
+    next_epoch(spec, state)
+    pre = state.copy()
+    _, signed = _sync_block(spec, state, lambda i: True)
+
+    def tamper(fn):
+        sb = signed.copy()
+        fn(sb)
+        return sb
+
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    cases = [
+        # invalid sync-committee signature (bisected to the sync entry,
+        # then replayed to the spec's process_sync_aggregate assert)
+        tamper(lambda sb: setattr(sb.message.body.sync_aggregate,
+                                  "sync_committee_signature",
+                                  spec.BLSSignature(b"\x42" * 96))),
+        # empty participation must carry the infinity signature
+        tamper(lambda sb: setattr(sb.message.body, "sync_aggregate",
+                                  spec.SyncAggregate(
+                                      sync_committee_bits=[False] * size,
+                                      sync_committee_signature=spec.BLSSignature(
+                                          b"\x01" * 96)))),
+        # flipped participation bit under the full-participation signature
+        tamper(lambda sb: setattr(
+            sb.message.body.sync_aggregate, "sync_committee_bits",
+            [i != 0 for i in range(size)])),
+        tamper(lambda sb: setattr(sb, "signature", b"\x11" * 96)),
+        tamper(lambda sb: setattr(sb.message, "state_root",
+                                  spec.Root(b"\x44" * 32))),
+    ]
+    for sb in cases:
+        _exception_parity(spec, pre, sb)
+    yield None
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_stf_invalid_altair_attestation_fails_identically(spec, state):
+    """A tampered aggregate signature inside an attestation-bearing altair
+    block: the engine's batch fails, bisects, rolls back, and the literal
+    replay raises at the spec's is_valid_indexed_attestation assert."""
+    next_epoch(spec, state)
+    _, signed_blocks, _ = next_slots_with_attestations(
+        spec, state.copy(), int(spec.SLOTS_PER_EPOCH), True, False)
+    sb = signed_blocks[0].copy()
+    sb.message.body.attestations[0].signature = spec.BLSSignature(b"\x33" * 96)
+    _exception_parity(spec, state, sb)
+    yield None
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_stf_invalid_execution_payload_fails_identically(spec, state):
+    """An invalid execution payload (wrong timestamp) must raise the
+    spec's process_execution_payload assert from inside the snapshot
+    region with identical partial state."""
+    pre = state.copy()
+    block = build_empty_block_for_next_slot(spec, state)
+    advanced = state.copy()
+    spec.process_slots(advanced, block.slot)
+    payload = build_empty_execution_payload(spec, advanced)
+    payload.timestamp = payload.timestamp + 1
+    block.body.execution_payload = payload
+    signed = state_transition_and_sign_block(
+        spec, state, block, expect_fail=True)
+    _exception_parity(spec, pre, signed)
+    yield None
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_wrong_length_sync_bits_unrepresentable(spec, state):
+    """The 'wrong participation bitvector length' failure class is closed
+    off at the SSZ layer: Bitvector[SYNC_COMMITTEE_SIZE] rejects any other
+    length at construction, so neither path can ever see such a block."""
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    for bad in (size - 1, size + 1, 1):
+        with pytest.raises(Exception):
+            spec.SyncAggregate(sync_committee_bits=[True] * bad)
+    # the empty literal is the type's DEFAULT fill (all-false, full size),
+    # not a zero-length vector — still never a length mismatch
+    sa = spec.SyncAggregate(sync_committee_bits=[])
+    assert len(sa.sync_committee_bits) == size
+    yield None

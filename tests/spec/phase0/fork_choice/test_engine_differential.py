@@ -59,18 +59,25 @@ _REPLAY_CASES = [
     if name.startswith("test_")
 ]
 
+# the engine wraps a spec ``Store``, so later forks are parametrization,
+# not new engine code: the altair leg drives the same adversarial scripts
+# through an altair store (participation-flag states, altair justification
+# pipeline) with the identical mirror parity contract
+_REPLAY_PHASES = ["phase0", "altair"]
 
+
+@pytest.mark.parametrize("phase", _REPLAY_PHASES)
 @pytest.mark.parametrize(
     "mod,name", _REPLAY_CASES,
     ids=[f"{m.__name__.rsplit('.', 1)[-1]}::{n}" for m, n in _REPLAY_CASES])
-def test_replay_scenario_through_engine(mod, name):
+def test_replay_scenario_through_engine(mod, name, phase):
     """Re-run an existing adversarial fork-choice scenario with the engine
     mirror attached: parity is asserted after every store mutation.  BLS
     off: the originals already pin signature handling, and this exercises
     the batch path's vectorized no-BLS validation residue (the random
     cases below keep BLS on)."""
     with engine_mode():
-        getattr(mod, name)(phase="phase0", bls_active=False)
+        getattr(mod, name)(phase=phase, bls_active=False)
 
 
 # -- random-chain differential ------------------------------------------------

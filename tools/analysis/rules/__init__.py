@@ -8,5 +8,6 @@ from . import (  # noqa: F401
     engine_rules,
     hygiene,
     jit_purity,
+    key_coverage,
     rollback,
 )

@@ -85,6 +85,14 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         invalidators=frozenset({"reset_memo"}),
     ),
     CacheSpec(
+        name="sync-committee seat memo",
+        owner=("stf", "sync.py"),
+        module="consensus_specs_tpu.stf.sync",
+        module_globals=frozenset({"_SYNC_ROWS_CACHE"}),
+        producers=frozenset({"sync_committee_rows"}),
+        invalidators=frozenset({"reset_caches"}),
+    ),
+    CacheSpec(
         name="registry-columns cache",
         owner=("ops", "epoch_jax.py"),
         module="consensus_specs_tpu.ops.epoch_jax",

@@ -1,0 +1,187 @@
+"""CC02 — memo keys must bind every input the cached computation reads.
+
+CC01 polices WHO may write a registered memo; CC02 polices WHAT the key
+binds.  A memo whose key omits an input of the cached computation serves
+stale values with perfect cache discipline: the committee-context lookup
+keyed on registry/randao roots but not the spec's geometry constants
+would happily hand a minimal-preset context to a mainnet spec sharing the
+same roots, and no assert fires anywhere near the cause.
+
+The rule runs INSIDE each registered memo's owning module (the mirror
+image of CC01's scope) on the canonical memo shape:
+
+    hit = _CACHE.get(key)           # lookup
+    if hit is not None:
+        return hit
+    ...
+    _CACHE[key] = value             # insertion (or _fifo_put(_CACHE,
+    return value                    #   key, value) / setdefault)
+
+For every lookup it collects the key expression's *source parameters* —
+the enclosing function's parameters reachable from the key through local
+assignment chains (``seed = spec.get_seed(state, ...)`` makes ``seed``
+cover both ``spec`` and ``state``) — and the *read parameters* of the
+inserted value, gathered the same way from every insertion of the same
+cache in the function.  A parameter the computation reads but the key
+does not bind (directly or through a derived local) is a finding.
+
+Heuristic honesty: a lookup with no paired insertion in the same
+function is skipped (the key/value contract lives elsewhere — e.g. the
+``RootKeyedCache.get(view, build)`` instances, whose keying is the root
+of the view argument by construction), and only parameter-level coverage
+is compared, so a key derived from the right arguments is never
+second-guessed about WHICH projection of them it stores.  Fixture
+suite: tests/analysis/test_cc02.py.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Rule, register
+from .cache_coherence import CACHE_REGISTRY, _parts_contain
+
+_IGNORED_PARAMS = {"self", "cls"}
+
+
+def _load_names(expr: ast.AST) -> Set[str]:
+    """Every Name read inside an expression (comprehension targets and
+    nested loads included — over-approximation is safe here)."""
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _assignment_sources(func: ast.AST) -> Dict[str, Set[str]]:
+    """name -> union of Names appearing in every expression assigned to it
+    in this function (plain/aug/ann assignments and for-targets)."""
+    sources: Dict[str, Set[str]] = {}
+
+    def add(target: ast.AST, value: Optional[ast.AST]) -> None:
+        if value is None:
+            return
+        names = _load_names(value)
+        # Store-context Names only: in ``cache[key] = v`` neither ``cache``
+        # nor ``key`` is being (re)bound, so neither may inherit v's sources
+        for t in ast.walk(target):
+            if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store):
+                sources.setdefault(t.id, set()).update(names)
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                add(t, node.value)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            add(node.target, node.value)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            add(node.target, node.iter)
+        elif isinstance(node, (ast.withitem,)) and node.optional_vars:
+            add(node.optional_vars, node.context_expr)
+    return sources
+
+
+def _closure(names: Iterable[str], sources: Dict[str, Set[str]]) -> Set[str]:
+    """Names reachable from ``names`` through the assignment-source map."""
+    out: Set[str] = set()
+    stack = list(names)
+    while stack:
+        n = stack.pop()
+        if n in out:
+            continue
+        out.add(n)
+        stack.extend(sources.get(n, ()))
+    return out
+
+
+def _func_params(func: ast.AST) -> Set[str]:
+    a = func.args
+    params = {arg.arg for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    for arg in (a.vararg, a.kwarg):
+        if arg is not None:
+            params.add(arg.arg)
+    return params - _IGNORED_PARAMS
+
+
+@register
+class KeyCoverageRule(Rule):
+    """Registered-memo lookup whose key omits a parameter the cached
+    computation reads."""
+
+    code = "CC02"
+    summary = "memo lookup key omits an input the cached computation reads"
+
+    registry = CACHE_REGISTRY
+
+    def check(self, ctx):
+        if ctx.tree is None:
+            return
+        owned = [s for s in self.registry
+                 if s.module_globals and _parts_contain(ctx.parts, s.owner)]
+        if not owned:
+            return
+        cache_names: Set[str] = set()
+        for s in owned:
+            cache_names |= s.module_globals
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_function(func, cache_names)
+
+    # -- per-function memo-shape analysis ------------------------------------
+
+    def _check_function(self, func, cache_names: Set[str]):
+        lookups: List[Tuple[str, ast.AST, ast.AST]] = []  # (cache, key, site)
+        inserts: Dict[str, List[ast.AST]] = {}            # cache -> values
+        for node in ast.walk(func):
+            self._collect(node, cache_names, lookups, inserts)
+        if not lookups:
+            return
+        sources = _assignment_sources(func)
+        params = _func_params(func)
+        for cache, key_expr, site in lookups:
+            values = inserts.get(cache)
+            if not values:
+                continue  # key/value contract lives elsewhere: no evidence
+            read_params = set()
+            for v in values:
+                read_params |= _closure(_load_names(v), sources) & params
+            key_params = _closure(_load_names(key_expr), sources) & params
+            missing = sorted(read_params - key_params - cache_names)
+            if missing:
+                yield (site.lineno,
+                       f"lookup key of {cache} omits parameter"
+                       f"{'s' if len(missing) > 1 else ''} "
+                       f"{', '.join(missing)} that the cached computation "
+                       f"reads; bind them (or a value derived from them) "
+                       f"into the key")
+
+    def _collect(self, node, cache_names, lookups, inserts) -> None:
+        # lookup: CACHE.get(key[, default]) — dict-get shape only (the
+        # 2-arg builder form of RootKeyedCache keys on its view argument
+        # by construction and carries no inline key expression)
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in cache_names and node.args):
+            if len(node.args) == 1 or (
+                    len(node.args) == 2 and isinstance(node.args[1],
+                                                       ast.Constant)):
+                lookups.append((node.func.value.id, node.args[0], node))
+        # insertion: CACHE[key] = value
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in cache_names):
+                    inserts.setdefault(t.value.id, []).append(node.value)
+        # insertion: CACHE.setdefault(key, value)
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "setdefault"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in cache_names
+                and len(node.args) == 2):
+            inserts.setdefault(node.func.value.id, []).append(node.args[1])
+        # insertion through a put helper: helper(CACHE, key, value)
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and len(node.args) >= 3
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in cache_names):
+            inserts.setdefault(node.args[0].id, []).append(node.args[2])

@@ -45,8 +45,12 @@ PROTECTED_REGION: Dict[str, FrozenSet[str]] = {
         "apply_signed_blocks", "_apply_one", "_fast_transition",
         "_header", "_randao_collect", "_operations",
         "_attestations", "_attestations_inner",
+        "_attestations_inner_altair",
     }),
     "slot_roots.py": frozenset({"process_slots", "_process_slot"}),
+    # sync.py's writers run only from _fast_transition, inside the
+    # snapshot region (altair-lineage sync-aggregate rewards)
+    "sync.py": frozenset({"process_sync_aggregate", "_apply_rewards"}),
 }
 
 
