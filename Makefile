@@ -33,6 +33,11 @@ limb-probe:
 dcn-dryrun:
 	python tools/dcn_dryrun.py
 
+# tier-1 chaos subset (fault-injection differential suites) + the
+# analyzer gate — the failure-containment half of `make test`
+chaos:
+	python -m pytest tests/chaos tests/analysis/test_live_tree_clean.py -q -m 'not slow'
+
 lint:
 	python tools/lint.py
 
@@ -63,4 +68,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE
+.PHONY: test test-par test-fast test-mainnet bench chaos limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE

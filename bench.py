@@ -361,6 +361,10 @@ def bench_epoch_e2e_bls(results):
         "sig_batches": stf_verify.stats["batches"],
         "sig_entries_settled": stf_verify.stats["entries"],
         "sig_memo_hits": stf_verify.stats["memo_hits"],
+        "replay_reasons": dict(stf.stats["replay_reasons"]),
+        "breaker_state": stf.stats["breaker_state"],
+        "breaker_trips": stf.stats["breaker_trips"],
+        "native_degraded": stf_verify.stats["native_degraded"],
         **phases,
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
@@ -480,6 +484,13 @@ def bench_epoch_e2e_bls_altair(results):
         "sig_batches": stf_verify.stats["batches"],
         "sig_entries_settled": stf_verify.stats["entries"],
         "sig_memo_hits": stf_verify.stats["memo_hits"],
+        # failure-containment telemetry (PR 5): silent fallbacks are
+        # attributable per exception class, and a tripped breaker or
+        # degraded native backend can never hide in a green-looking row
+        "replay_reasons": dict(stf.stats["replay_reasons"]),
+        "breaker_state": stf.stats["breaker_state"],
+        "breaker_trips": stf.stats["breaker_trips"],
+        "native_degraded": stf_verify.stats["native_degraded"],
         **phases,
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
@@ -1094,6 +1105,13 @@ def _ensure_live_jax():
 
 def main():
     device_fallback = _ensure_live_jax()
+    if os.environ.get("CSTPU_FAULTS"):
+        # chaos run: import the instrumented modules, then fail fast on a
+        # typo'd site name — a silently-disarmed schedule would report a
+        # clean row that exercised nothing
+        from consensus_specs_tpu import faults, forkchoice, stf  # noqa: F401
+
+        faults.assert_sites_registered()
     results = {}
     if device_fallback:
         results["_device_fallback"] = (

@@ -209,9 +209,17 @@ def _load_or_build_fixed_table(nat, flat: bytes) -> bytes:
     setup otherwise recurs in every process.  Keyed by (native source
     digest, ABI tag, points digest) — the entries are raw Montgomery
     limbs, valid only for the exact library build *and host ABI* — with a
-    trailing SHA-256 guarding against torn/corrupted files."""
+    trailing SHA-256 guarding against torn/corrupted files.
+
+    Failure containment: a truncated or damaged file (torn write, disk
+    fault) fails the length/digest check and is REGENERATED in place, and
+    writes go to a uniquely-named temp file promoted with ``os.replace``
+    — concurrent builders each write their own temp and the atomic rename
+    means a reader can never observe a half-written table (the C side's
+    on-curve entry-0 check stays as the tamper backstop behind both)."""
     import hashlib
     import os
+    import tempfile
 
     path = _fixed_table_path(nat, flat)
     expect = 96 * (len(flat) // 96) * nat._MSM_FIXED_WINDOWS
@@ -225,11 +233,22 @@ def _load_or_build_fixed_table(nat, flat: bytes) -> bytes:
         pass
     table = nat.G1MSMPrecompute(flat)
     try:
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(table)
-            f.write(hashlib.sha256(table).digest())
-        os.replace(tmp, path)
+        fd, tmp = tempfile.mkstemp(
+            prefix=os.path.basename(path) + ".", suffix=".tmp",
+            dir=os.path.dirname(path))
+        try:
+            # mkstemp creates 0600; restore plain-open() semantics so a
+            # shared cache stays readable by other accounts' processes
+            umask = os.umask(0)
+            os.umask(umask)
+            os.fchmod(fd, 0o666 & ~umask)
+            with os.fdopen(fd, "wb") as f:
+                f.write(table)
+                f.write(hashlib.sha256(table).digest())
+            os.replace(tmp, path)  # atomic: concurrent builders converge
+        except BaseException:
+            os.unlink(tmp)
+            raise
     except OSError:
         pass  # read-only tree: rebuild per process
     return table

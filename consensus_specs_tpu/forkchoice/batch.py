@@ -33,10 +33,18 @@ so an invalid attestation aborts the batch with no votes applied (target
 checkpoint states materialized during validation remain, as they would
 under the spec).  For single-attestation batches — how the differential
 suites replay scenarios — this coincides exactly with the spec handler.
+
+Exception safety (PR 5): this module STAGES, it never commits.  The
+returned ``StagedVotes`` carries the winning messages fully materialized;
+``commit_votes`` applies them to ``store.latest_messages`` in a loop with
+no failure modes left in it.  The engine fires the
+``forkchoice.batch.apply`` fault probe between staging and commit and
+lands the store fold and the proto-array weight update together — a fault
+anywhere in ingestion leaves both exactly as they were (tests/chaos/).
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, NamedTuple, Optional
 
 import numpy as np
 
@@ -44,15 +52,36 @@ from consensus_specs_tpu import tracing
 from consensus_specs_tpu.crypto import bls
 
 
+class StagedVotes(NamedTuple):
+    """A validated, reduced, NOT-yet-applied batch of latest-message
+    updates: ``block_roots[att_ids[k]]`` is the LMD vote of
+    ``validators[k]``; ``messages`` holds the prebuilt
+    ``(ValidatorIndex, LatestMessage)`` pairs ``commit_votes`` applies."""
+
+    validators: np.ndarray
+    epochs: np.ndarray
+    att_ids: np.ndarray
+    block_roots: List
+    messages: List
+
+
+def commit_votes(store, staged: StagedVotes) -> None:
+    """Apply a staged batch to ``store.latest_messages`` (the spec's
+    ``update_latest_messages`` fold, precomputed): plain dict writes of
+    prebuilt objects — nothing here can raise halfway."""
+    messages = store.latest_messages
+    for vi, msg in staged.messages:
+        messages[vi] = msg
+
+
 def ingest_attestations(
         spec, store, attestations, is_from_block: bool = False
-) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, List]]:
+) -> Optional[StagedVotes]:
     """Spec-equivalent batched ``on_attestation`` over ``store``.
 
-    Validates every attestation, then updates ``store.latest_messages`` in
-    one reduction.  Returns ``(validators, epochs, att_ids, block_roots)``
-    for the winning (applied) messages — ``block_roots[att_ids[k]]`` is
-    the LMD vote of ``validators[k]`` — or None when nothing changed.
+    Validates every attestation and reduces the batch to its winning
+    messages, WITHOUT applying them.  Returns a ``StagedVotes`` (commit
+    with ``commit_votes``), or None when nothing would change.
     """
     attestations = list(attestations)
     if not attestations:
@@ -149,12 +178,13 @@ def ingest_attestations(
             return None
         wv, we, wa = wv[upd], we[upd], wa[upd]
 
-    with tracing.span("forkchoice/ingest/commit"):
+    with tracing.span("forkchoice/ingest/stage"):
         LatestMessage = spec.LatestMessage
         ValidatorIndex = spec.ValidatorIndex
+        staged_messages = []
         for vi, ai in zip(wv.tolist(), wa.tolist()):
             d = attestations[ai].data
-            messages[ValidatorIndex(vi)] = LatestMessage(
-                epoch=d.target.epoch, root=d.beacon_block_root)
+            staged_messages.append((ValidatorIndex(vi), LatestMessage(
+                epoch=d.target.epoch, root=d.beacon_block_root)))
 
-    return wv, we, wa, block_roots
+    return StagedVotes(wv, we, wa, block_roots, staged_messages)

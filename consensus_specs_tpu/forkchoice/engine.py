@@ -36,12 +36,20 @@ from __future__ import annotations
 
 import numpy as np
 
-from consensus_specs_tpu import tracing
+from consensus_specs_tpu import faults, tracing
 
 from . import batch
 from .proto_array import ProtoArray
 
 _ZERO32 = b"\x00" * 32
+
+# fault probes (tests/chaos/): each fires BEFORE its handler's first
+# mutation, so an injected failure leaves the wrapped store and the
+# proto-array exactly as they were — head parity with the spec walk is
+# asserted across the fault in the chaos suite
+_SITE_ON_BLOCK = faults.site("forkchoice.on_block")
+_SITE_BATCH_APPLY = faults.site("forkchoice.batch.apply")
+_SITE_PRUNE = faults.site("forkchoice.prune")
 
 
 def _cp(checkpoint) -> tuple:
@@ -115,60 +123,86 @@ class ForkChoiceEngine:
     def _sync_checkpoints(self) -> None:
         jc = _cp(self.store.justified_checkpoint)
         if jc != self._justified_seen:
-            self._justified_seen = jc
+            # seen-marker moves only after the refresh succeeds: a failure
+            # mid-refresh must retry on the next handler call, not leave
+            # stale balances behind a marker that says they're fresh
             self._refresh_justified()
+            self._justified_seen = jc
         fc = _cp(self.store.finalized_checkpoint)
         if fc != self._finalized_seen:
-            self._finalized_seen = fc
             with tracing.span("forkchoice/prune"):
+                # probe before the prune mutates the proto-array; the seen
+                # marker moves only after success, so an injected failure
+                # here retries the prune on the next handler call
+                _SITE_PRUNE()
                 self.proto.prune(self.store.finalized_checkpoint.root)
+            self._finalized_seen = fc
 
     # -- handlers ------------------------------------------------------------
 
     def on_tick(self, time) -> None:
         with tracing.span("forkchoice/on_tick"):
-            self.spec.on_tick(self.store, time)
-            self._sync_checkpoints()
-            self._head = None
+            try:
+                self.spec.on_tick(self.store, time)
+                self._sync_checkpoints()
+            finally:
+                # invalidate even on a failure part-way: the spec handler
+                # may already have moved the store under the cached head
+                self._head = None
 
     def on_block(self, signed_block) -> None:
         with tracing.span("forkchoice/on_block"):
-            self.spec.on_block(self.store, signed_block)
-            self._insert_block(
-                self.spec.hash_tree_root(signed_block.message))
-            self._sync_checkpoints()
-            self._head = None
+            _SITE_ON_BLOCK()  # pre-mutation: a fault leaves store + proto as-is
+            try:
+                self.spec.on_block(self.store, signed_block)
+                self._insert_block(
+                    self.spec.hash_tree_root(signed_block.message))
+                self._sync_checkpoints()
+            finally:
+                self._head = None
 
     def on_attestations(self, attestations, is_from_block: bool = False) -> None:
-        """Batched ``on_attestation``: the whole batch is validated before
-        any vote lands (see batch.py for the exact semantics)."""
+        """Batched ``on_attestation``: the whole batch is validated AND
+        staged before any vote lands (batch.py), then the store fold and
+        the proto-array weight update commit together in a region with no
+        failure modes — a fault anywhere up to the commit leaves no
+        partially-applied vote deltas."""
         with tracing.span("forkchoice/on_attestations"):
-            changed = batch.ingest_attestations(
-                self.spec, self.store, attestations, is_from_block)
-            if changed is not None:
-                validators, epochs, att_ids, block_roots = changed
-                self.proto.ensure_validators(int(validators.max()) + 1)
-                nodes = np.fromiter(
-                    (self.proto.node_index(block_roots[a])
-                     for a in att_ids.tolist()),
-                    dtype=np.int64, count=len(att_ids))
-                with tracing.span("forkchoice/apply_votes"):
-                    self.proto.apply_vote_changes(validators, nodes, epochs)
-            self._head = None
+            try:
+                staged = batch.ingest_attestations(
+                    self.spec, self.store, attestations, is_from_block)
+                if staged is not None:
+                    self.proto.ensure_validators(
+                        int(staged.validators.max()) + 1)
+                    nodes = np.fromiter(
+                        (self.proto.node_index(staged.block_roots[a])
+                         for a in staged.att_ids.tolist()),
+                        dtype=np.int64, count=len(staged.att_ids))
+                    _SITE_BATCH_APPLY()  # last probed point before the commit
+                    batch.commit_votes(self.store, staged)
+                    with tracing.span("forkchoice/apply_votes"):
+                        self.proto.apply_vote_changes(
+                            staged.validators, nodes, staged.epochs)
+            finally:
+                self._head = None
 
     def on_attestation(self, attestation, is_from_block: bool = False) -> None:
         self.on_attestations([attestation], is_from_block=is_from_block)
 
     def on_attester_slashing(self, attester_slashing) -> None:
         with tracing.span("forkchoice/on_attester_slashing"):
-            self.spec.on_attester_slashing(self.store, attester_slashing)
-            fresh = self.store.equivocating_indices - self._equivocating_seen
-            if fresh:
-                self._equivocating_seen |= fresh
-                eq = np.fromiter((int(i) for i in fresh), dtype=np.int64)
-                self.proto.ensure_validators(int(eq.max()) + 1)
-                self.proto.clear_votes(eq)
-            self._head = None
+            try:
+                self.spec.on_attester_slashing(self.store, attester_slashing)
+                fresh = self.store.equivocating_indices - self._equivocating_seen
+                if fresh:
+                    eq = np.fromiter((int(i) for i in fresh), dtype=np.int64)
+                    self.proto.ensure_validators(int(eq.max()) + 1)
+                    self.proto.clear_votes(eq)
+                    # seen-marker moves only after the votes cleared, like
+                    # the justified/prune markers: a failure here retries
+                    self._equivocating_seen |= fresh
+            finally:
+                self._head = None
 
     # -- queries -------------------------------------------------------------
 

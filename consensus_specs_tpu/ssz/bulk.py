@@ -156,7 +156,10 @@ class RootKeyedCache:
         self.capacity = capacity
         self._store: Dict[bytes, object] = {}
 
-    def get(self, view, build):
+    def get(self, view, build, on_insert=None):
+        """Cached value for ``view``; on a miss, builds and inserts.
+        ``on_insert(store, root)`` fires after a fresh insert — the stf
+        cache transaction uses it to record the insert for rollback."""
         root = bytes(view.hash_tree_root())
         hit = self._store.get(root)
         if hit is None:
@@ -164,6 +167,8 @@ class RootKeyedCache:
                 self._store.pop(next(iter(self._store)))
             hit = build(view)
             self._store[root] = hit
+            if on_insert is not None:
+                on_insert(self._store, root)
         return hit
 
 

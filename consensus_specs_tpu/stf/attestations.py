@@ -29,15 +29,25 @@ from typing import List, Tuple
 
 import numpy as np
 
+from consensus_specs_tpu import faults
 from consensus_specs_tpu.ops.segment import segment_sum
 from consensus_specs_tpu.ops.shuffle import committee_bounds, compute_shuffle_permutation
 from consensus_specs_tpu.ssz import bulk
+
+from . import staging
 
 
 class FastPathViolation(Exception):
     """A block failed a fast-path check (or needs a capability the fast
     path lacks): the engine rolls back and replays through the literal
     spec, which raises the spec's own exception."""
+
+
+# fault probes (tests/chaos/): whole-block resolution and the affine
+# gather feed the signature batch — both must fail into the replay
+# contract without poisoning a memo
+_SITE_RESOLVE = faults.site("stf.attestations.resolve")
+_SITE_AFFINE_ROWS = faults.site("stf.attestations.affine_rows")
 
 
 # -- per-epoch committee geometry --------------------------------------------
@@ -49,9 +59,13 @@ _CACHE_MAX = 8
 
 
 def _fifo_put(cache: dict, key, value, cap: int = _CACHE_MAX):
+    """FIFO insert, recorded with the block's cache transaction (if one
+    is active) so a failed block's inserts roll back — the transactional
+    half of the rollback contract (stf/staging.py)."""
     if len(cache) >= cap:
         cache.pop(next(iter(cache)))
     cache[key] = value
+    staging.note_insert(cache, key)
     return value
 
 
@@ -198,8 +212,12 @@ def _new_affine_matrix(validators):
 
 
 def affine_matrix(validators) -> dict:
-    """Registry-root-cached affine coordinate matrix + invalid-row mask."""
-    return _AFFINE_MATRIX_CACHE.get(validators, _new_affine_matrix)
+    """Registry-root-cached affine coordinate matrix + invalid-row mask.
+    A build triggered mid-block is recorded with the cache transaction
+    like every other fast-path insert (the value is pure in the registry
+    root, so the rollback only costs a rebuild)."""
+    return _AFFINE_MATRIX_CACHE.get(validators, _new_affine_matrix,
+                                    on_insert=staging.note_insert)
 
 
 def reset_caches() -> None:
@@ -231,7 +249,9 @@ def affine_rows(validators, indices: np.ndarray) -> bytes:
         # an unverifiable member pubkey: the spec's FastAggregateVerify
         # returns False and process_attestation asserts — replay path
         raise FastPathViolation("invalid registry pubkey among attesters")
-    return entry["mat"][indices].tobytes()
+    # probed on the outgoing buffer: a corrupted coordinate fails the
+    # batch, bisects to this entry, and the block replays literally
+    return _SITE_AFFINE_ROWS(entry["mat"][indices].tobytes())
 
 
 # -- whole-block resolution ---------------------------------------------------
@@ -261,6 +281,7 @@ class _BlockResolver:
         spec, state = self.spec, self.state
         out = []
         for att in attestations:
+            _SITE_RESOLVE()
             data = att.data
             target_epoch = int(data.target.epoch)
             slot = int(data.slot)

@@ -31,9 +31,9 @@ from __future__ import annotations
 
 import time
 
-from consensus_specs_tpu import tracing
+from consensus_specs_tpu import faults, tracing
 
-from . import slot_roots, sync, verify
+from . import slot_roots, staging, sync, verify
 from .attestations import (
     FastPathViolation,
     affine_rows,
@@ -49,10 +49,42 @@ from .attestations import (
 # through the literal spec until the engine grows those operations.
 FAST_FORKS = ("phase0", "altair", "bellatrix")
 
+# circuit breaker: after BREAKER_THRESHOLD CONSECUTIVE unexpected
+# fast-path errors (not FastPathViolations — those are the contract
+# working) the engine stops attempting the fast path and replays every
+# block literally; while open, every BREAKER_PROBE_INTERVAL-th block is
+# a recovery probe (i.e. INTERVAL-1 literal replays between probes), and
+# a successful probe closes the breaker.  Failure containment for a systematically broken fast path
+# (poisoned build, sick native library): correctness never depended on
+# the fast path, but paying a doomed attempt + rollback per block would
+# double the work exactly when the node is least healthy.
+BREAKER_THRESHOLD = 3
+BREAKER_PROBE_INTERVAL = 8
+
+_breaker = {"consecutive_errors": 0, "open": False, "since_skipped": 0}
+
+# fault probes (tests/chaos/): each fast-path phase fails into the
+# rollback contract; the gate and the post-settlement cache commit are
+# probed as well so degraded-availability and torn-commit scenarios are
+# tested paths
+_SITE_HEADER = faults.site("stf.engine.header")
+_SITE_RANDAO = faults.site("stf.engine.randao")
+_SITE_OPERATIONS = faults.site("stf.engine.operations")
+_SITE_STATE_ROOT = faults.site("stf.engine.state_root")
+_SITE_NATIVE_GATE = faults.site("stf.engine.native_gate")
+_SITE_CACHE_COMMIT = faults.site("stf.engine.cache_commit")
+_SITE_MIRROR_READ = faults.site("stf.engine.mirror_read")
+_SITE_MIRROR_FLUSH = faults.site("stf.engine.mirror_flush")
+
 stats = {
     "fast_blocks": 0,
     "replayed_blocks": 0,
     "fast_path_errors": 0,
+    "breaker_trips": 0,
+    "breaker_probes": 0,
+    "breaker_skipped": 0,
+    "breaker_state": "closed",
+    "replay_reasons": {},
     "sig_verify_s": 0.0,
     "attestation_apply_s": 0.0,
     "sync_apply_s": 0.0,
@@ -64,10 +96,24 @@ stats = {
 def reset_stats() -> None:
     """Zero ALL engine counters — the per-block phase/fallback dict here
     and the signature-settlement counters in stf/verify.py (one call, so
-    bench rows can't accidentally report cumulative halves)."""
+    bench rows can't accidentally report cumulative halves) — and re-arm
+    the circuit breaker (counters and live state reset together, so a
+    bench leg can't inherit the previous leg's open breaker)."""
     for k in stats:
-        stats[k] = 0.0 if isinstance(stats[k], float) else 0
+        if isinstance(stats[k], float):
+            stats[k] = 0.0
+        elif isinstance(stats[k], dict):
+            stats[k] = {}
+        elif isinstance(stats[k], int):
+            stats[k] = 0
+    _breaker.update(consecutive_errors=0, open=False, since_skipped=0)
+    stats["breaker_state"] = "closed"
     verify.reset_stats()
+
+
+def _count_reason(reason: str) -> None:
+    reasons = stats["replay_reasons"]
+    reasons[reason] = reasons.get(reason, 0) + 1
 
 
 def _native_available() -> bool:
@@ -76,6 +122,52 @@ def _native_available() -> bool:
         return True
     except ImportError:
         return False
+
+
+def _fast_path_ready(spec) -> bool:
+    """The gate: covered fork family, native backend importable AND not
+    degraded (a crashed backend demotes every block to the literal
+    replay — see stf/verify._degrade)."""
+    ok = (getattr(spec, "fork", None) in FAST_FORKS
+          and _native_available() and not verify.native_degraded())
+    return bool(_SITE_NATIVE_GATE(ok))
+
+
+# -- circuit breaker -----------------------------------------------------------
+
+def _breaker_note_success() -> None:
+    _breaker["consecutive_errors"] = 0
+    if _breaker["open"]:
+        _breaker["open"] = False
+        _breaker["since_skipped"] = 0
+        stats["breaker_state"] = "closed"
+        tracing.count("stf.breaker_closed")
+
+
+def _breaker_note_error() -> None:
+    _breaker["consecutive_errors"] += 1
+    if _breaker["open"]:
+        # a failed recovery probe: stay open, restart the skip countdown
+        _breaker["since_skipped"] = 0
+        return
+    if _breaker["consecutive_errors"] >= BREAKER_THRESHOLD:
+        _breaker["open"] = True
+        _breaker["since_skipped"] = 0
+        stats["breaker_trips"] += 1
+        stats["breaker_state"] = "open"
+        tracing.count("stf.breaker_tripped")
+
+
+def _breaker_allows_attempt() -> bool:
+    """False while the breaker is open and this block is not a probe."""
+    if not _breaker["open"]:
+        return True
+    _breaker["since_skipped"] += 1
+    if _breaker["since_skipped"] % BREAKER_PROBE_INTERVAL == 0:
+        stats["breaker_probes"] += 1
+        tracing.count("stf.breaker_probe")
+        return True
+    return False
 
 
 def apply_signed_blocks(spec, state, signed_blocks, validate_result: bool = True):
@@ -89,19 +181,33 @@ def apply_signed_blocks(spec, state, signed_blocks, validate_result: bool = True
 
 
 def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
+    if not _breaker_allows_attempt():
+        stats["replayed_blocks"] += 1
+        stats["breaker_skipped"] += 1
+        _count_reason("breaker_open")
+        tracing.count("stf.replayed_block")
+        spec.state_transition(state, signed_block, validate_result)
+        return
     pre_backing = state.get_backing()
     try:
-        if getattr(spec, "fork", None) not in FAST_FORKS or not _native_available():
+        if not _fast_path_ready(spec):
             # uncovered forks keep their own kernel substitutions + the
             # facade's deferred per-block batch
             raise FastPathViolation(
                 "fast path covers phase0/altair/bellatrix + native BLS")
-        _fast_transition(spec, state, signed_block, validate_result)
+        with staging.block_transaction():
+            _fast_transition(spec, state, signed_block, validate_result)
+            # the commit itself is a probed seam: a torn commit rolls the
+            # staged entries back and the block replays literally
+            _SITE_CACHE_COMMIT()
         stats["fast_blocks"] += 1
+        _breaker_note_success()
         tracing.count("stf.fast_block")
     except Exception as exc:
         if not isinstance(exc, FastPathViolation):
             stats["fast_path_errors"] += 1
+            _breaker_note_error()
+        _count_reason(type(exc).__name__)
         stats["replayed_blocks"] += 1
         tracing.count("stf.replayed_block")
         state.set_backing(pre_backing)
@@ -163,7 +269,8 @@ def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
         raise FastPathViolation(f"invalid signature (batch entry {bad})")
     t5 = time.perf_counter()
     if validate_result:
-        if bytes(block.state_root) != bytes(slot_roots.state_root(spec, state)):
+        computed = _SITE_STATE_ROOT(bytes(slot_roots.state_root(spec, state)))
+        if bytes(block.state_root) != computed:
             raise FastPathViolation("state root mismatch")
     t6 = time.perf_counter()
     stats["sig_verify_s"] += (t2 - t1) + (t5 - t4s)
@@ -206,6 +313,9 @@ def _header(spec, state, block) -> None:
     )
     proposer = state.validators[block.proposer_index]
     assert not proposer.slashed
+    # probed AFTER the header write: a fault here proves the rollback
+    # restores the mutated latest_block_header
+    _SITE_HEADER()
 
 
 def _randao_collect(spec, state, body, collect, bls_on) -> None:
@@ -222,6 +332,7 @@ def _randao_collect(spec, state, body, collect, bls_on) -> None:
     mix = spec.xor(spec.get_randao_mix(state, epoch),
                    spec.hash(body.randao_reveal))
     state.randao_mixes[epoch % spec.EPOCHS_PER_HISTORICAL_VECTOR] = mix
+    _SITE_RANDAO()  # post-mix-write: rollback must restore randao_mixes
 
 
 def _operations(spec, state, body, collect, bls_on, altair_lineage) -> None:
@@ -236,6 +347,7 @@ def _operations(spec, state, body, collect, bls_on, altair_lineage) -> None:
         spec.process_proposer_slashing(state, operation)
     for operation in body.attester_slashings:
         spec.process_attester_slashing(state, operation)
+    _SITE_OPERATIONS()  # mid-operations: slashings applied, rest pending
     _attestations(spec, state, body.attestations, collect, bls_on,
                   altair_lineage)
     for operation in body.deposits:
@@ -363,7 +475,10 @@ def _attestations_inner_altair(spec, state, attestations, collect, bls_on) -> No
         if col is None:
             view = (state.current_epoch_participation if is_current
                     else state.previous_epoch_participation)
-            col = columns[is_current] = bulk.packed_uint8_to_numpy(view)
+            # probed between read and use: a corrupted mirror must be
+            # caught by the post-state root check, never flushed silently
+            col = columns[is_current] = _SITE_MIRROR_READ(
+                bulk.packed_uint8_to_numpy(view))
         return col
 
     # exact get_base_reward column: effective // increment * per-increment
@@ -406,6 +521,7 @@ def _attestations_inner_altair(spec, state, attestations, collect, bls_on) -> No
                     lambda a=attesters: affine_rows(validators, a),
                     bytes(signing_root), bytes(att.signature))
 
+    _SITE_MIRROR_FLUSH()  # pre-flush: mirrors dirty, state still clean
     if True in columns:
         bulk.set_packed_uint8_from_numpy(
             state.current_epoch_participation, columns[True])

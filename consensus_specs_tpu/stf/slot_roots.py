@@ -22,7 +22,12 @@ tests/spec/phase0/sanity/test_stf_engine_differential.py.
 """
 from __future__ import annotations
 
-from consensus_specs_tpu import tracing
+from consensus_specs_tpu import faults, tracing
+
+# fault probe (tests/chaos/): fires at each slot advance, so an error
+# lands with some slots already processed — the engine rollback must
+# restore the whole multi-slot advance
+_SITE_PROCESS = faults.site("stf.slot_roots.process")
 
 
 def state_root(spec, state):
@@ -63,6 +68,7 @@ def process_slots(spec, state, slot) -> None:
     ``state_root`` above."""
     assert state.slot < slot
     while state.slot < slot:
+        _SITE_PROCESS()
         _process_slot(spec, state)
         # Process epoch on the start slot of the next epoch
         if (state.slot + 1) % spec.SLOTS_PER_EPOCH == 0:

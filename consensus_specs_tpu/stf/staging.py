@@ -1,0 +1,117 @@
+"""Block-scoped transactional cache commits for the stf fast path.
+
+The fast path populates process-global memos mid-block (committee
+contexts, proposer walks, sync seat rows, affine matrices, the
+verified-triple memo).  Before this module, an insert landed the moment
+it was computed — so a fault between the insert and the block settling
+could strand an entry whose value a corruption fault had just poisoned,
+and every later block would consume it (the engine would silently replay
+forever, or worse).  The chaos suite (tests/chaos/) makes that scenario
+a tested path; this module makes it impossible:
+
+* **visible inserts with an undo log** — caches the block itself re-reads
+  (committee contexts are probed per attestation) insert immediately, but
+  the owning module records each (cache, key) with ``note_insert``;
+  if the block fails, ``rollback`` pops exactly those entries, so a
+  failed block leaves every memo as it found it;
+* **deferred commits** — inserts nothing re-reads within the block (the
+  verified-triple memo keys) are staged with ``defer`` and applied only
+  after the block fully settles — including the post-state root check —
+  so a triple can never enter the memo on the strength of a block that
+  then failed.
+
+The engine opens one transaction per block (``block_transaction`` in
+``_apply_one``); with no transaction active (literal replays, direct
+helper use, tests poking the memos), ``note_insert`` is a no-op and
+``defer`` runs the commit immediately — the memos behave exactly as
+before PR 5.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+_TXN: Optional["CacheTransaction"] = None
+
+
+class CacheTransaction:
+    """Undo log for visible inserts + queue of deferred commits, scoped to
+    one block of ``apply_signed_blocks``."""
+
+    __slots__ = ("_undo", "_deferred")
+
+    def __init__(self):
+        self._undo = []      # (cache_dict, key): pop on rollback
+        self._deferred = []  # (fn, args): run on commit
+
+    def note_insert(self, cache: dict, key) -> None:
+        self._undo.append((cache, key))
+
+    def defer(self, fn, *args) -> None:
+        self._deferred.append((fn, args))
+
+    def commit(self) -> None:
+        """Apply deferred commits; on any failure mid-commit, undo the
+        block's visible inserts too and re-raise (already-applied deferred
+        entries are content-addressed facts — safe to keep)."""
+        try:
+            while self._deferred:
+                fn, args = self._deferred.pop(0)
+                fn(*args)
+        except BaseException:
+            self.rollback()
+            raise
+        self._undo.clear()
+
+    def rollback(self) -> None:
+        """Pop every visible insert this block made (newest first) and
+        drop the deferred queue: the memos read as if the block never
+        ran.  Removal-only, so concurrent FIFO evictions stay safe."""
+        while self._undo:
+            cache, key = self._undo.pop()
+            cache.pop(key, None)
+        self._deferred.clear()
+
+
+def current() -> Optional[CacheTransaction]:
+    return _TXN
+
+
+def note_insert(cache: dict, key) -> None:
+    """Record a visible insert with the active transaction (no-op when
+    none is active — non-engine callers keep the old immediate
+    semantics)."""
+    txn = _TXN
+    if txn is not None:
+        txn.note_insert(cache, key)
+
+
+def defer(fn, *args) -> None:
+    """Stage a commit for block settlement, or run it now when no
+    transaction is active."""
+    txn = _TXN
+    if txn is not None:
+        txn.defer(fn, *args)
+    else:
+        fn(*args)
+
+
+@contextlib.contextmanager
+def block_transaction():
+    """One block's cache transaction: commit on clean exit, roll back on
+    any exception (then re-raise into the engine's replay contract).
+    Re-entrant use joins the outer transaction."""
+    global _TXN
+    if _TXN is not None:
+        yield _TXN
+        return
+    txn = _TXN = CacheTransaction()
+    try:
+        yield txn
+    except BaseException:
+        txn.rollback()
+        raise
+    else:
+        txn.commit()
+    finally:
+        _TXN = None

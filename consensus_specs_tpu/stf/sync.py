@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from consensus_specs_tpu import tracing
+from consensus_specs_tpu import faults, tracing
 
 from .attestations import (
     FastPathViolation,
@@ -43,6 +43,12 @@ from .attestations import (
     affine_rows,
     beacon_proposer_index,
 )
+
+# fault probes (tests/chaos/): the seat memo build (a corrupted value
+# here must roll back with the block, never serve a later one) and the
+# mid-walk reward application (partial balance writes must restore)
+_SITE_ROWS_MEMO = faults.site("stf.sync.rows_memo")
+_SITE_REWARDS = faults.site("stf.sync.rewards")
 
 # -- per-period seat-to-registry-row memo -------------------------------------
 
@@ -72,6 +78,10 @@ def sync_committee_rows(spec, state) -> np.ndarray:
         # the spec's list.index scan raises on a committee pubkey missing
         # from the registry — replay path surfaces its exact ValueError
         raise FastPathViolation("sync committee pubkey not in registry")
+    # probed before the insert: a corrupted seat map fails the block (bad
+    # signature members / bad rewards -> root mismatch) and the cache
+    # transaction pops the poisoned entry with the rollback
+    rows = _SITE_ROWS_MEMO(rows)
     rows.setflags(write=False)
     return _fifo_put(_SYNC_ROWS_CACHE, key, rows, cap=_CACHE_MAX)
 
@@ -172,6 +182,7 @@ def _apply_rewards(spec, state, rows, bits, participant_reward: int,
 
     balances = state.balances
     for index, (c, d) in deltas.items():
+        _SITE_REWARDS()  # mid-walk: some balances written, some pending
         b = int(balances[index])
         if b + c >= 1 << 64:
             raise FastPathViolation("sync reward overflows a balance")

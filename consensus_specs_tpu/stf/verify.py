@@ -29,7 +29,9 @@ from __future__ import annotations
 import hashlib
 from typing import List, Optional, Sequence, Tuple
 
-from consensus_specs_tpu import tracing
+from consensus_specs_tpu import faults, tracing
+
+from . import staging
 
 # (count, flat affine members, message, signature): one pairing equation
 SigEntry = Tuple[int, bytes, bytes, bytes]
@@ -37,22 +39,72 @@ SigEntry = Tuple[int, bytes, bytes, bytes]
 _VERIFIED_MEMO: dict = {}
 _VERIFIED_MEMO_MAX = 1 << 16
 
+# fault probes (tests/chaos/): the native multi-pairing call, the
+# bisection walk, and the memo commit are the settlement path's fragile
+# seams — each must fail into the engine's replay contract, never into a
+# poisoned memo
+_SITE_NATIVE_CALL = faults.site("stf.verify.native_call")
+_SITE_BISECT = faults.site("stf.verify.bisect")
+_SITE_MEMO_COMMIT = faults.site("stf.verify.memo_commit")
+
+# degradation ladder: a native call that DIES (OSError/ctypes failure,
+# not a clean False) marks the backend degraded — this batch settles
+# through the pure-Python oracle, and the engine gates every later block
+# to the literal replay until an operator resets
+_NATIVE_DEGRADED = False
+_DEGRADED_WARNED = False
+
 stats = {
     "batches": 0,
     "entries": 0,
     "memo_hits": 0,
     "bisections": 0,
     "memo_evictions": 0,
+    "native_degraded": 0,
     "memo_cap": _VERIFIED_MEMO_MAX,
 }
 
 
 def reset_stats() -> None:
     """Zero the settlement counters (``memo_cap`` is a constant readout,
-    not a counter — it survives the reset)."""
+    not a counter — it survives the reset; so does the degraded flag,
+    which is operational state, reset via ``reset_degraded``)."""
     for k in stats:
         stats[k] = 0
     stats["memo_cap"] = _VERIFIED_MEMO_MAX
+    stats["native_degraded"] = int(_NATIVE_DEGRADED)
+
+
+def native_degraded() -> bool:
+    """True once a native batch call has crashed this process: the engine
+    must stop routing blocks through the native fast path."""
+    return _NATIVE_DEGRADED
+
+
+def reset_degraded() -> None:
+    """Clear the degraded mark (tests; an operator restoring the backend)."""
+    global _NATIVE_DEGRADED, _DEGRADED_WARNED
+    _NATIVE_DEGRADED = False
+    _DEGRADED_WARNED = False
+    stats["native_degraded"] = 0
+
+
+def _degrade(exc: BaseException) -> None:
+    """One-way degradation mark with a one-time traced warning: the run
+    survives (pure-Python settles the in-flight batch, the engine falls
+    back to literal replays) instead of dying mid-block."""
+    global _NATIVE_DEGRADED, _DEGRADED_WARNED
+    _NATIVE_DEGRADED = True
+    stats["native_degraded"] = 1
+    tracing.count("stf.native_degraded")
+    if not _DEGRADED_WARNED:
+        _DEGRADED_WARNED = True
+        import warnings
+
+        warnings.warn(
+            f"native BLS batch backend crashed ({type(exc).__name__}: {exc}); "
+            "degraded to pure-Python verification — fast path disabled until "
+            "verify.reset_degraded()", RuntimeWarning)
 
 
 def triple_key(members_id: bytes, message: bytes, signature: bytes) -> bytes:
@@ -72,14 +124,53 @@ def is_verified(key: bytes) -> bool:
 
 
 def _verify_batch(entries: Sequence[SigEntry], seed: bytes = None) -> bool:
-    """One RLC multi-pairing over ``entries`` (True iff every item holds)."""
+    """One RLC multi-pairing over ``entries`` (True iff every item holds).
+
+    Containment: an ``InjectedFault`` (generic mid-phase error) propagates
+    into the engine's replay contract; any OTHER exception out of the
+    native call is a backend crash — the process marks itself degraded and
+    this batch settles through the pure-Python oracle instead of dying."""
     if not entries:
         return True
+    if _NATIVE_DEGRADED:
+        # never re-enter a crashed backend — the bisection calls land
+        # here too, so a mid-block crash stops touching native at once
+        return _verify_batch_python(entries)
     from consensus_specs_tpu.crypto.bls import native
 
     counts, flats, msgs, sigs = zip(*entries)
-    return native.BatchFastAggregateVerifyFlat(
-        counts, b"".join(flats), msgs, sigs, seed=seed)
+    try:
+        _SITE_NATIVE_CALL()
+        return native.BatchFastAggregateVerifyFlat(
+            counts, b"".join(flats), msgs, sigs, seed=seed)
+    except faults.InjectedFault:
+        raise
+    except Exception as exc:
+        _degrade(exc)
+        return _verify_batch_python(entries)
+
+
+def _verify_batch_python(entries: Sequence[SigEntry]) -> bool:
+    """Pure-Python settlement of a batch (degraded mode): each entry's
+    affine members compress back to ZCash form and verify through the
+    oracle ``FastAggregateVerify`` — slow, but the node stays alive and
+    byte-exact while the native backend is gone."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite
+    from consensus_specs_tpu.crypto.bls.curve import _HALF_P
+
+    for count, flat, message, signature in entries:
+        pks = []
+        for i in range(count):
+            xy = flat[96 * i: 96 * (i + 1)]
+            x, y = int.from_bytes(xy[:48], "big"), int.from_bytes(xy[48:], "big")
+            raw = bytearray(x.to_bytes(48, "big"))
+            raw[0] |= 0x80 | (0x20 if y > _HALF_P else 0)
+            pks.append(bytes(raw))
+        # noqa-justified: this IS the no-native fallback — there is no
+        # batch backend left to route through while degraded
+        if not ciphersuite.FastAggregateVerify(pks, message, signature):  # noqa: ST01
+            return False
+    return True
 
 
 def first_invalid(entries: Sequence[SigEntry], seed: bytes = None) -> Optional[int]:
@@ -98,6 +189,7 @@ def first_invalid(entries: Sequence[SigEntry], seed: bytes = None) -> Optional[i
     lo, hi = 0, len(entries)
     # invariant: entries[:lo] all verify; at least one failure in [lo, hi)
     while hi - lo > 1:
+        _SITE_BISECT()
         mid = (lo + hi) // 2
         if _verify_batch(entries[lo:mid], seed=seed):
             lo = mid
@@ -112,8 +204,11 @@ def settle(entries: List[SigEntry], keys: List[bytes],
     the index (in call order) of the first invalid entry.
 
     The engine drops already-verified triples before building entries
-    (``is_verified``); on success the settled triples join the memo.
-    """
+    (``is_verified``); on success the settled triples join the memo —
+    through the block's cache transaction when one is active, so the
+    commit lands only after the WHOLE block settles (including the
+    post-state root check), never on the strength of a block that then
+    rolled back."""
     if not entries:
         return None
     tracing.count("stf.sig_batch")
@@ -121,9 +216,16 @@ def settle(entries: List[SigEntry], keys: List[bytes],
     bad = first_invalid(entries, seed=seed)
     if bad is not None:
         return bad
+    staging.defer(_commit_keys, keys)
+    return None
+
+
+def _commit_keys(keys: Sequence[bytes]) -> None:
+    """Insert a settled block's triples (the deferred half of ``settle``;
+    runs at block commit, or immediately when no transaction is active)."""
+    _SITE_MEMO_COMMIT()
     for k in keys:
         _memo_put(k)
-    return None
 
 
 def _memo_put(key: bytes) -> None:

@@ -1,0 +1,129 @@
+"""Unit pins for the fault-injection machinery itself
+(consensus_specs_tpu/faults.py): hit counting, disarm-after-fire, sticky
+rules, deterministic corruption, env-directive parsing, plan nesting, and
+registry uniqueness.  The chaos differential suites build on these
+semantics — if a probe misfires, every containment assertion downstream
+is measuring the wrong thing."""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu import faults
+
+SITE = faults.site("tests.chaos.unit_probe")
+VALUE_SITE = faults.site("tests.chaos.unit_value_probe")
+
+
+def test_probe_is_passthrough_without_plan():
+    assert faults.active_plan() is None
+    assert SITE() is None
+    assert VALUE_SITE(17) == 17
+
+
+def test_fires_on_nth_hit_then_disarms():
+    plan = faults.FaultPlan([faults.Fault(SITE.name, nth=2)])
+    with faults.inject(plan):
+        SITE()  # hit 1: armed but not yet
+        with pytest.raises(faults.InjectedFault, match="hit 2"):
+            SITE()
+        SITE()  # hit 3: fired once, disarmed
+    assert plan.hits[SITE.name] == 3
+    assert plan.fired == [(SITE.name, 2, "error")]
+
+
+def test_sticky_fires_from_nth_on():
+    plan = faults.FaultPlan([faults.Fault(SITE.name, nth=2, sticky=True)])
+    with faults.inject(plan):
+        SITE()
+        for expected_hit in (2, 3, 4):
+            with pytest.raises(faults.InjectedFault):
+                SITE()
+    assert [h for _, h, _ in plan.fired] == [2, 3, 4]
+
+
+def test_crash_kind_is_backend_crash():
+    plan = faults.FaultPlan([faults.Fault(SITE.name, kind="crash")])
+    with faults.inject(plan):
+        with pytest.raises(faults.InjectedBackendCrash):
+            SITE()
+    # the crash exception is an OSError (a dead ctypes backend), NOT the
+    # generic InjectedFault the engine's replay contract swallows
+    assert not issubclass(faults.InjectedBackendCrash, faults.InjectedFault)
+    assert issubclass(faults.InjectedBackendCrash, OSError)
+
+
+def test_corrupt_copies_and_is_deterministic():
+    arr = np.array([5, 6, 7], dtype=np.int64)
+    plan = faults.FaultPlan([faults.Fault(VALUE_SITE.name, kind="corrupt")])
+    with faults.inject(plan):
+        out = VALUE_SITE(arr)
+    assert out[0] == 6 and arr[0] == 5  # copy corrupted, original intact
+    with faults.inject(faults.FaultPlan(
+            [faults.Fault(VALUE_SITE.name, kind="corrupt")])):
+        assert VALUE_SITE(b"\x10\x20") == b"\x11\x20"
+    with faults.inject(faults.FaultPlan(
+            [faults.Fault(VALUE_SITE.name, kind="corrupt")])):
+        assert VALUE_SITE(True) is False
+    bools = np.array([True, False])
+    with faults.inject(faults.FaultPlan(
+            [faults.Fault(VALUE_SITE.name, kind="corrupt")])):
+        assert not VALUE_SITE(bools)[0]
+
+
+def test_corrupt_on_valueless_probe_degenerates_to_error():
+    plan = faults.FaultPlan([faults.Fault(SITE.name, kind="corrupt")])
+    with faults.inject(plan):
+        with pytest.raises(faults.InjectedFault):
+            SITE()
+
+
+def test_plan_from_env_directives():
+    plan = faults.plan_from_env(
+        "a.b@2=corrupt, c.d ,e.f@3+=crash")
+    reprs = sorted(repr(f) for f in plan.faults())
+    assert reprs == ["a.b@2=corrupt", "c.d@1=error", "e.f@3+=crash"]
+
+
+def test_seeded_plan_is_reproducible():
+    sites = ["s.one", "s.two", "s.three"]
+    a = faults.FaultPlan.seeded(42, sites, n_faults=5, kinds=("error", "corrupt"))
+    b = faults.FaultPlan.seeded(42, sites, n_faults=5, kinds=("error", "corrupt"))
+    assert [repr(f) for f in a.faults()] == [repr(f) for f in b.faults()]
+    c = faults.FaultPlan.seeded(43, sites, n_faults=5, kinds=("error", "corrupt"))
+    assert [repr(f) for f in a.faults()] != [repr(f) for f in c.faults()]
+
+
+def test_inject_nesting_restores_outer_plan():
+    outer = faults.FaultPlan([])
+    inner = faults.FaultPlan([])
+    with faults.inject(outer):
+        assert faults.active_plan() is outer
+        with faults.inject(inner):
+            assert faults.active_plan() is inner
+        assert faults.active_plan() is outer
+    assert faults.active_plan() is None
+
+
+def test_duplicate_site_registration_rejected():
+    with pytest.raises(ValueError, match="duplicate fault site"):
+        faults.site(SITE.name)
+
+
+def test_assert_sites_registered_catches_typos():
+    """A typo'd site name must fail fast, not silently disarm the run
+    (CSTPU_FAULTS schedules have no in-test `plan.fired` assert)."""
+    good = faults.FaultPlan([faults.Fault(SITE.name)])
+    faults.assert_sites_registered(good)  # registered: no raise
+    typo = faults.plan_from_env("tests.chaos.unit_prob=error")  # missing 'e'
+    with pytest.raises(ValueError, match="unregistered sites"):
+        faults.assert_sites_registered(typo)
+    faults.assert_sites_registered(None)  # no plan active: no-op
+    with faults.inject(typo):
+        with pytest.raises(ValueError, match="unregistered"):
+            faults.assert_sites_registered()  # defaults to the active plan
+
+
+def test_fault_validates_inputs():
+    with pytest.raises(ValueError, match="1-based"):
+        faults.Fault("x", nth=0)
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.Fault("x", kind="explode")

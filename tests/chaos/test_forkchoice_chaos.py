@@ -1,0 +1,218 @@
+"""Fork-choice chaos: injected failures in the engine's handlers must
+leave the wrapped store and the proto-array mutually consistent — head
+parity with the spec walk across the fault, no partially-applied vote
+deltas, and a prune that failed retries on the next handler call.
+
+``COVERED_SITES`` is closed over by test_registry_complete.py.
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu import faults
+from consensus_specs_tpu.forkchoice import ForkChoiceEngine
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.helpers.attestations import (
+    get_valid_attestation,
+    sign_attestation,
+)
+from consensus_specs_tpu.testing.helpers.block import build_empty_block
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+
+F = faults.Fault
+
+COVERED_SITES = {"forkchoice.on_block", "forkchoice.batch.apply",
+                 "forkchoice.prune"}
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    """The scaffold chains are built (and must be replayed) with BLS off:
+    signature seams belong to the stf chaos suite."""
+    from consensus_specs_tpu.crypto import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+# -- shared scaffold: a one-epoch chain + attestations, BLS off ---------------
+
+_SCAFFOLD = {}
+
+
+def _scaffold():
+    """(spec, anchor_state, signed_blocks, post_states, attestations):
+    a linear chain through one epoch plus ready-to-ingest attestations
+    voting for tip blocks (signatures irrelevant: BLS off here; the stf
+    chaos suite owns the signature seams)."""
+    if not _SCAFFOLD:
+        @with_phases(["phase0"])
+        @spec_state_test
+        def build(spec, state):
+            anchor = state.copy()
+            st = state.copy()
+            blocks, posts = [], []
+            for _ in range(int(spec.SLOTS_PER_EPOCH) + 2):
+                post = st
+                block = build_empty_block(spec, post, slot=int(post.slot) + 1)
+                signed = state_transition_and_sign_block(spec, post, block)
+                blocks.append(signed)
+                posts.append(post.copy())
+            atts = []
+            for i in (len(blocks) - 3, len(blocks) - 2, len(blocks) - 1):
+                att = get_valid_attestation(
+                    spec, posts[i], slot=posts[i].slot, signed=False)
+                att.data.beacon_block_root = \
+                    blocks[i].message.hash_tree_root()
+                sign_attestation(spec, posts[i], att)
+                atts.append(att)
+            _SCAFFOLD["phase0"] = (spec, anchor, blocks, posts, atts)
+            yield None
+
+        build(phase="phase0", bls_active=False)
+    return _SCAFFOLD["phase0"]
+
+
+def _slot_time(spec, store, slot):
+    return int(store.genesis_time) + int(slot) * int(spec.config.SECONDS_PER_SLOT)
+
+
+def _fresh_engine(spec, anchor_state, blocks, upto):
+    """Engine + lockstep reference spec store, both fed ``blocks[:upto]``."""
+    anchor = spec.BeaconBlock(state_root=anchor_state.hash_tree_root())
+    engine = ForkChoiceEngine(
+        spec, spec.get_forkchoice_store(anchor_state, anchor))
+    ref = spec.get_forkchoice_store(anchor_state, anchor)
+    for sb in blocks[:upto]:
+        t = _slot_time(spec, engine.store, sb.message.slot)
+        engine.on_tick(t)
+        spec.on_tick(ref, t)
+        engine.on_block(sb)
+        spec.on_block(ref, sb)
+    # one slot past the tip so every attestation is ingestible
+    t = _slot_time(spec, engine.store, int(blocks[upto - 1].message.slot) + 1)
+    engine.on_tick(t)
+    spec.on_tick(ref, t)
+    return engine, ref
+
+
+def _assert_parity(spec, engine, ref):
+    # the spec materializes the justified checkpoint state lazily on the
+    # first matching attestation; materialize it its own way before the walk
+    spec.store_target_checkpoint_state(ref, ref.justified_checkpoint)
+    assert bytes(engine.get_head()) == bytes(spec.get_head(ref))
+    assert dict(engine.store.latest_messages) == dict(ref.latest_messages)
+
+
+def test_on_block_fault_leaves_engine_untouched():
+    """A fault at the on_block seam fires before any mutation: the store
+    and proto-array are as they were, head parity holds across the fault,
+    and redelivery succeeds."""
+    spec, anchor, blocks, _posts, _atts = _scaffold()
+    engine, ref = _fresh_engine(spec, anchor, blocks, len(blocks) - 1)
+    last = blocks[-1]
+    n_blocks, n_proto = len(engine.store.blocks), len(engine.proto)
+    with faults.inject(faults.FaultPlan([F("forkchoice.on_block")])):
+        with pytest.raises(faults.InjectedFault):
+            engine.on_block(last)
+    assert len(engine.store.blocks) == n_blocks
+    assert len(engine.proto) == n_proto
+    _assert_parity(spec, engine, ref)
+    # redelivery lands; lockstep reference agrees
+    t = _slot_time(spec, engine.store, last.message.slot)
+    engine.on_tick(t)
+    spec.on_tick(ref, t)
+    engine.on_block(last)
+    spec.on_block(ref, last)
+    _assert_parity(spec, engine, ref)
+
+
+def test_batch_apply_fault_leaves_no_partial_votes():
+    """A fault after validation/staging but before the commit: NO vote
+    lands anywhere — latest_messages unchanged, proto vote axis
+    unchanged, head parity across the fault — and the retry applies the
+    whole batch, matching the spec's sequential fold."""
+    spec, anchor, blocks, _posts, atts = _scaffold()
+    engine, ref = _fresh_engine(spec, anchor, blocks, len(blocks))
+    messages_before = dict(engine.store.latest_messages)
+    votes_before = engine.proto.vote_node.copy()
+    weights_before = list(engine.proto.weights)
+    with faults.inject(faults.FaultPlan([F("forkchoice.batch.apply")])):
+        with pytest.raises(faults.InjectedFault):
+            engine.on_attestations(atts)
+    assert dict(engine.store.latest_messages) == messages_before
+    assert np.array_equal(
+        engine.proto.vote_node[:len(votes_before)], votes_before)
+    assert list(engine.proto.weights) == weights_before
+    _assert_parity(spec, engine, ref)
+    # retry without the fault: the full batch lands, spec fold agrees
+    engine.on_attestations(atts)
+    for att in atts:
+        spec.on_attestation(ref, att)
+    _assert_parity(spec, engine, ref)
+
+
+def test_prune_fault_retries_on_next_handler():
+    """A fault at the prune seam after finalization moved: the handler
+    raises, the seen-marker does NOT advance, head parity holds on the
+    unpruned proto-array, and the next handler call retries the prune."""
+    spec, anchor_state, signed = _finalizing_chain()
+    anchor = spec.BeaconBlock(state_root=anchor_state.hash_tree_root())
+    engine = ForkChoiceEngine(
+        spec, spec.get_forkchoice_store(anchor_state, anchor))
+    ref = spec.get_forkchoice_store(anchor_state, anchor)
+
+    fault_seen = False
+    for sb in signed:
+        t = _slot_time(spec, engine.store, sb.message.slot)
+        engine.on_tick(t)
+        spec.on_tick(ref, t)
+        try:
+            with faults.inject(faults.FaultPlan([F("forkchoice.prune")])):
+                engine.on_block(sb)
+        except faults.InjectedFault:
+            # finalization moved and the prune was interrupted AFTER the
+            # store absorbed the block: the engine must still answer
+            # queries consistently (head cache was invalidated)
+            fault_seen = True
+        spec.on_block(ref, sb)
+        spec.store_target_checkpoint_state(ref, ref.justified_checkpoint)
+        assert bytes(engine.get_head()) == bytes(spec.get_head(ref))
+        if fault_seen:
+            break
+    assert fault_seen, "walk never finalized: prune seam not exercised"
+    assert engine.store.finalized_checkpoint.epoch > 0
+    n_before = len(engine.proto)
+    # any later handler retries the interrupted prune
+    engine.on_tick(int(engine.store.time) + 1)
+    spec.on_tick(ref, int(ref.time) + 1)
+    assert len(engine.proto) < n_before
+    spec.store_target_checkpoint_state(ref, ref.justified_checkpoint)
+    assert bytes(engine.get_head()) == bytes(spec.get_head(ref))
+
+
+def _finalizing_chain():
+    """(spec, genesis anchor state, signed blocks): three
+    full-participation epochs off a genesis anchor — the cheapest walk
+    whose delivery moves the store's finalized checkpoint."""
+    if "finalizing" not in _SCAFFOLD:
+        from consensus_specs_tpu.testing.helpers.attestations import (
+            next_slots_with_attestations,
+        )
+
+        @with_phases(["phase0"])
+        @spec_state_test
+        def build(spec, state):
+            anchor_state = state.copy()  # genesis: blocks chain off it
+            walk = state.copy()
+            next_epoch(spec, walk)
+            _, signed, _ = next_slots_with_attestations(
+                spec, walk, int(spec.SLOTS_PER_EPOCH) * 3, True, True)
+            _SCAFFOLD["finalizing"] = (spec, anchor_state, signed)
+            yield None
+
+        build(phase="phase0", bls_active=False)
+    return _SCAFFOLD["finalizing"]

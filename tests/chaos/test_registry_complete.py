@@ -1,0 +1,56 @@
+"""Fault-site registry completeness gate.
+
+Every production fault site (registered via ``faults.site`` at module
+import) must be exercised by at least one chaos case — a new site
+without a chaos case turns this red, exactly like an analyzer finding.
+Coverage claims are the static ``COVERED_SITES`` tables of the chaos
+modules, so the gate holds regardless of which subset of tests a run
+selects.
+"""
+import pytest
+
+from consensus_specs_tpu import faults
+
+# importing the instrumented modules registers their sites
+import consensus_specs_tpu.forkchoice.engine  # noqa: F401
+import consensus_specs_tpu.stf.engine  # noqa: F401
+
+from . import test_forkchoice_chaos, test_stf_chaos
+
+
+def _production_sites():
+    """Registered sites, minus the probes test modules register for the
+    fault machinery's own unit tests."""
+    return {name for name in faults.registry() if not name.startswith("tests.")}
+
+
+def test_every_site_has_a_chaos_case():
+    registered = _production_sites()
+    covered = (set(test_stf_chaos.COVERED_SITES)
+               | set(test_forkchoice_chaos.COVERED_SITES))
+    missing = registered - covered
+    assert not missing, (
+        f"fault sites with no chaos case: {sorted(missing)} — add a case to "
+        "tests/chaos/ (COVERED_SITES) exercising each new probe")
+    phantom = covered - registered
+    assert not phantom, (
+        f"chaos cases claim unregistered sites: {sorted(phantom)} — typo in "
+        "a case table, or a probe was removed without its cases")
+
+
+def test_registry_depth_meets_the_acceptance_floor():
+    """ISSUE 5 acceptance: >= 12 distinct sites across the chaos
+    schedules.  The deterministic case tables alone must clear the bar —
+    random schedules are extra, not load-bearing."""
+    deterministic = {
+        f.site for case in (test_stf_chaos._PHASE0_CASES
+                            + test_stf_chaos._ALTAIR_CASES) for f in case}
+    assert len(deterministic) >= 12, sorted(deterministic)
+    assert len(_production_sites()) >= 12
+
+
+def test_site_names_are_unique_and_dotted():
+    for name in _production_sites():
+        assert "." in name, f"site {name!r} is not a dotted path"
+    with pytest.raises(ValueError, match="duplicate"):
+        faults.site("stf.engine.header")

@@ -1,0 +1,395 @@
+"""Chaos differential suite: seeded block walks under fault schedules.
+
+Adversarial proof of the engine's containment contracts (ISSUE 5):
+
+* **root parity under faults** — a seeded walk replayed through
+  ``stf.apply_signed_blocks`` while a ``FaultPlan`` fires errors,
+  simulated backend crashes, and value corruptions at registered sites
+  must land byte-identical post-state roots to a clean literal
+  ``spec.state_transition`` replay, block by block;
+
+* **post-fault cache coherence** — after the faulted run, a fault-free
+  re-run over the SAME process-global caches (committee contexts,
+  proposer walks, sync seat rows, verified-triple memo) must take the
+  fast path on every block (``replayed_blocks == 0``) with identical
+  roots: a fault may cost a replay, it may never strand a poisoned or
+  half-built cache entry;
+
+* **exception parity under faults** — a genuinely-invalid block must
+  raise the literal spec's exception (type + message) and leave the
+  state byte-identically poisoned even when faults fire around it;
+
+* **circuit breaker** — a deterministic demote → skip → probe → recover
+  cycle, with the counters in ``engine.stats`` pinned, including breaker
+  state persisting across ``apply_signed_blocks`` calls;
+
+* **native degradation** — a simulated native-backend crash mid-batch
+  settles the in-flight block through the pure-Python oracle, marks the
+  backend degraded (one-time warning), demotes later blocks to the
+  literal replay, and recovers after ``verify.reset_degraded()``.
+
+``COVERED_SITES`` (closed over by test_registry_complete.py) is the
+static claim of which fault sites this module exercises.
+"""
+import contextlib
+
+import pytest
+
+from consensus_specs_tpu import faults, stf
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.stf import attestations as stf_attestations
+from consensus_specs_tpu.stf import engine as stf_engine
+from consensus_specs_tpu.stf import verify as stf_verify
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.helpers.attestations import (
+    next_slots_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testing.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testing.helpers.sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+
+# -- corpora: one seeded walk per fork, literal-replay roots as the oracle ----
+
+_CORPUS = {}
+
+
+def _build_phase0(spec, state):
+    next_epoch(spec, state)
+    pre = state.copy()
+    _, signed, _ = next_slots_with_attestations(
+        spec, state.copy(), int(spec.SLOTS_PER_EPOCH) + 2, True, True)
+    return pre, signed
+
+
+def _build_altair(spec, state):
+    next_epoch(spec, state)
+    pre = state.copy()
+    walk = state.copy()
+    signed = []
+    # two sync-aggregate-bearing blocks (full + partial participation)
+    # ahead of the attestation walk: every sync seam is in scope
+    for participation in (lambda i: True, lambda i: i % 2 == 0):
+        block = build_empty_block_for_next_slot(spec, walk)
+        committee_indices = compute_committee_indices(spec, walk)
+        bits = [participation(i) for i in range(len(committee_indices))]
+        participants = [v for i, v in enumerate(committee_indices) if bits[i]]
+        block.body.sync_aggregate = spec.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=compute_aggregate_sync_committee_signature(
+                spec, walk, block.slot - 1, participants))
+        signed.append(state_transition_and_sign_block(spec, walk, block))
+    _, more, _ = next_slots_with_attestations(
+        spec, walk, int(spec.SLOTS_PER_EPOCH), True, True)
+    return pre, signed + list(more)
+
+
+def _corpus(fork):
+    """(spec, pre_state, signed_blocks, per-block literal roots) for the
+    fork's seeded walk — built once, signed with BLS ON, replayed through
+    the literal spec for the oracle roots."""
+    if fork not in _CORPUS:
+        @with_phases([fork])
+        @spec_state_test
+        def build(spec, state):
+            pre, signed = (_build_altair if fork == "altair"
+                           else _build_phase0)(spec, state)
+            s = pre.copy()
+            roots = []
+            for sb in signed:
+                spec.state_transition(s, sb, True)
+                roots.append(bytes(s.hash_tree_root()))
+            _CORPUS[fork] = (spec, pre, signed, roots)
+            yield None
+
+        build(phase=fork)  # DEFAULT_BLS_ACTIVE: signatures are real
+    return _CORPUS[fork]
+
+
+# -- runners ------------------------------------------------------------------
+
+
+def _fresh_engine_env():
+    """Cold caches + re-armed breaker + cleared degradation: each case
+    owns its failure story from the first block."""
+    stf.reset_stats()
+    stf_verify.reset_memo()
+    stf_verify.reset_degraded()
+    stf_attestations.reset_caches()
+
+
+def _engine_replay(spec, pre, blocks, roots, plan=None):
+    """Apply ``blocks`` through the engine (BLS on), optionally under a
+    fault plan, asserting per-block root parity with the literal oracle."""
+    s = pre.copy()
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        ctx = faults.inject(plan) if plan is not None else _null()
+        with ctx:
+            for i, sb in enumerate(blocks):
+                stf.apply_signed_blocks(spec, s, [sb], True)
+                assert bytes(s.hash_tree_root()) == roots[i], \
+                    f"diverged from literal replay at block {i}"
+    finally:
+        bls.bls_active = prev
+    return s
+
+
+@contextlib.contextmanager
+def _null():
+    yield
+
+
+def _run_case(fork, case_faults, expect_fired=True):
+    spec, pre, blocks, roots = _corpus(fork)
+    _fresh_engine_env()
+    plan = faults.FaultPlan(case_faults)
+    _engine_replay(spec, pre, blocks, roots, plan)
+    if expect_fired:
+        assert plan.fired, f"schedule never fired: {case_faults}"
+    # post-fault cache coherence: SAME caches/memo, fresh counters +
+    # re-armed breaker — the fast path must carry every block
+    stf.reset_stats()
+    stf_verify.reset_degraded()
+    _engine_replay(spec, pre, blocks, roots, plan=None)
+    assert stf.stats["replayed_blocks"] == 0, \
+        f"poisoned cache after faults: {stf.stats['replay_reasons']}"
+    assert stf.stats["fast_blocks"] == len(blocks)
+    return plan
+
+
+# -- deterministic per-site cases ---------------------------------------------
+
+F = faults.Fault
+
+_PHASE0_CASES = [
+    [F("stf.slot_roots.process", nth=2)],
+    [F("stf.engine.header", nth=3)],
+    [F("stf.engine.randao", nth=2)],
+    [F("stf.engine.operations", nth=4)],
+    [F("stf.engine.state_root", nth=2, kind="corrupt")],
+    [F("stf.engine.native_gate", nth=3, kind="corrupt")],
+    [F("stf.engine.cache_commit", nth=2)],
+    [F("stf.attestations.resolve", nth=1)],
+    [F("stf.attestations.affine_rows", nth=2, kind="corrupt")],
+    [F("stf.verify.native_call", nth=2)],
+    [F("stf.verify.memo_commit", nth=1)],
+    # corrupted member coordinates force the batch down the bisection
+    # walk, where the second fault lands mid-bisection
+    [F("stf.attestations.affine_rows", nth=1, kind="corrupt"),
+     F("stf.verify.bisect", nth=1)],
+]
+
+_ALTAIR_CASES = [
+    [F("stf.engine.mirror_read", nth=1, kind="corrupt")],
+    [F("stf.engine.mirror_flush", nth=1)],
+    [F("stf.sync.rows_memo", nth=1, kind="corrupt")],
+    [F("stf.sync.rewards", nth=2)],
+    [F("stf.engine.state_root", nth=1)],
+]
+
+_EXTRA_SITES = {"stf.verify.native_call", "stf.engine.operations",
+                "stf.attestations.affine_rows"}  # breaker/degrade/parity tests
+
+COVERED_SITES = (
+    {f.site for case in _PHASE0_CASES + _ALTAIR_CASES for f in case}
+    | _EXTRA_SITES)
+
+
+@pytest.mark.parametrize(
+    "case", _PHASE0_CASES, ids=[repr(c[-1]) for c in _PHASE0_CASES])
+def test_chaos_site_phase0(case):
+    _run_case("phase0", case)
+
+
+@pytest.mark.parametrize(
+    "case", _ALTAIR_CASES, ids=[repr(c[-1]) for c in _ALTAIR_CASES])
+def test_chaos_site_altair(case):
+    _run_case("altair", case)
+
+
+# -- seeded random schedules --------------------------------------------------
+
+_RANDOM_SITES = sorted(
+    {f.site for case in _PHASE0_CASES + _ALTAIR_CASES for f in case})
+
+
+@pytest.mark.parametrize("fork,seed", [
+    ("phase0", 1009), ("phase0", 2027), ("altair", 3049), ("altair", 4057)])
+def test_chaos_random_schedule(fork, seed):
+    """Seeded random schedules over every instrumented stf site: whatever
+    fires (error or corruption, any hit), parity and cache coherence must
+    hold.  A schedule that happens not to fire still asserts the clean
+    contract."""
+    plan = faults.FaultPlan.seeded(
+        seed, _RANDOM_SITES, n_faults=4, max_nth=6, kinds=("error", "corrupt"))
+    _run_case(fork, plan.faults(), expect_fired=False)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fork,seed", [
+    ("phase0", 5081), ("phase0", 6091), ("altair", 7103), ("altair", 8117)])
+def test_chaos_random_schedule_deep(fork, seed):
+    """Denser random schedules (more faults, later hits) — the heavy tail
+    of the same contract, slow-marked for the tier-1 budget."""
+    plan = faults.FaultPlan.seeded(
+        seed, _RANDOM_SITES, n_faults=8, max_nth=12,
+        kinds=("error", "corrupt"))
+    _run_case(fork, plan.faults(), expect_fired=False)
+
+
+# -- exception parity under faults --------------------------------------------
+
+
+def _capture(fn, *args):
+    try:
+        fn(*args)
+    except Exception as e:  # noqa: B001 - parity harness captures anything
+        return e
+    return None
+
+
+@pytest.mark.parametrize("tamper,fault", [
+    ("state_root", F("stf.engine.operations", nth=3)),
+    ("agg_signature", F("stf.attestations.affine_rows", nth=1, kind="corrupt")),
+], ids=["bad-state-root+operations-error", "bad-agg-sig+affine-corrupt"])
+def test_chaos_exception_parity(tamper, fault):
+    """A genuinely-invalid block inside a faulted walk: the engine must
+    raise the literal spec's exact exception and leave the state
+    byte-identically poisoned, faults or no faults."""
+    spec, pre, blocks, _ = _corpus("phase0")
+    good, bad = blocks[:2], blocks[2].copy()
+    if tamper == "state_root":
+        bad.message.state_root = spec.Root(b"\x5a" * 32)
+    else:
+        bad.message.body.attestations[0].signature = \
+            spec.BLSSignature(b"\x33" * 96)
+
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        s_spec = pre.copy()
+        for sb in good:
+            spec.state_transition(s_spec, sb, True)
+        exc_spec = _capture(spec.state_transition, s_spec, bad, True)
+
+        _fresh_engine_env()
+        s_eng = pre.copy()
+        with faults.inject(faults.FaultPlan([fault])):
+            stf.apply_signed_blocks(spec, s_eng, good, True)
+            exc_eng = _capture(stf.apply_signed_blocks, spec, s_eng, [bad], True)
+    finally:
+        bls.bls_active = prev
+
+    assert exc_spec is not None, "scenario was supposed to be invalid"
+    assert type(exc_spec) is type(exc_eng), (exc_spec, exc_eng)
+    assert str(exc_spec) == str(exc_eng), (exc_spec, exc_eng)
+    assert bytes(s_spec.hash_tree_root()) == bytes(s_eng.hash_tree_root()), \
+        "poisoned post-states diverged"
+
+
+# -- circuit breaker: demote -> skip -> probe -> recover ----------------------
+
+
+def test_breaker_demote_probe_recover(monkeypatch):
+    """Three consecutive injected fast-path errors trip the breaker; the
+    next blocks replay literally WITHOUT attempting the fast path; the
+    probe block re-attempts, succeeds, and closes the breaker."""
+    monkeypatch.setattr(stf_engine, "BREAKER_PROBE_INTERVAL", 3)
+    spec, pre, blocks, roots = _corpus("phase0")
+    _fresh_engine_env()
+    plan = faults.FaultPlan(
+        [F("stf.engine.operations", nth=n) for n in (1, 2, 3)])
+    _engine_replay(spec, pre, blocks, roots, plan)
+    st = stf.stats
+    assert st["breaker_trips"] == 1
+    assert st["breaker_state"] == "closed"  # recovered by the probe
+    assert st["breaker_probes"] == 1
+    assert st["breaker_skipped"] == 2      # blocks 4-5 skipped, 6 probed
+    assert st["fast_path_errors"] == 3
+    assert st["fast_blocks"] == len(blocks) - 5
+    assert st["replayed_blocks"] == 5
+    assert st["replay_reasons"] == {"InjectedFault": 3, "breaker_open": 2}
+
+
+def test_breaker_failed_probe_stays_open(monkeypatch):
+    """A probe that fails keeps the breaker open and restarts the skip
+    countdown; the following probe recovers."""
+    monkeypatch.setattr(stf_engine, "BREAKER_PROBE_INTERVAL", 3)
+    spec, pre, blocks, roots = _corpus("phase0")
+    _fresh_engine_env()
+    plan = faults.FaultPlan(
+        [F("stf.engine.operations", nth=n) for n in (1, 2, 3, 4)])
+    _engine_replay(spec, pre, blocks, roots, plan)
+    st = stf.stats
+    # blocks 1-3 error, 4-5 skip, 6 probes and errors (hit 4), 7-8 skip,
+    # 9 probes clean, 10 fast
+    assert st["breaker_trips"] == 1
+    assert st["breaker_probes"] == 2
+    assert st["breaker_skipped"] == 4
+    assert st["fast_path_errors"] == 4
+    assert st["breaker_state"] == "closed"
+    assert st["fast_blocks"] == 2
+
+
+def test_breaker_state_persists_across_calls(monkeypatch):
+    """An open breaker carries over between ``apply_signed_blocks`` calls
+    (it is engine state, not per-call state) and is visible in
+    ``engine.stats`` while open."""
+    monkeypatch.setattr(stf_engine, "BREAKER_PROBE_INTERVAL", 3)
+    spec, pre, blocks, roots = _corpus("phase0")
+    _fresh_engine_env()
+    plan = faults.FaultPlan(
+        [F("stf.engine.operations", nth=n) for n in (1, 2, 3)])
+    s = pre.copy()
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        with faults.inject(plan):
+            stf.apply_signed_blocks(spec, s, blocks[:4], True)
+        assert stf.stats["breaker_state"] == "open"
+        assert stf.stats["breaker_skipped"] == 1
+        # later call, no faults: countdown continues, probe recovers
+        stf.apply_signed_blocks(spec, s, blocks[4:], True)
+    finally:
+        bls.bls_active = prev
+    assert bytes(s.hash_tree_root()) == roots[-1]
+    assert stf.stats["breaker_state"] == "closed"
+    assert stf.stats["breaker_probes"] == 1
+
+
+# -- native-backend degradation ladder ----------------------------------------
+
+
+def test_native_crash_degrades_and_recovers():
+    """A simulated native crash mid-batch: the in-flight block settles
+    through the pure-Python oracle (run survives, one-time warning),
+    later blocks demote to the literal replay, and after an operator
+    reset the fast path returns."""
+    spec, pre, blocks, roots = _corpus("phase0")
+    subset, subroots = blocks[:3], roots[:3]
+    _fresh_engine_env()
+    plan = faults.FaultPlan([F("stf.verify.native_call", nth=1, kind="crash")])
+    with pytest.warns(RuntimeWarning, match="degraded to pure-Python"):
+        _engine_replay(spec, pre, subset, subroots, plan)
+    assert stf_verify.native_degraded()
+    assert stf_verify.stats["native_degraded"] == 1
+    # block 1 still settled FAST (python fallback inside the batch);
+    # blocks 2-3 were gated to the literal replay by the degraded mark
+    assert stf.stats["fast_blocks"] == 1
+    assert stf.stats["replayed_blocks"] == 2
+    assert stf.stats["replay_reasons"] == {"FastPathViolation": 2}
+    # recovery: reset, and the same walk is all-fast again
+    stf.reset_stats()
+    stf_verify.reset_degraded()
+    _engine_replay(spec, pre, subset, subroots)
+    assert stf.stats["fast_blocks"] == 3
+    assert stf.stats["replayed_blocks"] == 0

@@ -209,3 +209,59 @@ def test_msm_abi_tag_tracks_table_serialization(monkeypatch):
     monkeypatch.setattr(kzg, "_MSM_ABI_TAG", None)
     assert kzg._msm_abi_tag(nat) == real  # cache rebuilt, stable
     assert alien != real
+
+
+def _table_path_and_flat(tmp_path, monkeypatch):
+    """(nat, flat, path): the disk-cache path for a tiny setup, redirected
+    into ``tmp_path`` so corruption scenarios never touch the real tree."""
+    nat = kzg._native_mod()
+    if nat is None:
+        pytest.skip("native backend unavailable")
+    setup = kzg.setup_lagrange(4)
+    flat = kzg._points_affine_bytes(setup)
+    real_path = kzg._fixed_table_path(nat, flat)
+    import os
+    path = str(tmp_path / os.path.basename(real_path))
+    monkeypatch.setattr(kzg, "_fixed_table_path", lambda _nat, _flat: path)
+    return nat, flat, path
+
+
+def test_msm_table_truncated_file_regenerates(tmp_path, monkeypatch):
+    """ISSUE 5 satellite: a truncated cache file (torn write that made it
+    to disk, process killed mid-write on a pre-atomic layout) fails the
+    length check and is regenerated in place — never fed to the C side."""
+    import os
+    nat, flat, path = _table_path_and_flat(tmp_path, monkeypatch)
+    table = kzg._load_or_build_fixed_table(nat, flat)
+    assert os.path.exists(path)
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 2])  # truncate: torn write survivor
+    again = kzg._load_or_build_fixed_table(nat, flat)
+    assert again == table
+    with open(path, "rb") as f:
+        assert f.read() == data  # the damaged file was repaired on disk
+
+
+def test_msm_table_corrupted_payload_regenerates(tmp_path, monkeypatch):
+    """A right-sized file whose payload was damaged (bit rot, torn write
+    across preallocated blocks) fails the trailing-SHA256 check and is
+    regenerated; the rebuilt table round-trips through G1MSMFixed."""
+    import os
+    nat, flat, path = _table_path_and_flat(tmp_path, monkeypatch)
+    table = kzg._load_or_build_fixed_table(nat, flat)
+    with open(path, "r+b") as f:
+        f.seek(7)
+        byte = f.read(1)
+        f.seek(7)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    again = kzg._load_or_build_fixed_table(nat, flat)
+    assert again == table
+    # the repaired table feeds the C side (entry-0 on-curve backstop holds)
+    n = len(flat) // 96
+    scalars = b"".join(int(i + 1).to_bytes(32, "big") for i in range(n))
+    assert nat.G1MSMFixed(again, n, scalars) == nat.G1MSM(flat, scalars)
+    # no stray temp files left behind by the rebuild-and-replace path
+    leftovers = [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+    assert leftovers == []
