@@ -177,5 +177,5 @@ def mxu_mul_ints(x: int, y: int) -> int:
     """End-to-end x*y mod p through the device path (test hook)."""
     a = jnp.asarray(host_to_mont(x)[None], dtype=jnp.int8)
     b = jnp.asarray(host_to_mont(y)[None], dtype=jnp.int8)
-    out = np.asarray(_jit_mxu_mul(a, b))[0]
+    out = np.asarray(_jit_mxu_mul(a, b))[0]  # host-sync: test hook pulls the single product back
     return host_from_mont(out) % P_INT

@@ -216,7 +216,7 @@ def final_exp_is_one_traced(f):
 
 def final_exp_is_one(f):
     """final_exponentiation(f) == 1, via f^(3*(p^12-1)/r) == 1."""
-    return np.asarray(final_exp_is_one_traced(f))
+    return np.asarray(final_exp_is_one_traced(f))  # host-sync: pairing verdict readback
 
 
 def pairs_product_is_one(px, py, qx, qy) -> np.ndarray:

@@ -285,6 +285,8 @@ def attestation_deltas(inp: DeltaInputs):
         put(pad(inp.incl_proposer)),
         put(scalars),
     )
+    # host-sync: staged view — the one pull-back of the epoch kernel's
+    # outputs; ROADMAP item 3 (device-resident columns) retires it
     return np.asarray(rewards)[:n], np.asarray(penalties)[:n]
 
 

@@ -266,10 +266,11 @@ def sharded_ntt(values: Sequence[int], mesh, axis_name: str = None) -> List[int]
         # small result rows instead of materializing non-addressable shards
         from jax.experimental import multihost_utils
 
+        # host-sync: cross-process gather of the small NTT result rows
         out = np.asarray(multihost_utils.process_allgather(
             out_arr, tiled=True))
     else:
-        out = np.asarray(out_arr)
+        out = np.asarray(out_arr)  # host-sync: NTT result rows return to the int pipeline
 
     result = [0] * n
     for k2 in range(d):

@@ -144,6 +144,7 @@ class ResidentPackedU64List:
     def contents_subtree_root(self) -> bytes:
         """Root of the real-data subtree (padded to its power of two)."""
         assert self._lo is not None, "upload() before reading roots"
+        # host-sync: staged view — the resident tree's single root readback
         out = np.asarray(_jit_reduce(self._lo, self._hi))
         return out.astype(">u4").tobytes()
 
@@ -252,6 +253,8 @@ def fused_epoch_balance_update(inp, balances: np.ndarray, device):
         put(scalars),
     )
     stats["fused_epoch_updates"] += 1
+    # host-sync: staged view — fused-update outputs (new balances + root)
+    # pulled once per epoch; ROADMAP item 3 keeps balances resident
     return (np.asarray(new_bal)[:n],
             np.asarray(root_words).astype(">u4").tobytes())
 

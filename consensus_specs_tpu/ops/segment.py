@@ -41,6 +41,7 @@ def segment_sum(values: np.ndarray, segment_ids: np.ndarray,
 
         jax.config.update("jax_enable_x64", True)
         _jaxcache.configure()
+        # host-sync: staged view — callers consume segment counts on host
         return np.asarray(jax.ops.segment_sum(
             jnp.asarray(values), jnp.asarray(segment_ids),
             num_segments=num_segments))

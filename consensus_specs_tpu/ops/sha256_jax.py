@@ -124,7 +124,7 @@ def hash_blocks_u32(words: np.ndarray) -> np.ndarray:
     n_pad = _next_pow2(n)  # pad lanes to powers of two to bound recompiles
     if n_pad != n:
         words = np.vstack([words, np.zeros((n_pad - n, 16), dtype=np.uint32)])
-    out = np.asarray(_jit_block64(jnp.asarray(words)))
+    out = np.asarray(_jit_block64(jnp.asarray(words)))  # host-sync: digest batch returns to the byte pipeline
     return out[:n]
 
 
@@ -193,7 +193,7 @@ def hash_waves_u32(known: np.ndarray, waves) -> np.ndarray:
     lefts = tuple(jnp.asarray(w[0]) for w in waves)
     rights = tuple(jnp.asarray(w[1]) for w in waves)
     out = _jit_run_waves(jnp.asarray(known), lefts, rights)
-    return np.asarray(out)
+    return np.asarray(out)  # host-sync: wave digests return to the byte pipeline
 
 
 def hash_waves(known: List[bytes], waves) -> List[bytes]:

@@ -112,7 +112,7 @@ def sharded_batch_fast_aggregate_verify(
             px[k, b], py[k, b] = _g1_coords(p)
             qx[k, b], qy[k, b] = _g2_coords(q)
     check = make_sharded_pairs_check(mesh)
-    verdicts = np.asarray(check(px, py, qx, qy))
+    verdicts = np.asarray(check(px, py, qx, qy))  # host-sync: per-block verdicts readback
     for (b, _), v in zip(clean, verdicts[:n]):
         results[b] = bool(v)
     return results

@@ -1,6 +1,8 @@
-"""Incremental cache: findings are keyed by content hash — editing one
-file re-analyzes only that file, and a rule-source change drops the
-whole cache (version digest)."""
+"""Incremental cache: findings are keyed by content hash AND the shas of
+the file's call-graph fan-in — editing one file re-analyzes it plus its
+dependents (nothing else), override/subset runs consult the cache
+read-only, and a rule-source change drops the whole cache (version
+digest)."""
 import analysis
 from analysis import run
 from analysis.cachefile import AnalysisCache
@@ -54,14 +56,111 @@ def test_rule_subset_runs_never_poison_the_cache(tmp_path):
     assert {f.code for f in full.findings} == {"F401", "W291"}
 
 
+def _dep_tree(tmp_path):
+    """helper.py <- user.py (cross-file DT01 evidence), other.py alone."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "helper.py").write_text(
+        "import numpy as np\n"
+        "def total_of(values):\n"
+        "    return np.sum(values, dtype=np.uint64)\n")
+    (pkg / "user.py").write_text(
+        "from pkg.helper import total_of\n"
+        "def f(balances):\n"
+        "    return total_of(balances)\n")
+    (pkg / "other.py").write_text("x = 1\n")
+    return pkg
+
+
+def test_editing_a_leaf_helper_reanalyzes_its_dependents(tmp_path):
+    pkg = _dep_tree(tmp_path)
+    assert _run(tmp_path).findings == []
+    assert _run(tmp_path).cache_hits == 3  # warm
+    # drop the guard in the LEAF: user.py's bytes are untouched, but its
+    # finding set changes — the dependency digest must force the miss
+    (pkg / "helper.py").write_text(
+        "import numpy as np\n"
+        "def total_of(values):\n"
+        "    return np.sum(values)\n")
+    third = _run(tmp_path)
+    assert third.cache_hits == 1  # other.py alone came from the cache
+    assert [(f.file, f.code) for f in third.findings] == \
+        [("pkg/user.py", "DT01")]
+    # and the re-derived result is itself cached
+    fourth = _run(tmp_path)
+    assert fourth.cache_hits == 3
+    assert [(f.file, f.code) for f in fourth.findings] == \
+        [("pkg/user.py", "DT01")]
+
+
+def test_override_runs_consult_the_cache_for_untouched_files(tmp_path):
+    pkg = _dep_tree(tmp_path)
+    _run(tmp_path)  # seed
+    unguarded = (pkg / "helper.py").read_text().replace(
+        ", dtype=np.uint64", "")
+    mutated = run([tmp_path], root=tmp_path,
+                  cache_path=tmp_path / "cache.json",
+                  baseline_path=tmp_path / "missing-baseline.json",
+                  overrides={"pkg/helper.py": unguarded})
+    # other.py came from the cache; helper.py (overridden) and user.py
+    # (its dependent) re-analyzed with the hypothetical content
+    assert mutated.cache_hits == 1
+    assert [(f.file, f.code) for f in mutated.findings] == \
+        [("pkg/user.py", "DT01")]
+    # read-only: the real tree is still fully warm and clean afterwards
+    after = _run(tmp_path)
+    assert after.cache_hits == 3
+    assert after.findings == []
+
+
+def test_path_scoped_runs_keep_the_whole_project_graph(tmp_path):
+    # ``python tools/lint.py <path>`` must not lose cross-file facts:
+    # pass 1 widens to the default roots, pass 2 reports only the
+    # requested paths — and the cache digests match a full run's
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "helper.py").write_text(
+        "import numpy as np\n"
+        "def total_of(values):\n"
+        "    return np.sum(values)\n")
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "user.py").write_text(
+        "from tools.helper import total_of\n"
+        "def f(balances):\n"
+        "    return total_of(balances)\n")
+    scoped = run([tmp_path / "tests"], root=tmp_path,
+                 cache_path=tmp_path / "cache.json",
+                 baseline_path=tmp_path / "missing-baseline.json")
+    assert scoped.n_files == 1  # only the requested path is reported...
+    assert [(f.file, f.code) for f in scoped.findings] == \
+        [("tests/user.py", "DT01")]  # ...with out-of-root callee facts
+    full = run([tmp_path], root=tmp_path,
+               cache_path=tmp_path / "cache.json",
+               baseline_path=tmp_path / "missing-baseline.json")
+    assert full.cache_hits == 1  # the scoped entry is full-run-compatible
+
+
+def test_subset_runs_consult_a_warm_cache(tmp_path):
+    _tree(tmp_path)
+    full = _run(tmp_path)
+    assert {f.code for f in full.findings} == {"F401", "W291"}
+    subset = run([tmp_path], root=tmp_path,
+                 cache_path=tmp_path / "cache.json",
+                 baseline_path=tmp_path / "missing-baseline.json",
+                 rules=analysis.all_rules(codes=["W291"]))
+    assert subset.cache_hits == 3  # filtered from cached full-registry runs
+    assert {f.code for f in subset.findings} == {"W291"}
+
+
 def test_version_change_drops_cache(tmp_path):
     _tree(tmp_path)
     cache_file = tmp_path / "cache.json"
     c1 = AnalysisCache(cache_file, version="v1")
-    c1.put("a.py", "sha", [])
+    c1.put_findings("a.py", "sha", "deps", [])
     c1.save()
-    assert AnalysisCache(cache_file, version="v1").get("a.py", "sha") == []
-    assert AnalysisCache(cache_file, version="v2").get("a.py", "sha") is None
+    assert AnalysisCache(cache_file, version="v1").get_findings(
+        "a.py", "sha", "deps") == []
+    assert AnalysisCache(cache_file, version="v2").get_findings(
+        "a.py", "sha", "deps") is None
 
 
 def test_overlapping_roots_do_not_double_report(tmp_path):

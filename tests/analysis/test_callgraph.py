@@ -1,0 +1,198 @@
+"""Pass 1 + project graph: file summaries, fixed-point fact
+propagation, and the dependency closure the incremental cache keys on."""
+from analysis.callgraph import (FileSummary, absolutize, anchor_for,
+                                module_name_for, summarize)
+from analysis.dataflow import build_project
+
+import ast
+
+
+def _summ(display, src):
+    return summarize(display, ast.parse(src))
+
+
+def test_module_name_for():
+    assert module_name_for("a/b/c.py") == "a.b.c"
+    assert module_name_for("a/b/__init__.py") == "a.b"
+    assert module_name_for("bench.py") == "bench"
+
+
+def test_absolutize_relative_imports():
+    assert absolutize(".attestations.f", "pkg.stf.sync") == \
+        "pkg.stf.attestations.f"
+    assert absolutize("..ops.segment.g", "pkg.stf.sync") == \
+        "pkg.ops.segment.g"
+    assert absolutize("numpy.sum", "pkg.stf.sync") == "numpy.sum"
+    assert absolutize(None, "pkg.stf.sync") is None
+
+
+def test_anchor_for_packages_absolutizes_against_the_package_itself():
+    # ``from . import shuffle`` inside a/b/__init__.py means a.b.shuffle
+    s = _summ("a/b/__init__.py", "from . import shuffle\n")
+    assert s.imports["shuffle"] == "a.b.shuffle"
+    assert anchor_for("a/b/c.py") == "a.b.c"
+
+
+def test_summary_collects_imports_functions_and_flows():
+    src = (
+        "import numpy as np\n"
+        "import pkg.ops.shuffle\n"
+        "from .attestations import _fifo_put\n"
+        "def wrap(balances, k):\n"
+        "    total = np.sum(balances)\n"
+        "    _fifo_put(CACHE, k, total)\n"
+        "    return helper(k)\n"
+        "def helper(k):\n"
+        "    return k\n")
+    s = _summ("pkg/stf/sync.py", src)
+    assert s.module == "pkg.stf.sync"
+    assert s.imports["_fifo_put"] == "pkg.stf.attestations._fifo_put"
+    assert s.imports["pkg.ops.shuffle"] == "pkg.ops.shuffle"  # plain import
+    w = s.functions["wrap"]
+    assert w.params == ["balances", "k"]
+    assert "pkg.stf.attestations._fifo_put" in w.calls
+    assert w.return_calls == ["pkg.stf.sync.helper"]  # local fully qualified
+    assert w.reduce_params == ["balances"]  # np.sum with no dtype kwarg
+    assert ["pkg.stf.attestations._fifo_put", 1,
+            ["k"]] in w.arg_flows
+    # guarded reduction contributes no reduce fact
+    s2 = _summ("pkg/stf/sync.py",
+               src.replace("np.sum(balances)",
+                           "np.sum(balances, dtype=np.uint64)"))
+    assert s2.functions["wrap"].reduce_params == []
+
+
+def test_summary_json_roundtrip():
+    s = _summ("pkg/stf/sync.py",
+              "import numpy as np\n"
+              "def f(x):\n"
+              "    return np.sum(x)\n")
+    assert FileSummary.from_json(s.to_json()) == s
+
+
+def test_device_residency_propagates_through_return_chains():
+    files = {
+        "pkg/ops/a.py": ("import jax.numpy as jnp\n"
+                         "def leaf(x):\n"
+                         "    return jnp.asarray(x)\n"),
+        "pkg/ops/b.py": ("from pkg.ops.a import leaf\n"
+                         "def mid(x):\n"
+                         "    return leaf(x)\n"),
+        "pkg/ops/c.py": ("from pkg.ops.b import mid\n"
+                         "def top(x):\n"
+                         "    return mid(x)\n"
+                         "def host(x):\n"
+                         "    return [mid(x)[0] * 0]\n"),
+    }
+    p = build_project(files)
+    for key in ("pkg.ops.a.leaf", "pkg.ops.b.mid", "pkg.ops.c.top"):
+        assert key in p.device_fns, key
+    assert "pkg.ops.c.host" not in p.device_fns  # list wrap: not a view
+    assert p.returns_device("pkg/ops/c.py", "mid")
+    assert p.returns_device("pkg/ops/c.py", "jax.device_put")
+    assert not p.returns_device("pkg/ops/c.py", "jax.device_count")
+
+
+def test_producer_passthrough_is_tracked_across_files():
+    files = {
+        "consensus_specs_tpu/ops/epoch_jax.py": (
+            "_COLS_CACHE = {}\n"
+            "def registry_columns(spec, state):\n"
+            "    return _COLS_CACHE.setdefault(id(state), {})\n"),
+        "consensus_specs_tpu/ops/view.py": (
+            "from consensus_specs_tpu.ops.epoch_jax import registry_columns\n"
+            "def cols_view(spec, state):\n"
+            "    return registry_columns(spec, state)\n"),
+    }
+    p = build_project(files)
+    assert p.producer_behind(
+        "consensus_specs_tpu/ops/view.py", "cols_view") == \
+        "consensus_specs_tpu.ops.epoch_jax.registry_columns"
+    assert p.producer_behind(
+        "consensus_specs_tpu/ops/view.py", "unrelated") is None
+
+
+def test_staging_routers_and_raw_inserters():
+    files = {
+        "consensus_specs_tpu/stf/helper.py": (
+            "_VERIFIED_MEMO = {}\n"
+            "def raw_put(k, v):\n"
+            "    _VERIFIED_MEMO[k] = v\n"),
+        "consensus_specs_tpu/stf/wrapper.py": (
+            "from consensus_specs_tpu.stf.helper import raw_put\n"
+            "def wraps(k, v):\n"
+            "    raw_put(k, v)\n"),
+        "consensus_specs_tpu/stf/routed.py": (
+            "from consensus_specs_tpu.stf import staging\n"
+            "from consensus_specs_tpu.stf.helper import raw_put\n"
+            "def good(k, v):\n"
+            "    staging.note_insert({}, k)\n"
+            "    raw_put(k, v)\n"),
+    }
+    p = build_project(files)
+    assert p.raw_inserts_of("consensus_specs_tpu/stf/wrapper.py",
+                            "raw_put") == {"_VERIFIED_MEMO"}
+    # the wrapper transitively raw-inserts; the staging router does not
+    assert "consensus_specs_tpu.stf.wrapper.wraps" in p.raw_inserters
+    assert p.routes_through_staging("consensus_specs_tpu/stf/routed.py",
+                                    "good")
+    assert "consensus_specs_tpu.stf.routed.good" not in p.raw_inserters
+
+
+def test_dependencies_are_the_transitive_import_closure():
+    files = {
+        "pkg/a.py": "def leaf():\n    return 1\n",
+        "pkg/b.py": "from pkg.a import leaf\ndef mid():\n    return leaf()\n",
+        "pkg/c.py": "from pkg.b import mid\ndef top():\n    return mid()\n",
+        "pkg/d.py": "def alone():\n    return 0\n",
+    }
+    p = build_project(files)
+    assert p.dependencies("pkg/c.py") == {"pkg/a.py", "pkg/b.py"}
+    assert p.dependencies("pkg/b.py") == {"pkg/a.py"}
+    assert p.dependencies("pkg/a.py") == set()
+    assert p.dependencies("pkg/d.py") == set()
+
+
+def test_dependencies_see_plain_import_form():
+    files = {
+        "pkg/a.py": "def leaf():\n    return 1\n",
+        "pkg/c.py": "import pkg.a\ndef top():\n    return pkg.a.leaf()\n",
+    }
+    p = build_project(files)
+    assert p.dependencies("pkg/c.py") == {"pkg/a.py"}
+
+
+def test_mesh_axes_collected_from_axis_parameter_defaults():
+    files = {"consensus_specs_tpu/parallel/mesh.py": (
+        "def build_mesh(devices, axis='v', *, axis_dcn='h'):\n"
+        "    return (axis, axis_dcn)\n")}
+    assert build_project(files).mesh_axis_names() == {"v", "h"}
+
+
+def test_probe_names_and_defer_targets():
+    src = ("from consensus_specs_tpu import faults\n"
+           "from consensus_specs_tpu.stf import staging\n"
+           "_SITE = faults.site('stf.x.y')\n"
+           "def commit(k):\n"
+           "    pass\n"
+           "def settle(k):\n"
+           "    staging.defer(commit, k)\n")
+    s = _summ("consensus_specs_tpu/stf/x.py", src)
+    assert s.probe_names == ["_SITE"]
+    assert s.defer_targets == ["commit"]
+
+
+def test_tuple_unpack_shares_the_producing_call_origin():
+    # ``rewards, penalties = _jit(...)``: both names carry the origin
+    import analysis.symbols as symbols
+
+    tree = ast.parse("import jax\n"
+                     "_k = jax.jit(lambda x: x)\n"
+                     "def f(x):\n"
+                     "    r, p = _k(x)\n"
+                     "    return r, p\n")
+    table = symbols.SymbolTable(tree)
+    fn = tree.body[2]
+    info = table.scope_info(fn)
+    assert info.origin_of("r") == "_k"
+    assert info.origin_of("p") == "_k"

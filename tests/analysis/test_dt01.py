@@ -3,8 +3,9 @@ need an explicit 64-bit accumulator."""
 from analysis import analyze_text
 
 
-def dt01(path, src):
-    return [f for f in analyze_text(path, src) if f.code == "DT01"]
+def dt01(path, src, project=None):
+    return [f for f in analyze_text(path, src, project=project)
+            if f.code == "DT01"]
 
 
 _VIOLATIONS = """\
@@ -63,3 +64,181 @@ def test_dt01_respects_targeted_noqa():
            "def t(balances):\n"
            "    return np.sum(balances)  # noqa: DT01 (tiny fixture state)\n")
     assert dt01("m.py", src) == []
+
+
+# -- the extended forms: prod / matmul / @ / narrowing casts ------------------
+
+_EXTENDED_VIOLATIONS = """\
+import numpy as np
+
+def more(balances, weights, flags):
+    a = np.prod(weights)                       # prod
+    b = np.matmul(flags, balances)             # matmul
+    c = flags @ balances                       # @ operator
+    d = balances.astype(int)                   # platform-intp narrowing
+    e = balances.astype(np.int32)              # explicit narrow
+    f = np.int32(balances[0])                  # constructor cast
+    g = np.array(weights, dtype=np.int32)      # narrowing dtype kwarg
+    return a, b, c, d, e, f, g
+"""
+
+_EXTENDED_CLEAN = """\
+import numpy as np
+
+def more(balances, weights, flags, counts):
+    a = np.prod(weights, dtype=np.uint64)
+    b = np.matmul(flags.astype(np.uint64), balances.astype(np.uint64))
+    c = flags.astype(np.uint64) @ balances.astype(np.uint64)
+    d = balances.astype(np.uint64)
+    e = int(balances[0])               # python int: unbounded, stays legal
+    f = counts.astype(np.int32)        # not a balance/weight array
+    g = np.prod(counts)
+    return a, b, c, d, e, f, g
+"""
+
+
+def test_dt01_flags_extended_reduction_and_narrowing_forms():
+    assert [f.line for f in dt01("m.py", _EXTENDED_VIOLATIONS)] == \
+        [4, 5, 6, 7, 8, 9, 10]
+
+
+def test_dt01_extended_forms_accept_64bit_remedies():
+    assert dt01("m.py", _EXTENDED_CLEAN) == []
+
+
+# -- interprocedural sinks (facts follow helpers across files) ----------------
+
+def _proj(files):
+    from analysis.dataflow import build_project
+
+    return build_project(files)
+
+
+_REDUCING_HELPER = ("import numpy as np\n"
+                    "def total_of(values):\n"
+                    "    return np.sum(values)\n")
+
+
+def test_dt01_flags_callsite_feeding_an_unguarded_reducer():
+    user = ("from consensus_specs_tpu.ops.helper import total_of\n"
+            "def tally(balances):\n"
+            "    return total_of(balances)\n")
+    files = {"consensus_specs_tpu/ops/helper.py": _REDUCING_HELPER,
+             "consensus_specs_tpu/stf/user.py": user}
+    found = dt01("consensus_specs_tpu/stf/user.py", user,
+                 project=_proj(files))
+    assert [f.line for f in found] == [3]
+    assert "total_of" in found[0].message
+    # without the project graph the callsite carries no cross-file fact
+    assert dt01("consensus_specs_tpu/stf/user.py", user) == []
+
+
+def test_dt01_guarded_helper_clears_the_callsite():
+    helper = _REDUCING_HELPER.replace("np.sum(values)",
+                                      "np.sum(values, dtype=np.uint64)")
+    user = ("from consensus_specs_tpu.ops.helper import total_of\n"
+            "def tally(balances):\n"
+            "    return total_of(balances)\n")
+    files = {"consensus_specs_tpu/ops/helper.py": helper,
+             "consensus_specs_tpu/stf/user.py": user}
+    found = dt01("consensus_specs_tpu/stf/user.py", user,
+                 project=_proj(files))
+    assert found == []
+
+
+def test_dt01_operand_cast_guarded_helper_clears_the_callsite():
+    # the product-form operand-cast remedy is a guard on the summary
+    # side too: a correctly written helper must not taint its callsites
+    helper = ("import numpy as np\n"
+              "def total_of(values, w):\n"
+              "    return np.dot(values.astype(np.uint64),\n"
+              "                  w.astype(np.uint64))\n")
+    user = ("from consensus_specs_tpu.ops.helper import total_of\n"
+            "def tally(balances, w):\n"
+            "    return total_of(balances, w)\n")
+    files = {"consensus_specs_tpu/ops/helper.py": helper,
+             "consensus_specs_tpu/stf/user.py": user}
+    assert dt01("consensus_specs_tpu/ops/helper.py", helper,
+                project=_proj(files)) == []
+    assert dt01("consensus_specs_tpu/stf/user.py", user,
+                project=_proj(files)) == []
+
+
+def test_dt01_boundary_cast_clears_the_callsite():
+    # the message says "fix the callee or cast at the boundary" — the
+    # cast form must actually clear the finding
+    user = ("from consensus_specs_tpu.ops.helper import total_of\n"
+            "import numpy as np\n"
+            "def tally(balances):\n"
+            "    return total_of(balances.astype(np.uint64))\n")
+    files = {"consensus_specs_tpu/ops/helper.py": _REDUCING_HELPER,
+             "consensus_specs_tpu/stf/user.py": user}
+    assert dt01("consensus_specs_tpu/stf/user.py", user,
+                project=_proj(files)) == []
+
+
+def test_dt01_narrow_accumulator_reports_once():
+    # one defect, one finding: the explicit-but-narrow dtype kwarg is
+    # the narrowing check's finding, not also the reduction check's
+    src = ("import numpy as np\n"
+           "def f(balances):\n"
+           "    return np.sum(balances, dtype=np.int32)\n")
+    found = dt01("m.py", src)
+    assert len(found) == 1 and "dtype=np.int32 narrows" in found[0].message
+    method = ("import numpy as np\n"
+              "def f(balances):\n"
+              "    return balances.sum(dtype=np.int32)\n")
+    found = dt01("m.py", method)
+    assert len(found) == 1 and "narrows" in found[0].message
+
+
+def test_dt01_reduction_fact_propagates_through_wrappers():
+    # helper reduces; wrapper passes through; the caller three files away
+    # still gets the finding
+    wrapper = ("from consensus_specs_tpu.ops.helper import total_of\n"
+               "def via(values):\n"
+               "    return total_of(values)\n")
+    user = ("from consensus_specs_tpu.ops.wrapper import via\n"
+            "def tally(balances):\n"
+            "    return via(balances)\n")
+    files = {"consensus_specs_tpu/ops/helper.py": _REDUCING_HELPER,
+             "consensus_specs_tpu/ops/wrapper.py": wrapper,
+             "consensus_specs_tpu/stf/user.py": user}
+    found = dt01("consensus_specs_tpu/stf/user.py", user,
+                 project=_proj(files))
+    assert [f.line for f in found] == [3]
+
+
+def test_dt01_hinted_callee_params_stay_the_callees_finding():
+    # the callee's own parameter carries the hint: the callee is flagged
+    # where it reduces, and callsites are NOT double-reported
+    helper = ("import numpy as np\n"
+              "def total_of(balances):\n"
+              "    return np.sum(balances)\n")
+    user = ("from consensus_specs_tpu.ops.helper import total_of\n"
+            "def tally(eff):\n"
+            "    return total_of(eff)\n")
+    files = {"consensus_specs_tpu/ops/helper.py": helper,
+             "consensus_specs_tpu/stf/user.py": user}
+    proj = _proj(files)
+    assert [f.line for f in dt01("consensus_specs_tpu/ops/helper.py",
+                                 helper, project=proj)] == [3]
+    assert dt01("consensus_specs_tpu/stf/user.py", user, project=proj) == []
+
+
+def test_dt01_gwei_residency_follows_producers_across_files():
+    # the reduction site has NO lexical hint: the operand's producer is
+    # known to return balance-shaped values via the call graph
+    helper = ("import numpy as np\n"
+              "def effective_balances(state):\n"
+              "    return np.asarray(state.v)\n")
+    user = ("import numpy as np\n"
+            "from consensus_specs_tpu.ops.helper import effective_balances\n"
+            "def tally(state):\n"
+            "    cols = effective_balances(state)\n"
+            "    return np.sum(cols)\n")
+    files = {"consensus_specs_tpu/ops/helper.py": helper,
+             "consensus_specs_tpu/stf/user.py": user}
+    found = dt01("consensus_specs_tpu/stf/user.py", user,
+                 project=_proj(files))
+    assert [f.line for f in found] == [5]

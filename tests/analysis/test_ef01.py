@@ -1,0 +1,111 @@
+"""EF01 effect safety: registered-cache inserts in fault-probed
+functions must route through stf/staging or invalidate in try/finally —
+PR 5's hand-audited transactional discipline as a machine invariant."""
+from analysis import analyze_text
+from analysis.dataflow import build_project
+
+
+def ef01(path, src, project=None):
+    return [f for f in analyze_text(path, src, project=project)
+            if f.code == "EF01"]
+
+
+_HEADER = ("from consensus_specs_tpu import faults\n"
+           "from consensus_specs_tpu.stf import staging\n"
+           "_SITE = faults.site('stf.x.probe')\n"
+           "_VERIFIED_MEMO = {}\n")
+
+
+def test_ef01_flags_unrouted_insert_next_to_probe():
+    src = _HEADER + ("def risky(k, v):\n"
+                     "    _SITE()\n"
+                     "    _VERIFIED_MEMO[k] = v\n")
+    found = ef01("consensus_specs_tpu/stf/x.py", src)
+    assert [f.line for f in found] == [7]
+    assert "strand" in found[0].message
+
+
+def test_ef01_flags_update_and_setdefault_inserts():
+    src = _HEADER + ("def risky(k, v):\n"
+                     "    _SITE()\n"
+                     "    _VERIFIED_MEMO.update({k: v})\n"
+                     "    _VERIFIED_MEMO.setdefault(k, v)\n")
+    assert [f.line for f in ef01("consensus_specs_tpu/stf/x.py", src)] == \
+        [7, 8]
+
+
+def test_ef01_note_insert_routes_the_mutation():
+    src = _HEADER + ("def routed(txn, k, v):\n"
+                     "    _SITE()\n"
+                     "    staging.note_insert(_VERIFIED_MEMO, k)\n"
+                     "    _VERIFIED_MEMO[k] = v\n")
+    assert ef01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ef01_try_finally_invalidation_pardons():
+    src = _HEADER + ("def contained(k, v):\n"
+                     "    try:\n"
+                     "        _VERIFIED_MEMO[k] = v\n"
+                     "        _SITE()\n"
+                     "    except Exception:\n"
+                     "        _VERIFIED_MEMO.pop(k, None)\n"
+                     "        raise\n")
+    assert ef01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ef01_deferred_commit_functions_are_sanctioned():
+    src = _HEADER + ("def commit(k, v):\n"
+                     "    _SITE()\n"
+                     "    _VERIFIED_MEMO[k] = v\n"
+                     "def settle(txn, k, v):\n"
+                     "    staging.defer(commit, k, v)\n")
+    assert ef01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ef01_functions_without_probes_are_out_of_scope():
+    src = _HEADER + ("def quiet(k, v):\n"
+                     "    _VERIFIED_MEMO[k] = v\n")
+    assert ef01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ef01_uninstrumented_modules_are_out_of_scope():
+    src = ("_VERIFIED_MEMO = {}\n"
+           "def risky(k, v):\n"
+           "    _VERIFIED_MEMO[k] = v\n")
+    assert ef01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ef01_follows_helper_inserts_across_files():
+    helper = ("_VERIFIED_MEMO = {}\n"
+              "def memo_put(k, v):\n"
+              "    _VERIFIED_MEMO[k] = v\n")
+    user = ("from consensus_specs_tpu import faults\n"
+            "from consensus_specs_tpu.stf.helper import memo_put\n"
+            "_SITE = faults.site('stf.x.probe')\n"
+            "def risky(k, v):\n"
+            "    _SITE()\n"
+            "    memo_put(k, v)\n")
+    files = {"consensus_specs_tpu/stf/helper.py": helper,
+             "consensus_specs_tpu/stf/user.py": user}
+    proj = build_project(files)
+    found = ef01("consensus_specs_tpu/stf/user.py", user, project=proj)
+    assert [f.line for f in found] == [6]
+    assert "memo_put" in found[0].message
+
+
+def test_ef01_staging_routed_helper_is_clean_across_files():
+    helper = ("from consensus_specs_tpu.stf import staging\n"
+              "_VERIFIED_MEMO = {}\n"
+              "def memo_put(k, v):\n"
+              "    staging.note_insert(_VERIFIED_MEMO, k)\n"
+              "    _VERIFIED_MEMO[k] = v\n")
+    user = ("from consensus_specs_tpu import faults\n"
+            "from consensus_specs_tpu.stf.helper import memo_put\n"
+            "_SITE = faults.site('stf.x.probe')\n"
+            "def risky(k, v):\n"
+            "    _SITE()\n"
+            "    memo_put(k, v)\n")
+    files = {"consensus_specs_tpu/stf/helper.py": helper,
+             "consensus_specs_tpu/stf/user.py": user}
+    proj = build_project(files)
+    assert ef01("consensus_specs_tpu/stf/user.py", user, project=proj) == []

@@ -1,0 +1,125 @@
+"""HD01 host-sync detection: implicit device->host transfers on
+device-tainted values inside hot-path modules, with ``# host-sync:``
+declared boundaries as the sanctioned escape hatch."""
+from analysis import analyze_text
+from analysis.dataflow import build_project
+
+
+def hd01(path, src, project=None):
+    return [f for f in analyze_text(path, src, project=project)
+            if f.code == "HD01"]
+
+
+_VIOLATIONS = """\
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_jit_kernel = jax.jit(lambda x: x * 2)
+
+def pulls(x):
+    dev = jnp.asarray(x)
+    a = np.asarray(dev)            # np pull-back
+    b = float(dev[0])              # scalar cast sync
+    for row in dev:                # per-element sync
+        pass
+    c = dev.item()                 # .item()
+    d = dev.tolist()               # .tolist()
+    e = np.asarray(_jit_kernel(x))  # compiled-callable result
+    return a, b, c, d, e
+
+def unpacked(x):
+    r, p = _jit_kernel(x)
+    return np.asarray(r), np.asarray(p)   # both taints through unpack
+"""
+
+
+def test_hd01_flags_every_sync_shape_in_hot_dirs():
+    lines = [f.line for f in hd01("consensus_specs_tpu/ops/k.py",
+                                  _VIOLATIONS)]
+    assert lines == [9, 10, 11, 13, 14, 15, 20, 20]
+
+
+def test_hd01_only_polices_hot_path_modules():
+    # the same code outside ops/stf/parallel/forkchoice is free to sync
+    assert hd01("consensus_specs_tpu/testing/k.py", _VIOLATIONS) == []
+    assert hd01("consensus_specs_tpu/crypto/k.py", _VIOLATIONS) == []
+    assert hd01("tools/k.py", _VIOLATIONS) == []
+
+
+def test_hd01_host_values_do_not_taint():
+    src = ("import numpy as np\n"
+           "def f(x):\n"
+           "    a = np.square(x)\n"
+           "    b = np.asarray(a)\n"        # numpy-to-numpy: no device
+           "    return float(b[0]), b.tolist()\n")
+    assert hd01("consensus_specs_tpu/ops/k.py", src) == []
+
+
+def test_hd01_jax_host_returning_apis_are_not_seeds():
+    src = ("import jax\n"
+           "def f():\n"
+           "    n = jax.device_count()\n"
+           "    return float(n), [d for d in jax.devices()]\n")
+    assert hd01("consensus_specs_tpu/ops/k.py", src) == []
+
+
+def test_hd01_trailing_boundary_declaration_suppresses():
+    src = ("import numpy as np\n"
+           "import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    dev = jnp.asarray(x)\n"
+           "    return np.asarray(dev)  # host-sync: staged epoch view\n")
+    assert hd01("consensus_specs_tpu/ops/k.py", src) == []
+
+
+def test_hd01_standalone_boundary_comment_covers_next_statement():
+    src = ("import numpy as np\n"
+           "import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    dev = jnp.asarray(x)\n"
+           "    # host-sync: staged view — both outputs pulled once\n"
+           "    # (second comment line keeps the block together)\n"
+           "    return (np.asarray(dev),\n"
+           "            np.asarray(dev))\n")
+    assert hd01("consensus_specs_tpu/ops/k.py", src) == []
+
+
+def test_hd01_bare_boundary_without_justification_does_not_suppress():
+    src = ("import numpy as np\n"
+           "import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    dev = jnp.asarray(x)\n"
+           "    return np.asarray(dev)  # host-sync:\n")
+    assert [f.line for f in hd01("consensus_specs_tpu/ops/k.py", src)] == [5]
+
+
+def test_hd01_follows_device_residency_across_files():
+    helper = ("import jax.numpy as jnp\n"
+              "def device_cols(state):\n"
+              "    return jnp.asarray(state.balances)\n")
+    # passthrough: a second hop through another file still taints
+    middle = ("from consensus_specs_tpu.ops.helper import device_cols\n"
+              "def view(state):\n"
+              "    return device_cols(state)\n")
+    user = ("import numpy as np\n"
+            "from consensus_specs_tpu.ops.middle import view\n"
+            "def use(state):\n"
+            "    cols = view(state)\n"
+            "    return np.asarray(cols)\n")
+    files = {"consensus_specs_tpu/ops/helper.py": helper,
+             "consensus_specs_tpu/ops/middle.py": middle,
+             "consensus_specs_tpu/stf/user.py": user}
+    proj = build_project(files)
+    assert [f.line for f in hd01("consensus_specs_tpu/stf/user.py", user,
+                                 project=proj)] == [5]
+    # without the project graph the same file has no cross-file facts
+    assert hd01("consensus_specs_tpu/stf/user.py", user) == []
+
+
+def test_hd01_respects_targeted_noqa():
+    src = ("import numpy as np\n"
+           "import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    return np.asarray(jnp.asarray(x))  # noqa: HD01\n")
+    assert hd01("consensus_specs_tpu/ops/k.py", src) == []

@@ -1,19 +1,24 @@
 """Tier-1 gate: the live tree carries ZERO unbaselined analyzer findings
 — the engine invariants (cache coherence, rollback safety, jit purity,
-Gwei dtype safety) plus the hygiene codes hold on every PR by
-construction.
+Gwei dtype safety, host-sync boundaries, sharding contracts, effect
+safety) plus the hygiene codes hold on every PR by construction.
 
 The seeded-mutation tests prove the gate has teeth: re-introducing each
 class of bug the semantic rules exist for (a stray ``store.latest_messages``
 write, a dropped ``dtype=np.uint64``, a cache poke from outside the
 owner, a state write outside the rollback region, a ``print`` in a jitted
-kernel) turns the same analysis red — via ``overrides``, which analyze
-hypothetical file contents at their real tree paths without touching
-disk.
+kernel, an undeclared device pull-back, a spec-less ``shard_map``, an
+unrouted cache insert next to a fault probe) turns the same analysis red
+— via ``overrides``, which analyze hypothetical file contents at their
+real tree paths without touching disk.  The battery runs full-tree (the
+interprocedural rules need the project graph) against the gate's warm
+cache, so each mutation only re-analyzes the mutated files plus their
+call-graph dependents.
 """
 import pytest
 
 from analysis import REPO_ROOT, run
+from analysis.core import REGISTRY
 
 
 @pytest.fixture(scope="module")
@@ -40,77 +45,169 @@ def test_baselined_findings_still_fire(gate):
 
 def test_full_tree_scale_and_budget(gate):
     assert gate.n_files > 250  # the whole tree, not a subset
-    # acceptance: < 5 s cold on the 1 vCPU CI box; allow CI-noise headroom
-    assert gate.duration_s < 15, f"cold run took {gate.duration_s:.1f}s"
+    # acceptance: cold two-pass run on the 1 vCPU CI box with headroom
+    assert gate.duration_s < 20, f"cold run took {gate.duration_s:.1f}s"
 
 
 def test_warm_run_is_cached_and_fast(gate):
     warm = run(cache_path=gate._cache_path)
     assert warm.cache_hits == warm.n_files
     assert warm.findings == []
-    # acceptance: < 1 s warm; allow CI-noise headroom
-    assert warm.duration_s < 3, f"warm run took {warm.duration_s:.1f}s"
+    # acceptance: <= 2 s warm on 1 vCPU; allow CI-noise headroom
+    assert warm.duration_s < 2, f"warm run took {warm.duration_s:.1f}s"
+
+
+def test_per_rule_budget_and_observability(gate):
+    # every registered rule reports stats, and no single rule eats the
+    # whole cold-run budget on the live tree (self-observability gate)
+    from analysis import all_rules
+
+    assert set(gate.rule_stats) == {r.code for r in all_rules()}
+    for code, s in gate.rule_stats.items():
+        assert s["time_s"] < 8.0, f"{code} took {s['time_s']:.2f}s"
+        assert s["findings"] >= 0
+    # the stats survive into the JSON report (make analyze -> ANALYSIS.json)
+    report = gate.to_json()["rule_stats"]
+    assert set(report) == set(gate.rule_stats)
+    assert all("time_s" in v and "findings" in v for v in report.values())
 
 
 # -- seeded mutations: the gate must turn red --------------------------------
 
-def _mutated(rel, mutate):
-    """Analyze one live file with ``mutate(text)`` applied, full gate
-    config (baseline included), returning unbaselined findings."""
-    path = REPO_ROOT / rel
-    text = path.read_text()
-    mutated = mutate(text)
-    assert mutated != text, "mutation did not apply"
-    result = run([path], overrides={rel: mutated}, use_cache=False)
-    return result.findings
+def _mutated(gate, mutations):
+    """Analyze the live tree with ``mutations`` ({rel: mutate(text)})
+    applied, full gate config (baseline included, project graph built,
+    warm cache consulted read-only), returning unbaselined findings."""
+    overrides = {}
+    for rel, mutate in mutations.items():
+        text = (REPO_ROOT / rel).read_text()
+        mutated = mutate(text)
+        assert mutated != text, f"mutation did not apply to {rel}"
+        overrides[rel] = mutated
+    return run(cache_path=gate._cache_path, overrides=overrides).findings
 
 
-def test_fc01_mutation_turns_red():
+def test_fc01_mutation_turns_red(gate):
     rel = "consensus_specs_tpu/testing/helpers/fork_choice.py"
-    found = _mutated(rel, lambda t: t + (
+    found = _mutated(gate, {rel: lambda t: t + (
         "\n\ndef fast_vote(store, i, message):\n"
-        "    store.latest_messages[i] = message\n"))
+        "    store.latest_messages[i] = message\n")})
     assert any(f.code == "FC01" for f in found), found
 
 
-def test_dt01_mutation_turns_red():
+def test_dt01_mutation_turns_red(gate):
     rel = "consensus_specs_tpu/ops/epoch_jax.py"
-    found = _mutated(rel, lambda t: t.replace(",\n                       dtype=np.uint64", ""))
+    found = _mutated(gate, {rel: lambda t: t.replace(
+        ",\n                       dtype=np.uint64", "")})
     assert sum(f.code == "DT01" for f in found) == 2, found
 
 
-def test_cc01_mutation_turns_red():
+def test_cc01_mutation_turns_red(gate):
     rel = "consensus_specs_tpu/stf/attestations.py"
-    found = _mutated(rel, lambda t: t + (
+    found = _mutated(gate, {rel: lambda t: t + (
         "\n\ndef _prime_permutation(seed, n, rounds):\n"
         "    perm = compute_shuffle_permutation(seed, n, rounds)\n"
         "    perm[0] = 0\n"
-        "    return perm\n"))
+        "    return perm\n")})
     assert any(f.code == "CC01" for f in found), found
 
 
-def test_rb01_mutation_turns_red():
+def test_rb01_mutation_turns_red(gate):
     rel = "consensus_specs_tpu/stf/verify.py"
-    found = _mutated(rel, lambda t: t + (
+    found = _mutated(gate, {rel: lambda t: t + (
         "\n\ndef settle_and_advance(state, slot):\n"
-        "    state.slot = slot\n"))
+        "    state.slot = slot\n")})
     assert any(f.code == "RB01" for f in found), found
 
 
-def test_jx01_mutation_turns_red():
+def test_jx01_mutation_turns_red(gate):
     rel = "consensus_specs_tpu/ops/sha256_jax.py"
-    found = _mutated(rel, lambda t: t + (
+    found = _mutated(gate, {rel: lambda t: t + (
         "\n\n@jax.jit\n"
         "def _traced_debug(words):\n"
         "    print(words.shape)\n"
-        "    return words\n"))
+        "    return words\n")})
     assert any(f.code == "JX01" for f in found), found
 
 
-def test_st01_mutation_turns_red():
+def test_st01_mutation_turns_red(gate):
     rel = "consensus_specs_tpu/testing/helpers/block_processing.py"
-    found = _mutated(rel, lambda t: t + (
+    found = _mutated(gate, {rel: lambda t: t + (
         "\n\ndef verify_each(bls, atts):\n"
         "    return [bls.FastAggregateVerify(a.pks, a.msg, a.sig)\n"
-        "            for a in atts]\n"))
+        "            for a in atts]\n")})
     assert any(f.code == "ST01" for f in found), found
+
+
+def test_hd01_mutation_turns_red(gate):
+    # un-declare the epoch kernel's staged-view boundary: the pull-back
+    # the issue names (ops/epoch_jax.py) must be flagged again
+    rel = "consensus_specs_tpu/ops/epoch_jax.py"
+    found = _mutated(gate, {rel: lambda t: t.replace("# host-sync:",
+                                                     "# host-off:")})
+    assert sum(f.code == "HD01" for f in found) == 2, found
+
+
+def test_sh01_mutation_turns_red(gate):
+    # drop out_specs from the sharded pairing check's shard_map callsite
+    rel = "consensus_specs_tpu/parallel/bls_sharded.py"
+    found = _mutated(gate, {rel: lambda t: t.replace(
+        "            out_specs=P(axis),\n", "")})
+    assert any(f.code == "SH01" and "out_specs" in f.message
+               for f in found), found
+
+
+def test_ef01_mutation_turns_red(gate):
+    # an unrouted insert into a registered memo right next to a fault
+    # probe: PR 5's transactional discipline, machine-checked
+    rel = "consensus_specs_tpu/stf/attestations.py"
+    found = _mutated(gate, {rel: lambda t: t + (
+        "\n\ndef _poke_ctx(key, value):\n"
+        "    _SITE_RESOLVE()\n"
+        "    _CTX_CACHE[key] = value\n")})
+    assert any(f.code == "EF01" for f in found), found
+
+
+def test_cc01_cross_file_passthrough_mutation_turns_red(gate):
+    # the call-graph-aware half of CC01: a helper in ANOTHER file passes
+    # the registry-columns producer's cached dict through; mutating its
+    # return value is flagged at the mutation site
+    wrapper = "consensus_specs_tpu/ops/segment.py"
+    user = "consensus_specs_tpu/stf/slot_roots.py"
+    found = _mutated(gate, {
+        wrapper: lambda t: t + (
+            "\n\nfrom consensus_specs_tpu.ops.epoch_jax import "
+            "registry_columns\n"
+            "def cols_view(spec, state):\n"
+            "    return registry_columns(spec, state)\n"),
+        user: lambda t: t + (
+            "\n\nfrom consensus_specs_tpu.ops.segment import cols_view\n"
+            "def _corrupt(spec, state):\n"
+            "    cols = cols_view(spec, state)\n"
+            "    cols[\"effective_balance\"][0] = 0\n"
+            "    return cols\n")})
+    assert any(f.code == "CC01" and f.file == user for f in found), found
+
+
+def test_dt01_cross_file_callsite_mutation_turns_red(gate):
+    # the call-graph-aware half of DT01: the reducing helper carries no
+    # hint in its own file; the hinted callsite lives a file away
+    helper = "consensus_specs_tpu/ops/segment.py"
+    user = "consensus_specs_tpu/forkchoice/batch.py"
+    found = _mutated(gate, {
+        helper: lambda t: t + (
+            "\n\ndef total_of(values):\n"
+            "    return np.sum(values)\n"),
+        user: lambda t: t + (
+            "\n\nfrom consensus_specs_tpu.ops.segment import total_of\n"
+            "def _total_balance(balances):\n"
+            "    return total_of(balances)\n")})
+    assert any(f.code == "DT01" and f.file == user
+               and "total_of" in f.message for f in found), found
+
+
+def test_registry_covers_every_mutation_code():
+    # every rule family proven red above is a registered plugin
+    for code in ("FC01", "DT01", "CC01", "RB01", "JX01", "ST01",
+                 "HD01", "SH01", "EF01"):
+        assert code in REGISTRY, code

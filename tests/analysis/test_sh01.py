@@ -1,0 +1,112 @@
+"""SH01 sharding contracts: every shard_map/pjit callsite binds its
+partition specs, names only declared mesh axes, and lives in a module
+with a sharded-dim divisibility guard."""
+from analysis import analyze_text
+from analysis.dataflow import build_project
+
+
+def sh01(path, src, project=None):
+    return [f for f in analyze_text(path, src, project=project)
+            if f.code == "SH01"]
+
+
+_CLEAN = """\
+import jax
+from jax.sharding import PartitionSpec as P
+
+def launch(mesh, fn, xs):
+    f = jax.shard_map(fn, mesh=mesh, in_specs=P("v"), out_specs=P("v"))
+    assert xs.shape[0] % 8 == 0, "ragged batch"
+    return f(xs)
+"""
+
+
+def test_sh01_contract_respecting_callsite_is_clean():
+    assert sh01("consensus_specs_tpu/parallel/x.py", _CLEAN) == []
+
+
+def test_sh01_missing_specs():
+    src = ("import jax\n"
+           "def launch(mesh, fn, xs):\n"
+           "    assert xs.shape[0] % 8 == 0\n"
+           "    g = jax.shard_map(fn, mesh=mesh)\n"
+           "    h = jax.shard_map(fn, mesh=mesh, in_specs=None)\n"
+           "    return g(xs), h(xs)\n")
+    found = sh01("consensus_specs_tpu/parallel/x.py", src)
+    assert [f.line for f in found] == [4, 5]
+    assert "in_specs / out_specs" in found[0].message
+    assert "out_specs" in found[1].message
+
+
+def test_sh01_undeclared_mesh_axis():
+    src = _CLEAN.replace('P("v"), out_specs=P("v")',
+                         'P("v"), out_specs=P("rows")')
+    found = sh01("consensus_specs_tpu/parallel/x.py", src)
+    assert len(found) == 1 and "'rows'" in found[0].message
+
+
+def test_sh01_axes_come_from_the_projects_mesh_module():
+    mesh = ("from jax.sharding import Mesh\n"
+            "def build_mesh(devices, axis='lanes', axis2='hosts'):\n"
+            "    return Mesh(devices, (axis, axis2))\n")
+    user = _CLEAN.replace('P("v"), out_specs=P("v")',
+                          'P("lanes"), out_specs=P("hosts")')
+    proj = build_project({"consensus_specs_tpu/parallel/mesh.py": mesh,
+                          "consensus_specs_tpu/parallel/x.py": user})
+    assert sh01("consensus_specs_tpu/parallel/x.py", user,
+                project=proj) == []
+    # "v" is not declared by THIS mesh module, so the default spelling
+    # now fails — the declared vocabulary is the source of truth
+    assert len(sh01("consensus_specs_tpu/parallel/x.py", _CLEAN,
+                    project=proj)) == 1
+
+
+def test_sh01_module_needs_divisibility_guard():
+    src = ('import jax\n'
+           'from jax.sharding import PartitionSpec as P\n'
+           'def launch(mesh, fn, xs):\n'
+           '    f = jax.shard_map(fn, mesh=mesh, in_specs=P("v"),\n'
+           '                      out_specs=P("v"))\n'
+           '    return f(xs)\n')
+    found = sh01("consensus_specs_tpu/parallel/x.py", src)
+    assert len(found) == 1 and "divisibility guard" in found[0].message
+    # a pad-to-multiple helper is the other sanctioned guard shape
+    assert sh01("consensus_specs_tpu/parallel/x.py",
+                src.replace("return f(xs)",
+                            "return f(pad_to_multiple(xs))")) == []
+
+
+def test_sh01_pjit_uses_shardings_spelling():
+    src = ("from jax.experimental.pjit import pjit\n"
+           "def launch(fn, xs):\n"
+           "    assert xs.shape[0] % 8 == 0\n"
+           "    return pjit(fn)(xs)\n")
+    found = sh01("consensus_specs_tpu/parallel/x.py", src)
+    assert len(found) == 1
+    assert "in_shardings / out_shardings" in found[0].message
+
+
+def test_sh01_partial_decorator_form_is_seen():
+    src = ("import functools\n"
+           "import jax\n"
+           "@functools.partial(jax.shard_map, mesh=None)\n"
+           "def kernel(x):\n"
+           "    return x\n")
+    assert len(sh01("consensus_specs_tpu/parallel/x.py", src)) >= 1
+
+
+def test_sh01_exempts_spec_sources():
+    src = ("import jax\n"
+           "def launch(mesh, fn, xs):\n"
+           "    return jax.shard_map(fn, mesh=mesh)(xs)\n")
+    assert sh01("consensus_specs_tpu/specs/src/phase0.py", src) == []
+
+
+def test_sh01_live_mesh_vocabulary_matches_parallel_mesh():
+    # the real tree's mesh.py declares exactly the "v" axis today; the
+    # project pass must pick it up from the axis-parameter default
+    import pathlib
+    mesh_src = (pathlib.Path(__file__).resolve().parents[2]
+                / "consensus_specs_tpu/parallel/mesh.py").read_text()
+    proj = build_project({"consensus_specs_tpu/parallel/mesh.py": mesh_src})
+    assert proj.mesh_axis_names() == {"v"}

@@ -1,12 +1,23 @@
-"""Content-hash incremental cache.
+"""Content-hash incremental cache with dependency-aware invalidation.
 
-Findings are a pure function of (file bytes, analyzer sources): the cache
-keys each file's findings by the sha256 of its text and drops wholesale
-when the analyzer's own sources change (``version`` digest, computed by
-the runner over every ``tools/analysis`` module).  noqa filtering happens
-before caching (it only reads the same text); baseline matching happens
-after (so editing baseline.json never needs a re-analysis).  A warm
-full-tree run is therefore one hash + one dict probe per file.
+A file's findings are no longer a pure function of its own bytes: the
+interprocedural rules (HD01/EF01 and the call-graph-aware DT01/CC01)
+read facts derived from every file in the file's import closure.  The
+cache therefore stores TWO things per file, keyed separately:
+
+* the **call-graph summary** (``callgraph.FileSummary``), keyed by the
+  file's own sha256 alone — pass 1 is per-file by construction, so a
+  warm run rebuilds the whole project graph without parsing anything;
+* the **findings**, keyed by the file's sha256 AND a ``deps`` digest the
+  runner computes over the shas of the file's transitive call-graph
+  fan-in (plus the project-wide mesh-axis salt).  Editing a leaf helper
+  re-derives the findings of every file that can see it — and nothing
+  else.
+
+Both drop wholesale when the analyzer's own sources change (``version``
+digest).  noqa filtering happens before caching (it only reads the same
+text); baseline matching happens after (so editing baseline.json never
+needs a re-analysis).
 """
 from __future__ import annotations
 
@@ -38,21 +49,45 @@ class AnalysisCache:
         if data.get("version") == version:
             self._files = data.get("files", {})
 
-    def get(self, display: str, digest: str) -> Optional[List[Finding]]:
+    def _entry(self, display: str, digest: str) -> dict:
+        """The entry for ``display``, reset whenever the file's own sha
+        moved (a stale summary or findings list must never survive)."""
         entry = self._files.get(display)
         if entry is None or entry.get("sha") != digest:
+            entry = self._files[display] = {"sha": digest}
+        return entry
+
+    # -- pass 1: call-graph summaries (keyed on own sha only) ----------------
+
+    def get_summary(self, display: str, digest: str) -> Optional[dict]:
+        entry = self._files.get(display)
+        if entry is None or entry.get("sha") != digest:
+            return None
+        return entry.get("summary")
+
+    def put_summary(self, display: str, digest: str, summary: dict) -> None:
+        self._entry(display, digest)["summary"] = summary
+
+    # -- pass 2: findings (keyed on own sha + dependency digest) -------------
+
+    def get_findings(self, display: str, digest: str,
+                     deps_digest: str) -> Optional[List[Finding]]:
+        entry = self._files.get(display)
+        if (entry is None or entry.get("sha") != digest
+                or entry.get("deps") != deps_digest
+                or "findings" not in entry):
             self.misses += 1
             return None
         self.hits += 1
         return [Finding(display, line, code, message, snippet)
                 for line, code, message, snippet in entry["findings"]]
 
-    def put(self, display: str, digest: str, findings: List[Finding]) -> None:
-        self._files[display] = {
-            "sha": digest,
-            "findings": [[f.line, f.code, f.message, f.snippet]
-                         for f in findings],
-        }
+    def put_findings(self, display: str, digest: str, deps_digest: str,
+                     findings: List[Finding]) -> None:
+        entry = self._entry(display, digest)
+        entry["deps"] = deps_digest
+        entry["findings"] = [[f.line, f.code, f.message, f.snippet]
+                             for f in findings]
 
     def save(self) -> None:
         if self.path is None:

@@ -1,0 +1,360 @@
+"""Pass 1 of the two-pass analyzer: per-file call-graph summaries.
+
+The per-file rules (pass 2) can resolve a dotted name inside ONE file;
+what they cannot see is what that name *does* — whether the helper a
+value came from returns a device-resident array, reduces its argument
+with a 32-bit accumulator, or hands back the cached object a memo owns.
+This module extracts, per file, exactly the facts the interprocedural
+rules need, in a serializable form the incremental cache can store (a
+warm run rebuilds the whole project graph without parsing a single
+file):
+
+* the file's **module identity** (``consensus_specs_tpu/ops/segment.py``
+  -> ``consensus_specs_tpu.ops.segment``) and its **import table with
+  relative imports absolutized** (``from .attestations import _fifo_put``
+  in ``stf/sync.py`` -> ``consensus_specs_tpu.stf.attestations._fifo_put``),
+  so facts line up across files regardless of import spelling;
+* per top-level function: parameters, every resolved **call target**,
+  the calls whose results **flow to the return value** (through the
+  scope's alias/origin chains), per-call **argument flows** (which caller
+  parameters feed which callee slot), which parameters reach an
+  **unguarded numpy reduction**, whether returned expressions carry a
+  balance/weight **gwei hint**, and which registered-cache globals the
+  function **raw-inserts** into without routing through ``stf/staging``;
+* module-level facts: names bound to ``faults.site(...)`` probes, names
+  passed to ``staging.defer`` (deferred commit functions), mesh-axis
+  string names (for the sharding-contract rule), and module-scope call
+  origins (``_jit_kernel = jax.jit(_deltas_kernel)``).
+
+``dataflow.Project`` consumes these summaries and runs the fixed-point
+propagation; rules never touch this module directly.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .symbols import SymbolTable, name_matches
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# DT01's reducer/operand vocabulary, shared so the interprocedural facts
+# and the per-file rule can never disagree about what "unguarded" means
+_REDUCERS = {"sum", "cumsum", "dot", "prod", "matmul"}
+_OPERAND_CAST_REMEDY = {"dot", "matmul"}
+_HINT_SUBSTRINGS = ("balance", "weight", "gwei", "reward", "penalt")
+_HINT_EXACT = {"eff"}
+_OK_DTYPES = {"uint64", "int64", "u8", "i8"}
+
+
+def module_name_for(display: str) -> str:
+    """Dotted module name for a repo-relative display path
+    (``a/b/c.py`` -> ``a.b.c``; ``a/b/__init__.py`` -> ``a.b``)."""
+    parts = display[:-3].split("/") if display.endswith(".py") else \
+        display.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def anchor_for(display: str) -> str:
+    """The module name to absolutize relative imports against.  For a
+    package ``__init__`` the module IS the package (``from . import x``
+    in ``a/b/__init__.py`` means ``a.b.x``), so anchor one level deeper
+    than the dotted name to keep ``absolutize``'s climb arithmetic
+    uniform."""
+    module = module_name_for(display)
+    if display.endswith("__init__.py"):
+        return module + ".__init__"
+    return module
+
+
+def absolutize(dotted: Optional[str], module: str) -> Optional[str]:
+    """Resolve a possibly-relative dotted name against ``module``'s
+    package (``.attestations.f`` in ``pkg.stf.sync`` ->
+    ``pkg.stf.attestations.f``).  Absolute names pass through."""
+    if not dotted or not dotted.startswith("."):
+        return dotted
+    level = len(dotted) - len(dotted.lstrip("."))
+    pkg = module.split(".")
+    # level 1 = the module's own package, each extra dot climbs one more
+    pkg = pkg[: len(pkg) - level] if level <= len(pkg) else []
+    rest = dotted.lstrip(".")
+    return ".".join(pkg + ([rest] if rest else []))
+
+
+def gwei_hint(expr: ast.AST) -> bool:
+    """True when the expression mentions a balance/weight-ish identifier
+    (same vocabulary as DT01)."""
+    for node in ast.walk(expr):
+        word = None
+        if isinstance(node, ast.Name):
+            word = node.id
+        elif isinstance(node, ast.Attribute):
+            word = node.attr
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            word = node.value
+        if word is None:
+            continue
+        w = word.lower()
+        if w in _HINT_EXACT or any(h in w for h in _HINT_SUBSTRINGS):
+            return True
+    return False
+
+
+def dtype_ok(call: ast.Call) -> bool:
+    """An explicit 64-bit accumulator dtype kwarg (DT01's pardon)."""
+    for kw in call.keywords:
+        if kw.arg != "dtype":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Attribute) and v.attr in _OK_DTYPES:
+            return True
+        if isinstance(v, ast.Name) and v.id in _OK_DTYPES:
+            return True
+        if isinstance(v, ast.Constant) and str(v.value) in _OK_DTYPES:
+            return True
+    return False
+
+
+def has_ok_cast(expr: ast.AST) -> bool:
+    """The expression contains a 64-bit ``.astype`` cast (DT01's
+    operand-cast pardon for the product forms)."""
+    return any(isinstance(n, ast.Attribute) and n.attr in _OK_DTYPES
+               for n in ast.walk(expr))
+
+
+@dataclass
+class FuncSummary:
+    """Interprocedural facts for one top-level function.  ``params``
+    keeps declaration order (positional slots index into it)."""
+
+    params: List[str] = field(default_factory=list)
+    calls: List[str] = field(default_factory=list)         # resolved targets
+    return_calls: List[str] = field(default_factory=list)  # results returned
+    returns_hint: bool = False                             # gwei-ish return
+    # [callee, slot (int position | str keyword), [caller params in arg]]
+    arg_flows: List[list] = field(default_factory=list)
+    reduce_params: List[str] = field(default_factory=list)
+    raw_insert_caches: List[str] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"params": self.params, "calls": self.calls,
+                "return_calls": self.return_calls,
+                "returns_hint": self.returns_hint,
+                "arg_flows": self.arg_flows,
+                "reduce_params": self.reduce_params,
+                "raw_insert_caches": self.raw_insert_caches}
+
+    def param_at(self, slot: int) -> Optional[str]:
+        return self.params[slot] if 0 <= slot < len(self.params) else None
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FuncSummary":
+        return cls(**d)
+
+
+@dataclass
+class FileSummary:
+    """Everything the project graph needs to know about one file."""
+
+    display: str
+    module: str
+    imports: Dict[str, str] = field(default_factory=dict)  # local -> absolute
+    functions: Dict[str, FuncSummary] = field(default_factory=dict)
+    probe_names: List[str] = field(default_factory=list)   # faults.site vars
+    defer_targets: List[str] = field(default_factory=list)
+    mesh_axes: List[str] = field(default_factory=list)
+    module_origins: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {"display": self.display, "module": self.module,
+                "imports": self.imports,
+                "functions": {n: f.to_json()
+                              for n, f in self.functions.items()},
+                "probe_names": self.probe_names,
+                "defer_targets": self.defer_targets,
+                "mesh_axes": self.mesh_axes,
+                "module_origins": self.module_origins}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FileSummary":
+        return cls(display=d["display"], module=d["module"],
+                   imports=d.get("imports", {}),
+                   functions={n: FuncSummary.from_json(f)
+                              for n, f in d.get("functions", {}).items()},
+                   probe_names=d.get("probe_names", []),
+                   defer_targets=d.get("defer_targets", []),
+                   mesh_axes=d.get("mesh_axes", []),
+                   module_origins=d.get("module_origins", {}))
+
+
+def _registered_cache_globals() -> Set[str]:
+    from .rules.cache_coherence import CACHE_REGISTRY
+
+    names: Set[str] = set()
+    for spec in CACHE_REGISTRY:
+        names |= spec.module_globals
+    return names
+
+
+def summarize(display: str, tree: Optional[ast.AST],
+              sym: Optional[SymbolTable] = None) -> FileSummary:
+    """Build a file's summary from its parsed AST (None tree -> empty
+    summary: a syntactically broken file contributes no graph facts)."""
+    module = module_name_for(display)
+    anchor = anchor_for(display)
+    out = FileSummary(display=display, module=module)
+    if tree is None:
+        return out
+    sym = sym or SymbolTable(tree)
+    local_funcs = {n.name for n in tree.body if isinstance(n, _FUNC_NODES)}
+
+    def resolve_dotted(dotted: Optional[str]) -> Optional[str]:
+        dotted = absolutize(dotted, anchor)
+        if dotted and "." not in dotted and dotted in local_funcs:
+            return f"{module}.{dotted}"  # same-file helper: fully qualify
+        return dotted
+
+    def resolve(node: ast.AST) -> Optional[str]:
+        return resolve_dotted(sym.resolve(node))
+
+    out.imports = {local: absolutize(d, anchor) or d
+                   for local, d in sym.imports.items()}
+    # ``import a.b.c`` binds only the root name in the symbol table; the
+    # dependency closure still needs the full dotted module recorded
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out.imports.setdefault(alias.name, alias.name)
+
+    mod_scope = sym.scope_info(None)
+    for name, dotted in mod_scope.origins.items():
+        dotted = absolutize(dotted, anchor) or dotted
+        out.module_origins[name] = dotted
+        if name_matches(dotted, {"site"}) and "faults" in dotted:
+            out.probe_names.append(name)
+
+    cache_globals = _registered_cache_globals()
+    for node in ast.walk(tree):
+        # staging.defer(fn, ...) registers fn as a sanctioned deferred commit
+        if (isinstance(node, ast.Call)
+                and name_matches(resolve(node.func), {"defer"}) and node.args
+                and isinstance(node.args[0], ast.Name)):
+            out.defer_targets.append(node.args[0].id)
+        # mesh-axis names: string defaults of axis-ish parameters
+        if isinstance(node, _FUNC_NODES):
+            a = node.args
+            positional = [*a.posonlyargs, *a.args]
+            for arg, dflt in zip(positional[len(positional) - len(a.defaults):],
+                                 a.defaults):
+                if (arg.arg.startswith("axis") and isinstance(dflt, ast.Constant)
+                        and isinstance(dflt.value, str)):
+                    out.mesh_axes.append(dflt.value)
+            for arg, dflt in zip(a.kwonlyargs, a.kw_defaults):
+                if (dflt is not None and arg.arg.startswith("axis")
+                        and isinstance(dflt, ast.Constant)
+                        and isinstance(dflt.value, str)):
+                    out.mesh_axes.append(dflt.value)
+
+    for node in tree.body:
+        if isinstance(node, _FUNC_NODES):
+            out.functions[node.name] = _summarize_func(
+                node, sym, resolve, resolve_dotted, cache_globals)
+    return out
+
+
+def _summarize_func(fn, sym: SymbolTable, resolve, resolve_dotted,
+                    cache_globals) -> FuncSummary:
+    info = sym.scope_info(fn)
+    a = fn.args
+    ordered = [arg.arg for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    s = FuncSummary(params=ordered)
+    calls: Set[str] = set()
+    return_calls: Set[str] = set()
+    routed = False  # calls staging.note_insert directly
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            dotted = resolve(node.func)
+            if dotted:
+                calls.add(dotted)
+                if name_matches(dotted, {"note_insert"}):
+                    routed = True
+                self_flows = []
+                for slot, arg in enumerate(node.args):
+                    feeds = sorted({n.id for n in ast.walk(arg)
+                                    if isinstance(n, ast.Name)} & info.params)
+                    if feeds:
+                        self_flows.append([dotted, slot, feeds])
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    feeds = sorted({n.id for n in ast.walk(kw.value)
+                                    if isinstance(n, ast.Name)} & info.params)
+                    if feeds:
+                        self_flows.append([dotted, kw.arg, feeds])
+                s.arg_flows.extend(self_flows)
+            # unguarded numpy reduction reached by a parameter
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in _REDUCERS
+                    and not dtype_ok(node)):
+                res = sym.resolve(f)
+                if res and res.lstrip(".").startswith("numpy."):
+                    operands = node.args
+                elif res and (res.lstrip(".").startswith("jax")
+                              or res.lstrip(".").startswith("jnp")):
+                    operands = []
+                else:
+                    operands = [f.value, *node.args]
+                if f.attr in _OPERAND_CAST_REMEDY and any(
+                        has_ok_cast(op) for op in operands):
+                    operands = []  # DT01's operand-cast pardon: guarded
+                for op in operands:
+                    for p in ({n.id for n in ast.walk(op)
+                               if isinstance(n, ast.Name)} & info.params):
+                        if p not in s.reduce_params:
+                            s.reduce_params.append(p)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            for origin in _return_origins(node.value, info, resolve,
+                                          resolve_dotted):
+                return_calls.add(origin)
+            if gwei_hint(node.value):
+                s.returns_hint = True
+        elif isinstance(node, ast.Assign) and not routed:
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in cache_globals
+                        and t.value.id not in s.raw_insert_caches):
+                    s.raw_insert_caches.append(t.value.id)
+
+    if gwei_hint(ast.Name(id=fn.name)):
+        s.returns_hint = True
+    if routed:
+        s.raw_insert_caches = []
+    s.calls = sorted(calls)
+    s.return_calls = sorted(return_calls)
+    return s
+
+
+def _return_origins(expr: ast.AST, info, resolve, resolve_dotted):
+    """Dotted producers whose results flow out of a return expression:
+    direct calls (through tuples and subscript/attribute views) and
+    names whose scope origin is a producing call."""
+    stack = [expr]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, (ast.Tuple, ast.List)):
+            stack.extend(e.elts)
+        elif isinstance(e, (ast.Subscript, ast.Attribute, ast.Starred)):
+            stack.append(e.value)
+        elif isinstance(e, ast.Call):
+            dotted = resolve(e.func)
+            if dotted:
+                yield dotted
+        elif isinstance(e, ast.Name):
+            origin = info.origin_of(e.id)
+            if origin:
+                yield resolve_dotted(origin) or origin

@@ -71,7 +71,10 @@ def all_rules(codes=None) -> List[Rule]:
 
 @dataclass
 class FileContext:
-    """Everything rules may need about one file, computed once."""
+    """Everything rules may need about one file, computed once.
+    ``project`` (optional) is the whole-tree call graph built by the
+    runner's first pass — interprocedural rules consult it when present
+    and degrade to per-file reasoning when not (single-file fixtures)."""
 
     path: Path
     display: str
@@ -79,12 +82,18 @@ class FileContext:
     lines: List[str] = field(default_factory=list)
     tree: Optional[ast.AST] = None
     syntax_error: Optional[SyntaxError] = None
+    project: Optional[object] = None
     _symbols: Optional[SymbolTable] = None
 
     @classmethod
-    def build(cls, path, text: str, display: Optional[str] = None) -> "FileContext":
-        ctx = cls(path=Path(path), display=display or str(path), text=text)
+    def build(cls, path, text: str, display: Optional[str] = None,
+              project=None, tree: Optional[ast.AST] = None) -> "FileContext":
+        ctx = cls(path=Path(path), display=display or str(path), text=text,
+                  project=project)
         ctx.lines = text.splitlines()
+        if tree is not None:
+            ctx.tree = tree
+            return ctx
         try:
             with warnings.catch_warnings():
                 # invalid escapes warn at parse time; W605 reports them

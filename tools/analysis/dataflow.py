@@ -1,0 +1,337 @@
+"""Pass 2 support: the project graph and its fixed-point value facts.
+
+``Project`` holds every file's ``callgraph.FileSummary`` and propagates
+four fact families to a fixed point over the call graph, so a per-file
+rule can ask about a helper defined three imports away:
+
+* **device residency** — a function returns a device-resident value when
+  a returned expression originates in ``jax.*`` / ``jnp.*`` /
+  ``jax.device_put`` / ``jax.jit``/``shard_map``/``pjit`` products, or in
+  another function already so marked.  This is the taint HD01 follows to
+  implicit device->host syncs (``np.asarray`` / ``.item()`` / iteration).
+* **gwei residency** — a function whose returned expressions (or name)
+  carry DT01's balance/weight vocabulary, or that passes through another
+  gwei producer: lets DT01 recognize ``eb = cols_helper(...)``-style
+  indirection without a lexical hint at the reduction site.
+* **unguarded reductions** — which parameters of a function reach a
+  numpy reduction with no explicit 64-bit accumulator, propagated
+  through argument flows (``f`` passes its ``balances`` into ``g``'s
+  reducing parameter -> ``balances`` is reducing for ``f`` too).  DT01
+  flags gwei-hinted arguments at callsites of such functions.
+* **cached-producer pass-through** — a function returning a registered
+  memo producer's result IS that producer for CC01's purposes: mutating
+  its return value corrupts the cache, whichever file the pass-through
+  lives in.
+
+Plus two flat facts EF01 needs: which functions (transitively) route
+inserts through ``stf/staging`` (``note_insert``/``defer``), and which
+raw-insert into registered cache globals.
+
+The graph also answers **dependencies(display)**: the transitive set of
+project files whose summaries can influence a file's findings — the
+incremental cache keys each file's findings on its own content hash AND
+its dependencies' hashes, so editing a leaf helper re-derives every
+dependent file's findings (and nothing else).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .callgraph import FileSummary
+
+# dotted-name prefixes whose call results live on device.  jax.* is the
+# seed family; the denylist names jax APIs that return host objects.
+_DEVICE_PREFIXES = ("jax.", "jnp.")
+_DEVICE_EXACT = {"jax"}
+_HOST_RETURNING = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.process_count", "jax.process_index",
+    "jax.default_backend", "jax.config.update",
+}
+def dotted_is_device_seed(dotted: Optional[str]) -> bool:
+    """A resolved dotted name whose CALL RESULT is device-resident (or a
+    compiled callable whose results are: jax.jit/shard_map products)."""
+    if not dotted:
+        return False
+    d = dotted.lstrip(".")
+    if d in _HOST_RETURNING or any(d.startswith(h + ".")
+                                   for h in _HOST_RETURNING):
+        return False
+    return d in _DEVICE_EXACT or any(d.startswith(p)
+                                     for p in _DEVICE_PREFIXES)
+
+
+class Project:
+    """The whole-tree call graph + propagated value facts."""
+
+    def __init__(self, summaries: Iterable[FileSummary]):
+        self.files: Dict[str, FileSummary] = {}
+        self.modules: Dict[str, FileSummary] = {}
+        for s in summaries:
+            self.files[s.display] = s
+            self.modules[s.module] = s
+        self._modof_memo: Dict[str, Optional[FileSummary]] = {}
+        self.device_fns: Set[str] = set()
+        self.gwei_fns: Set[str] = set()
+        self.reduce_params: Dict[str, Set[str]] = {}
+        self.cached_producer: Dict[str, str] = {}
+        self.staging_routers: Set[str] = set()
+        self.raw_inserters: Dict[str, Set[str]] = {}
+        self._deps_memo: Dict[str, Set[str]] = {}
+        self._propagate()
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_function(self, dotted: Optional[str]) -> Optional[str]:
+        """Canonical ``module.func`` key for a dotted call target, when it
+        names a top-level function of a project module
+        (``pkg.stf.attestations._fifo_put`` -> that module's summary).
+        Functions are top-level by construction, so the module is always
+        everything before the last dot — one dict probe."""
+        if not dotted:
+            return None
+        d = dotted.lstrip(".")
+        mod, _, func = d.rpartition(".")
+        if not mod:
+            return None
+        summary = self.modules.get(mod)
+        if summary is not None and func in summary.functions:
+            return d
+        return None
+
+    def summary_for_function(self, key: str):
+        mod, func = key.rsplit(".", 1)
+        return self.modules[mod].functions[func]
+
+    def qualify(self, display: str, dotted: Optional[str]) -> Optional[str]:
+        """Absolutize a per-file resolved name against the file's module
+        (bare local-helper names become ``module.name``)."""
+        if not dotted:
+            return None
+        if "." not in dotted.lstrip("."):
+            summary = self.files.get(display)
+            if summary is not None:
+                if dotted in summary.functions:
+                    return f"{summary.module}.{dotted}"
+                if dotted in summary.imports:  # bare imported name
+                    return summary.imports[dotted]
+        from .callgraph import absolutize, anchor_for
+
+        return absolutize(dotted, anchor_for(display))
+
+    # -- fact queries (rules call these) -------------------------------------
+
+    def returns_device(self, display: str, dotted: Optional[str]) -> bool:
+        dotted = self.qualify(display, dotted)
+        if dotted_is_device_seed(dotted):
+            return True
+        key = self.resolve_function(dotted)
+        return key in self.device_fns if key else False
+
+    def returns_gwei(self, display: str, dotted: Optional[str]) -> bool:
+        key = self.resolve_function(self.qualify(display, dotted))
+        return key in self.gwei_fns if key else False
+
+    def reducing_params_of(self, display: str,
+                           dotted: Optional[str]) -> Tuple[str, Set[str]]:
+        """(canonical key, reducing params) for a call target, or
+        (None, empty)."""
+        key = self.resolve_function(self.qualify(display, dotted))
+        if key and key in self.reduce_params:
+            return key, self.reduce_params[key]
+        return None, set()
+
+    def producer_behind(self, display: str, dotted: Optional[str]) -> Optional[str]:
+        """The registered memo producer (``module.func``) whose cached
+        object a call to ``dotted`` ultimately returns, if any."""
+        key = self.resolve_function(self.qualify(display, dotted))
+        # a producer trivially stands behind itself
+        if key in self.cached_producer:
+            return self.cached_producer[key]
+        return None
+
+    def routes_through_staging(self, display: str, dotted: Optional[str]) -> bool:
+        dotted = self.qualify(display, dotted)
+        if dotted and self._is_staging_call(dotted):
+            return True  # staging's own note_insert/defer entry points
+        key = self.resolve_function(dotted)
+        return key in self.staging_routers if key else False
+
+    def raw_inserts_of(self, display: str, dotted: Optional[str]) -> Set[str]:
+        key = self.resolve_function(self.qualify(display, dotted))
+        return self.raw_inserters.get(key, set()) if key else set()
+
+    def mesh_axis_names(self) -> Set[str]:
+        """Axis names declared by ``parallel/mesh.py`` (string defaults of
+        ``axis``-ish parameters).  Empty when no mesh module is in the
+        project (single-file fixture runs)."""
+        axes: Set[str] = set()
+        for mod, summary in self.modules.items():
+            if mod.endswith("parallel.mesh") or mod == "mesh":
+                axes.update(summary.mesh_axes)
+        return axes
+
+    # -- dependency closure (the incremental cache keys on this) -------------
+
+    def dependencies(self, display: str) -> Set[str]:
+        """Transitive project files whose content can influence this
+        file's findings (its call-graph fan-in), excluding itself."""
+        if display in self._deps_memo:
+            return self._deps_memo[display]
+        seen: Set[str] = set()
+        stack = [display]
+        while stack:
+            d = stack.pop()
+            if d in seen:
+                continue
+            seen.add(d)
+            summary = self.files.get(d)
+            if summary is None:
+                continue
+            for dotted in summary.imports.values():
+                dep = self._module_of(dotted)
+                if dep is not None and dep.display not in seen:
+                    stack.append(dep.display)
+        seen.discard(display)
+        self._deps_memo[display] = seen
+        return seen
+
+    def _module_of(self, dotted: Optional[str]) -> Optional[FileSummary]:
+        """The project module a dotted name lives in (longest dotted
+        prefix that names a module; memoized — import spellings repeat
+        heavily across files)."""
+        if not dotted:
+            return None
+        hit = self._modof_memo.get(dotted)
+        if hit is not None or dotted in self._modof_memo:
+            return hit
+        parts = dotted.lstrip(".").split(".")
+        found = None
+        for i in range(len(parts), 0, -1):
+            found = self.modules.get(".".join(parts[:i]))
+            if found is not None:
+                break
+        self._modof_memo[dotted] = found
+        return found
+
+    # -- fixed-point propagation ---------------------------------------------
+
+    def _iter_functions(self):
+        for mod, summary in self.modules.items():
+            for name, fn in summary.functions.items():
+                yield f"{mod}.{name}", summary, fn
+
+    def _propagate(self) -> None:
+        from .rules.cache_coherence import CACHE_REGISTRY
+
+        producer_keys = {f"{spec.module.lstrip('.')}.{p}": f"{spec.module.lstrip('.')}.{p}"
+                         for spec in CACHE_REGISTRY for p in spec.producers}
+        # seeds
+        for key, summary, fn in self._iter_functions():
+            if any(dotted_is_device_seed(self.qualify(summary.display, rc))
+                   for rc in fn.return_calls):
+                self.device_fns.add(key)
+            if fn.returns_hint:
+                self.gwei_fns.add(key)
+            if fn.reduce_params:
+                self.reduce_params[key] = set(fn.reduce_params)
+            if key in producer_keys:
+                self.cached_producer[key] = key
+            if any(self._is_staging_call(c) for c in fn.calls):
+                self.staging_routers.add(key)
+            if fn.raw_insert_caches:
+                self.raw_inserters[key] = set(fn.raw_insert_caches)
+
+        # fixed point: facts flow along return-value and argument edges
+        changed = True
+        while changed:
+            changed = False
+            for key, summary, fn in self._iter_functions():
+                display = summary.display
+                for rc in fn.return_calls:
+                    callee = self.resolve_function(self.qualify(display, rc))
+                    if callee is None:
+                        continue
+                    if callee in self.device_fns and key not in self.device_fns:
+                        self.device_fns.add(key)
+                        changed = True
+                    if callee in self.gwei_fns and key not in self.gwei_fns:
+                        self.gwei_fns.add(key)
+                        changed = True
+                    prod = self.cached_producer.get(callee)
+                    if prod and self.cached_producer.get(key) != prod:
+                        self.cached_producer[key] = prod
+                        changed = True
+                for callee_dotted, slot, feeders in fn.arg_flows:
+                    callee = self.resolve_function(
+                        self.qualify(display, callee_dotted))
+                    if callee is None:
+                        continue
+                    callee_reduce = self.reduce_params.get(callee)
+                    if callee_reduce:
+                        target = self._slot_param(callee, slot)
+                        if target in callee_reduce:
+                            mine = self.reduce_params.setdefault(key, set())
+                            new = set(feeders) - mine
+                            if new:
+                                mine |= new
+                                changed = True
+
+        # transitive raw-insert closure (a wrapper around a raw inserter
+        # is itself a raw inserter unless it routes through staging)
+        changed = True
+        while changed:
+            changed = False
+            for key, summary, fn in self._iter_functions():
+                if key in self.staging_routers:
+                    continue
+                mine = self.raw_inserters.setdefault(key, set())
+                for c in fn.calls:
+                    callee = self.resolve_function(self.qualify(summary.display, c))
+                    if callee and callee != key and callee in self.raw_inserters:
+                        if callee in self.staging_routers:
+                            continue
+                        new = self.raw_inserters[callee] - mine
+                        if new:
+                            mine |= new
+                            changed = True
+        self.raw_inserters = {k: v for k, v in self.raw_inserters.items() if v}
+
+    @staticmethod
+    def _is_staging_call(dotted: str) -> bool:
+        d = dotted.lstrip(".")
+        tail = d.rsplit(".", 1)[-1]
+        return tail in ("note_insert", "defer") and "staging" in d
+
+    def _slot_param(self, callee_key: str, slot) -> Optional[str]:
+        fn = self.summary_for_function(callee_key)
+        if isinstance(slot, str):
+            return slot if slot in fn.params else None
+        return fn.param_at(slot)
+
+
+def build_project(texts: Dict[str, str]) -> Project:
+    """Build a Project straight from {display: source} (fixture tests)."""
+    import ast as _ast
+
+    from .callgraph import summarize
+
+    summaries: List[FileSummary] = []
+    for display, text in texts.items():
+        try:
+            tree = _ast.parse(text)
+        except SyntaxError:
+            tree = None
+        summaries.append(summarize(display, tree))
+    return Project(summaries)
+
+
+def project_for(ctx) -> Optional[Project]:
+    """The runner's project, or a single-file mini-project so fixture
+    and legacy single-file runs still resolve same-file helpers."""
+    if ctx.project is not None:
+        return ctx.project
+    try:
+        return build_project({ctx.display: ctx.text})
+    except Exception:
+        return None

@@ -5,9 +5,12 @@ test asserts every rule has a unique code, a summary, and a docstring).
 from . import (  # noqa: F401
     cache_coherence,
     dtype_safety,
+    effect_safety,
     engine_rules,
+    host_sync,
     hygiene,
     jit_purity,
     key_coverage,
     rollback,
+    sharding_contract,
 )

@@ -25,7 +25,11 @@ in the same function:
   objects (``compute_shuffle_permutation`` returns the cached ndarray
   itself), so ``perm[i] = x`` after ``perm = compute_shuffle_permutation(...)``
   corrupts every later committee resolution.  The symbol pass tracks the
-  producing call through plain rebinding and derived views.
+  producing call through plain rebinding and derived views, and the
+  project call graph extends the fact across files: a helper that merely
+  RETURNS a producer's result IS that producer for this rule's purposes
+  (``rows = my_wrapper(...)`` where ``my_wrapper`` returns
+  ``registry_columns(...)`` hands out the same cached object).
 
 A write is pardoned when its enclosing function is a registered
 invalidator or calls one (``reset_caches()`` / ``reset_memo()``): wiping
@@ -144,6 +148,7 @@ class CacheCoherenceRule(Rule):
     summary = "cache-structure write outside the owning module"
 
     registry = CACHE_REGISTRY
+    _ctx = None
 
     def check(self, ctx):
         if ctx.tree is None or ctx.in_dir("specs"):
@@ -153,6 +158,7 @@ class CacheCoherenceRule(Rule):
         if not specs:
             return
         sym = ctx.symbols
+        self._ctx = ctx
         for node in ast.walk(ctx.tree):
             for spec, detail in self._writes(node, sym, specs):
                 if self._pardoned(node, sym, spec):
@@ -218,18 +224,31 @@ class CacheCoherenceRule(Rule):
         """The CacheSpec whose producer's return value ``expr`` is rooted
         in (via the scope's alias/origin tracking).  The producing call
         must resolve INTO the owner module (through an import or module
-        attribute): an unrelated local function that merely shares a
-        producer's name is not the cache."""
+        attribute) — an unrelated local function that merely shares a
+        producer's name is not the cache — OR, with the project graph
+        present, be a function the graph knows passes a producer's cached
+        object through (across any number of files)."""
         base = root_name(expr)
         if base is None:
             return None
         origin = sym.scope_of(node).origin_of(base)
-        if origin is None or "." not in origin.lstrip("."):
-            return None  # bare name: locally defined, not the owner's
-        prefix, last = origin.rsplit(".", 1)
-        for spec in specs:
-            if last in spec.producers and module_matches(prefix, spec.module):
-                return spec
+        if origin is None:
+            return None
+        if "." in origin.lstrip("."):
+            prefix, last = origin.rsplit(".", 1)
+            for spec in specs:
+                if last in spec.producers and module_matches(prefix,
+                                                             spec.module):
+                    return spec
+        proj = getattr(self._ctx, "project", None)
+        if proj is not None:
+            behind = proj.producer_behind(self._ctx.display, origin)
+            if behind:
+                prefix, last = behind.rsplit(".", 1)
+                for spec in specs:
+                    if last in spec.producers and module_matches(
+                            prefix, spec.module):
+                        return spec
         return None
 
     # -- pardons -------------------------------------------------------------

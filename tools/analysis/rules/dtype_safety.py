@@ -1,73 +1,58 @@
 """DT01 — Gwei dtype safety.
 
-``np.sum`` / ``np.cumsum`` / ``np.dot`` pick their accumulator from the
-input dtype — and when the input is anything but a 64-bit integer array
-(a bool mask promoted through ``np.where``, an int32 intermediate, a
-list), numpy accumulates in platform ``intp``.  Mainnet balances make
-that a live hazard: 400k validators × 32 ETH ≈ 1.3e16 Gwei, past int32
-by six orders of magnitude, and a 32-bit-``intp`` build wraps silently —
-a wrong total-active-balance changes justification thresholds with no
-exception anywhere.  The spec side is immune by construction (python
-ints); only the numpy fast paths can wrap.
+``np.sum`` / ``np.cumsum`` / ``np.dot`` / ``np.prod`` / ``np.matmul``
+pick their accumulator from the input dtype — and when the input is
+anything but a 64-bit integer array (a bool mask promoted through
+``np.where``, an int32 intermediate, a list), numpy accumulates in
+platform ``intp``.  Mainnet balances make that a live hazard: 400k
+validators × 32 ETH ≈ 1.3e16 Gwei, past int32 by six orders of
+magnitude, and a 32-bit-``intp`` build wraps silently — a wrong
+total-active-balance changes justification thresholds with no exception
+anywhere.  The spec side is immune by construction (python ints); only
+the numpy fast paths can wrap.
 
-DT01 flags ``np.sum``/``np.cumsum``/``np.dot`` calls (function or
-method form) whose reduced operand mentions a balance/weight identifier
-(``balance``, ``weight``, ``gwei``, ``reward``, ``penalt``, ``eff``)
-without an explicit 64-bit accumulator: pass ``dtype=np.uint64``
-(preferred for Gwei; ``np.int64`` is accepted where signed deltas are
-real).  ``jnp`` reductions are exempt — their width policy is the global
-x64 flag, set once in ``_jaxcache.configure``.  ``specs/src`` modules
-are exempt (pinned AST-for-AST to the reference)."""
+DT01 flags, on operands mentioning a balance/weight identifier
+(``balance``, ``weight``, ``gwei``, ``reward``, ``penalt``, ``eff``) —
+or whose producing call the project graph knows returns such a value:
+
+* reductions (function or method form) without an explicit 64-bit
+  accumulator: pass ``dtype=np.uint64`` (preferred for Gwei;
+  ``np.int64`` where signed deltas are real), or for the product forms
+  (``dot``/``matmul``/``@``) cast operands with ``.astype(np.uint64)``;
+* the ``@`` matmul operator under the same operand-cast remedy;
+* **narrowing casts**: ``.astype(int)`` (platform ``intp`` — the classic
+  bare-``int()`` narrowing), ``astype``/``dtype=`` of
+  ``int32``/``intc``/``intp``/``int16``/``int8``, and ``np.int32(x)``
+  constructor casts (scalar builtin ``int()`` is safe — python ints are
+  unbounded — and stays legal);
+* **interprocedural sinks**: a call passing a balance/weight array into
+  a function the call graph knows reduces that parameter without a
+  64-bit accumulator (facts follow helpers across files, e.g. through
+  ``ops/segment.py``-style wrappers whose parameter names carry no
+  hint).  Callsites whose callee-side parameter already carries a hint
+  are the callee's finding, not repeated here.
+
+``jnp`` reductions are exempt — their width policy is the global x64
+flag, set once in ``_jaxcache.configure`` — and so are method-form
+receivers the scope (or the project graph) proves hold a jax array.
+``specs/src`` modules are exempt (pinned AST-for-AST to the reference).
+"""
 from __future__ import annotations
 
 import ast
 
+from ..callgraph import (_OPERAND_CAST_REMEDY, _REDUCERS, dtype_ok,
+                         gwei_hint as _gwei_hint, has_ok_cast as _has_ok_cast)
 from ..core import Rule, register
 from ..symbols import root_name
 
-_REDUCERS = {"sum", "cumsum", "dot"}
-_HINT_SUBSTRINGS = ("balance", "weight", "gwei", "reward", "penalt")
-_HINT_EXACT = {"eff"}
-_OK_DTYPES = {"uint64", "int64", "u8", "i8"}
-
-
-def _gwei_hint(expr: ast.AST) -> bool:
-    """True when the expression mentions a balance/weight-ish identifier
-    (names, attributes, or string keys like cols["effective_balance"])."""
-    for node in ast.walk(expr):
-        word = None
-        if isinstance(node, ast.Name):
-            word = node.id
-        elif isinstance(node, ast.Attribute):
-            word = node.attr
-        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
-            word = node.value
-        if word is None:
-            continue
-        w = word.lower()
-        if w in _HINT_EXACT or any(h in w for h in _HINT_SUBSTRINGS):
-            return True
-    return False
-
-
-def _dtype_ok(call: ast.Call) -> bool:
-    for kw in call.keywords:
-        if kw.arg != "dtype":
-            continue
-        v = kw.value
-        if isinstance(v, ast.Attribute) and v.attr in _OK_DTYPES:
-            return True
-        if isinstance(v, ast.Name) and v.id in _OK_DTYPES:
-            return True
-        if isinstance(v, ast.Constant) and str(v.value) in _OK_DTYPES:
-            return True
-    return False
+_NARROW_DTYPES = {"int32", "intc", "intp", "int16", "int8"}
 
 
 @register
 class GweiDtypeRule(Rule):
-    """numpy reduction over a balance/weight array without an explicit
-    64-bit accumulator dtype."""
+    """numpy reduction or narrowing cast over a balance/weight array
+    without an explicit 64-bit accumulator."""
 
     code = "DT01"
     summary = "Gwei reduction without explicit dtype=np.uint64"
@@ -76,38 +61,169 @@ class GweiDtypeRule(Rule):
         if ctx.tree is None or ctx.is_spec_source:
             return
         sym = ctx.symbols
+        proj = ctx.project
+
+        def hinted(expr: ast.AST, node: ast.AST) -> bool:
+            if _gwei_hint(expr):
+                return True
+            if proj is None:
+                return False
+            # a name fed by a helper the graph knows returns gwei values
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name):
+                    origin = sym.scope_of(node).origin_of(n.id)
+                    if origin and proj.returns_gwei(ctx.display, origin):
+                        return True
+            return False
+
+        def receiver_is_jax(node: ast.AST, base) -> bool:
+            if base is None:
+                return False
+            origin = sym.scope_of(node).origin_of(base)
+            if origin is None:
+                return False
+            if origin.lstrip(".").split(".")[0] in ("jax", "jnp"):
+                return True
+            return proj is not None and proj.returns_device(
+                ctx.display, origin)
+
         for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+                operands = [node.left, node.right]
+                if (any(hinted(op, node) for op in operands)
+                        and not any(_has_ok_cast(op) for op in operands)):
+                    yield (node.lineno,
+                           "@ (matmul) over a balance/weight array "
+                           "accumulates in the input dtype (platform-intp "
+                           "overflow at mainnet balances; cast operands "
+                           "with .astype(np.uint64))")
+                continue
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
-            if not isinstance(f, ast.Attribute) or f.attr not in _REDUCERS:
-                continue
-            resolved = sym.resolve(f)
-            if resolved and resolved.lstrip(".").startswith("numpy."):
-                operands = node.args  # np.sum(x) / np.dot(a, b)
-            elif resolved and (resolved.lstrip(".").startswith("jax.")
-                               or resolved.lstrip(".").startswith("jnp.")):
-                continue  # jnp width policy is the global x64 flag
+            if isinstance(f, ast.Attribute) and f.attr in _REDUCERS:
+                yield from self._check_reduction(node, f, sym, hinted,
+                                                 receiver_is_jax)
+            elif isinstance(f, ast.Attribute) and f.attr == "astype":
+                yield from self._check_astype(node, f, hinted)
             else:
-                # x.sum() / a.dot(b) — skip receivers that provably hold
-                # a jax array (assigned from a jax/jnp call in scope)
-                base = root_name(f.value)
-                origin = (sym.scope_of(node).origin_of(base)
-                          if base else None)
-                if origin and origin.lstrip(".").split(".")[0] in ("jax", "jnp"):
-                    continue
-                operands = [f.value, *node.args]
-            if not any(_gwei_hint(op) for op in operands):
-                continue
-            if _dtype_ok(node):
-                continue
-            if f.attr == "dot" and any(
-                    isinstance(n, ast.Attribute) and n.attr in _OK_DTYPES
-                    for op in operands for n in ast.walk(op)):
-                continue  # operands already cast with .astype(np.uint64)
+                yield from self._check_narrow_ctor(node, sym, hinted)
+                if proj is not None:
+                    yield from self._check_callsite(node, sym, proj, ctx,
+                                                    hinted)
+            if isinstance(f, ast.Attribute) or isinstance(f, ast.Name):
+                yield from self._check_dtype_kwarg(node, hinted)
+
+    # -- reduction forms ------------------------------------------------------
+
+    def _check_reduction(self, node, f, sym, hinted, receiver_is_jax):
+        resolved = sym.resolve(f)
+        if resolved and resolved.lstrip(".").startswith("numpy."):
+            operands = node.args  # np.sum(x) / np.dot(a, b)
+        elif resolved and (resolved.lstrip(".").startswith("jax.")
+                           or resolved.lstrip(".").startswith("jnp.")):
+            return  # jnp width policy is the global x64 flag
+        else:
+            # x.sum() / a.dot(b) — skip receivers that provably hold
+            # a jax array (assigned from a jax/jnp call in scope, or a
+            # device-returning helper the project graph knows)
+            if receiver_is_jax(node, root_name(f.value)):
+                return
+            operands = [f.value, *node.args]
+        if not any(hinted(op, node) for op in operands):
+            return
+        if dtype_ok(node):
+            return
+        if f.attr in _OPERAND_CAST_REMEDY and any(
+                _has_ok_cast(op) for op in operands):
+            return  # operands already cast with .astype(np.uint64)
+        if any(kw.arg == "dtype" and (
+                (isinstance(kw.value, ast.Name) and kw.value.id == "int")
+                or (isinstance(kw.value, ast.Attribute)
+                    and kw.value.attr in _NARROW_DTYPES))
+               for kw in node.keywords):
+            return  # an explicitly narrow dtype is _check_dtype_kwarg's finding
+        yield (node.lineno,
+               f"np.{f.attr} over a balance/weight array without an "
+               "explicit 64-bit accumulator (platform-intp overflow at "
+               "mainnet balances; pass dtype=np.uint64"
+               + (" or cast operands with .astype(np.uint64)"
+                  if f.attr in _OPERAND_CAST_REMEDY else "") + ")")
+
+    # -- narrowing casts ------------------------------------------------------
+
+    def _check_astype(self, node, f, hinted):
+        if not node.args or not hinted(f.value, node):
+            return
+        arg = node.args[0]
+        narrow = None
+        if isinstance(arg, ast.Name) and arg.id == "int":
+            narrow = "int (platform intp)"
+        elif isinstance(arg, ast.Attribute) and arg.attr in _NARROW_DTYPES:
+            narrow = f"np.{arg.attr}"
+        elif isinstance(arg, ast.Constant) and str(arg.value) in _NARROW_DTYPES:
+            narrow = repr(arg.value)
+        if narrow:
             yield (node.lineno,
-                   f"np.{f.attr} over a balance/weight array without an "
-                   "explicit 64-bit accumulator (platform-intp overflow at "
-                   "mainnet balances; pass dtype=np.uint64"
-                   + (" or cast operands with .astype(np.uint64)"
-                      if f.attr == "dot" else "") + ")")
+                   f".astype({narrow}) narrows a balance/weight array below "
+                   "64 bits (wraps at mainnet balances; use np.uint64 / "
+                   "np.int64)")
+
+    def _check_narrow_ctor(self, node, sym, hinted):
+        resolved = sym.resolve(node.func)
+        if not resolved:
+            return
+        r = resolved.lstrip(".")
+        if (r.startswith("numpy.") and r.rsplit(".", 1)[-1] in _NARROW_DTYPES
+                and node.args and hinted(node.args[0], node)):
+            yield (node.lineno,
+                   f"np.{r.rsplit('.', 1)[-1]}() narrows a balance/weight "
+                   "value below 64 bits (wraps at mainnet balances)")
+
+    def _check_dtype_kwarg(self, node, hinted):
+        # method-form receivers (balances.sum(dtype=...)) count as operands
+        operands = list(node.args)
+        if isinstance(node.func, ast.Attribute):
+            operands.append(node.func.value)
+        for kw in node.keywords:
+            if kw.arg != "dtype":
+                continue
+            v = kw.value
+            narrow = None
+            if isinstance(v, ast.Name) and v.id == "int":
+                narrow = "int (platform intp)"
+            elif isinstance(v, ast.Attribute) and v.attr in _NARROW_DTYPES:
+                narrow = f"np.{v.attr}"
+            if narrow and any(hinted(a, node) for a in operands):
+                yield (node.lineno,
+                       f"dtype={narrow} narrows a balance/weight array "
+                       "below 64 bits (wraps at mainnet balances)")
+
+    # -- interprocedural callsites -------------------------------------------
+
+    def _check_callsite(self, node, sym, proj, ctx, hinted):
+        dotted = sym.resolve(node.func)
+        key, reducing = proj.reducing_params_of(ctx.display, dotted)
+        if not reducing:
+            return
+        summary = proj.summary_for_function(key)
+        flagged = set()
+        for slot, arg in enumerate(node.args):
+            param = summary.param_at(slot)
+            if param in reducing and param not in flagged \
+                    and not _gwei_hint(ast.Name(id=param)) \
+                    and hinted(arg, node) and not _has_ok_cast(arg):
+                flagged.add(param)
+        for kw in node.keywords:
+            if kw.arg in reducing and kw.arg not in flagged \
+                    and not _gwei_hint(ast.Name(id=kw.arg)) \
+                    and hinted(kw.value, node) and not _has_ok_cast(kw.value):
+                flagged.add(kw.arg)
+        if flagged:
+            tail = key.rsplit(".", 1)[-1]
+            yield (node.lineno,
+                   f"passes a balance/weight array into {tail}(), which "
+                   f"reduces parameter{'s' if len(flagged) > 1 else ''} "
+                   f"{', '.join(sorted(flagged))} without an explicit "
+                   "64-bit accumulator (call-graph fact; fix the callee "
+                   "or cast at the boundary)")

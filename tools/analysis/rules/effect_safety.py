@@ -1,0 +1,178 @@
+"""EF01 — effect safety: cache mutations adjacent to fault probes must
+be transactional.
+
+PR 5's chaos harness proved the containment story by hand: every insert
+a block makes into a process-global memo is either tracked with
+``stf/staging.note_insert`` (undo log popped on block failure) or
+deferred with ``staging.defer`` until the block settles.  That audit was
+manual; this rule makes it an invariant.  The hazard shape is precise: a
+function that both **touches a registered cache** and **contains a
+``faults.py`` probe site** is a function where an injected fault can
+strand a just-written entry — the probe raises after the insert, the
+block replays, and a poisoned value survives for every later block.
+
+EF01 flags, in any function of an instrumented module (one binding
+``_SITE = faults.site(...)`` probes), an insert into a registered memo
+(``CACHE[k] = v``, ``CACHE.update/setdefault``, helper-put
+``helper(CACHE, k, v)``, or a call into a function the project graph
+knows raw-inserts) UNLESS the mutation is routed:
+
+* the function calls ``staging.note_insert`` itself, or the helper it
+  delegates to (``_fifo_put``) transitively does — the project graph
+  follows this across files;
+* the function is registered as a deferred commit (passed to
+  ``staging.defer`` anywhere in the file) — it only ever runs after the
+  block settles;
+* the function is the cache's registered invalidator (``reset_*``), or
+  the insert sits in a ``try`` whose handler/finally invalidates the
+  cache (``pop``/``clear``/``del``/``= None``).
+
+Instance-attribute caches (the fork-choice head) invalidate in
+``finally`` blocks CC01 already audits; EF01 scopes to the dict-shaped
+module-global memos where stranded entries are possible.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Rule, register
+from ..dataflow import project_for as _project_for
+from ..symbols import name_matches
+from .cache_coherence import CACHE_REGISTRY
+
+_INSERTING_METHODS = {"update", "setdefault"}
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@register
+class EffectSafetyRule(Rule):
+    """Registered-cache insert in a fault-probed function not routed
+    through stf/staging (note_insert/defer) or a try/finally invalidation."""
+
+    code = "EF01"
+    summary = "unroutable cache insert next to a fault probe"
+
+    registry = CACHE_REGISTRY
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.in_dir("specs", "tests", "testing"):
+            return
+        sym = ctx.symbols
+        # probe names: module-level ``X = faults.site("...")`` bindings
+        probe_names = {
+            name for name, dotted in sym.scope_info(None).origins.items()
+            if name_matches(dotted, {"site"}) and "faults" in (dotted or "")}
+        if not probe_names:
+            return
+        cache_names: Set[str] = set()
+        invalidators: Set[str] = set()
+        for spec in self.registry:
+            cache_names |= spec.module_globals
+            invalidators |= spec.invalidators
+        proj = _project_for(ctx)
+        defer_targets = self._defer_targets(ctx, proj)
+
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, _FUNC_NODES):
+                continue
+            if fn.name in defer_targets or fn.name in invalidators:
+                continue
+            body_nodes = list(ast.walk(fn))
+            has_probe = any(
+                isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id in probe_names for n in body_nodes)
+            if not has_probe:
+                continue
+            routed = any(
+                isinstance(n, ast.Call)
+                and name_matches(sym.resolve(n.func), {"note_insert", "defer"})
+                and "staging" in (sym.resolve(n.func) or "")
+                for n in body_nodes)
+            for lineno, cache, detail in self._inserts(
+                    fn, sym, cache_names, proj, ctx):
+                if routed or self._try_invalidates(fn, sym, cache):
+                    continue
+                yield (lineno,
+                       f"{detail} of {cache} in '{fn.name}', which probes a "
+                       "fault site: an injected fault can strand the entry. "
+                       "Route it through stf/staging (note_insert/defer) or "
+                       "invalidate in try/finally")
+
+    # -- insert detection ----------------------------------------------------
+
+    def _inserts(self, fn, sym, cache_names: Set[str], proj, ctx):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in cache_names):
+                        yield node.lineno, t.value.id, "direct insert"
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _INSERTING_METHODS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in cache_names):
+                    yield node.lineno, f.value.id, f".{f.attr}() insert"
+                elif (node.args and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in cache_names
+                        and len(node.args) >= 2):
+                    # helper-put shape: helper(CACHE, key, value)
+                    dotted = sym.resolve(f)
+                    if proj is not None and proj.routes_through_staging(
+                            ctx.display, dotted):
+                        continue
+                    yield (node.lineno, node.args[0].id,
+                           "helper insert (helper does not route through "
+                           "staging)")
+                else:
+                    dotted = sym.resolve(f)
+                    if proj is None or dotted is None:
+                        continue
+                    if proj.routes_through_staging(ctx.display, dotted):
+                        continue
+                    stranded = proj.raw_inserts_of(ctx.display, dotted)
+                    for cache in sorted(stranded & cache_names):
+                        yield (node.lineno, cache,
+                               f"insert via {dotted.rsplit('.', 1)[-1]}()")
+
+    # -- pardons -------------------------------------------------------------
+
+    @staticmethod
+    def _defer_targets(ctx, proj) -> Set[str]:
+        if proj is not None and ctx.display in proj.files:
+            return set(proj.files[ctx.display].defer_targets)
+        return set()
+
+    def _try_invalidates(self, fn, sym, cache: str) -> bool:
+        """True when some try-statement in the function both contains an
+        insert into ``cache`` and invalidates it in a handler/finally."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            cleanup: List[ast.AST] = list(node.finalbody)
+            for h in node.handlers:
+                cleanup.extend(h.body)
+            for c in cleanup:
+                for n in ast.walk(c):
+                    if (isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Attribute)
+                            and n.func.attr in ("pop", "clear")
+                            and isinstance(n.func.value, ast.Name)
+                            and n.func.value.id == cache):
+                        return True
+                    if (isinstance(n, ast.Delete) and any(
+                            isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == cache for t in n.targets)):
+                        return True
+                    if (isinstance(n, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == cache
+                            for t in n.targets)
+                            and isinstance(n.value, ast.Constant)
+                            and n.value.value is None):
+                        return True
+        return False
+

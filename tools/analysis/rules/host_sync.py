@@ -1,0 +1,158 @@
+"""HD01 — implicit device->host synchronization on the hot path.
+
+BENCH_DETAILS' binding-limit analysis says the epoch and merkle paths
+are host-orchestration bound: the device kernels touch microseconds of
+HBM while the host pays seconds flattening committees and — the part
+this rule polices — silently pulling device arrays back.  Every
+``np.asarray(device_array)``, ``float(jnp_scalar)``, ``.item()``,
+``.tolist()`` or plain iteration over a device value is a blocking
+transfer + sync; one stray pull-back inside ``ops/``, ``stf/``,
+``parallel/`` or ``forkchoice/`` can erase a sharded kernel's entire
+win, and nothing fails — the code is merely seconds slower.
+
+HD01 tracks **device-residency taint**: a value is device-resident when
+it originates (through the scope's alias/origin chains, tuple unpacks
+included) in a ``jax.*``/``jnp.*`` call, ``jax.device_put``, the result
+of calling a ``jax.jit``/``shard_map``-compiled callable (including one
+bound at module scope, ``_jit_kernel = jax.jit(f)``), or — via the
+project call graph — any function another file defines that returns such
+a value.  On tainted values it flags the sync sinks above.
+
+The sanctioned escape hatch is a **declared boundary**: a trailing
+``# host-sync: <why>`` comment on the flagged line, or a standalone
+comment line directly above the statement (for lines with no room).
+Unlike ``# noqa`` this is a positive annotation — it documents that the
+transfer is a deliberate staged view (e.g. the epoch kernel's single
+result pull-back) and requires a non-empty justification; a bare
+``# host-sync:`` does not suppress.  The declared boundaries are exactly the places the
+device-resident refactor (ROADMAP item 3) must revisit.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from ..core import Rule, register
+from ..dataflow import project_for as _project_for
+
+_HOST_CASTS = {"float", "int", "bool"}
+_SYNC_METHODS = {"item", "tolist"}
+_NP_PULLS = {"asarray", "array"}
+_HOT_DIRS = ("ops", "stf", "parallel", "forkchoice")
+_BOUNDARY_RE = re.compile(r"#\s*host-sync:\s*\S")
+
+
+@register
+class HostSyncRule(Rule):
+    """Implicit device->host transfer on a device-tainted value inside a
+    hot-path module, without a declared ``# host-sync:`` boundary."""
+
+    code = "HD01"
+    summary = "implicit device->host sync on the hot path"
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.in_dir("specs", "tests", "testing"):
+            return
+        if not ("consensus_specs_tpu" in ctx.parts
+                and ctx.in_dir(*_HOT_DIRS)):
+            return
+        sym = ctx.symbols
+        proj = _project_for(ctx)
+        declared = set()
+        for i, line in enumerate(ctx.lines, 1):
+            if not _BOUNDARY_RE.search(line):
+                continue
+            declared.add(i)
+            if line.lstrip().startswith("#"):
+                # standalone annotation: covers the first statement after
+                # its comment block
+                j = i + 1
+                while (j <= len(ctx.lines)
+                       and ctx.lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                declared.add(j)
+
+        def origin_is_device(dotted: Optional[str]) -> bool:
+            from ..dataflow import dotted_is_device_seed
+
+            if dotted is None:
+                return False
+            if dotted_is_device_seed(dotted):
+                return True
+            if "." not in dotted.lstrip("."):
+                # a bare name: follow a module-scope binding like
+                # ``_jit_kernel = jax.jit(_deltas_kernel)``
+                mod_origin = sym.scope_info(None).origins.get(dotted)
+                if mod_origin and dotted_is_device_seed(mod_origin):
+                    return True
+            return proj is not None and proj.returns_device(ctx.display, dotted)
+
+        def name_is_device(node: ast.AST, name: str) -> bool:
+            scope = sym.scope_of(node)
+            origin = scope.origin_of(name)
+            if origin is None:
+                root = scope.resolve_root(name)
+                origin = sym.scope_info(None).origins.get(root)
+            return origin_is_device(origin)
+
+        def tainted(expr: ast.AST, node: ast.AST) -> bool:
+            if isinstance(expr, ast.Name):
+                return name_is_device(node, expr.id)
+            if isinstance(expr, ast.Call):
+                dotted = sym.resolve(expr.func)
+                if origin_is_device(dotted):
+                    return True
+                if isinstance(expr.func, ast.Name) and name_is_device(
+                        node, expr.func.id):
+                    return True  # calling a device-compiled callable
+                if isinstance(expr.func, ast.Call):
+                    return tainted(expr.func, node)
+                return False
+            if isinstance(expr, (ast.Subscript, ast.Attribute, ast.Starred)):
+                return tainted(expr.value, node)
+            if isinstance(expr, (ast.Tuple, ast.List)):
+                return any(tainted(e, node) for e in expr.elts)
+            if isinstance(expr, ast.BinOp):
+                return tainted(expr.left, node) or tainted(expr.right, node)
+            if isinstance(expr, ast.UnaryOp):
+                return tainted(expr.operand, node)
+            return False
+
+        def boundary_declared(node: ast.AST) -> bool:
+            # one declaration covers the whole enclosing statement: a
+            # multi-value return's second pull-back is the same boundary
+            stmt = node
+            while stmt is not None and not isinstance(stmt, ast.stmt):
+                stmt = sym.parent.get(stmt)
+            anchor = stmt or node
+            end = getattr(anchor, "end_lineno", anchor.lineno) or anchor.lineno
+            return any(line in declared
+                       for line in range(anchor.lineno, end + 1))
+
+        for node in ast.walk(ctx.tree):
+            hit = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                dotted = sym.resolve(f)
+                if (dotted and dotted.lstrip(".").startswith("numpy.")
+                        and dotted.rsplit(".", 1)[-1] in _NP_PULLS
+                        and node.args and tainted(node.args[0], node)):
+                    hit = f"np.{dotted.rsplit('.', 1)[-1]} pulls a device array to host"
+                elif (isinstance(f, ast.Name) and f.id in _HOST_CASTS
+                        and f.id not in sym.imports and node.args
+                        and tainted(node.args[0], node)):
+                    hit = f"{f.id}() forces a device->host scalar sync"
+                elif (isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS
+                        and tainted(f.value, node)):
+                    hit = f".{f.attr}() forces a device->host transfer"
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if tainted(node.iter, node):
+                    hit = "iterating a device array syncs once per element"
+            if hit is None or boundary_declared(node):
+                continue
+            yield (node.lineno,
+                   f"{hit} inside a hot-path module; keep the value "
+                   "device-resident or declare the staged view with "
+                   "`# host-sync: <why>`")
+

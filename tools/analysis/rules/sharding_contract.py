@@ -1,0 +1,140 @@
+"""SH01 — sharding contracts at shard_map / pjit callsites.
+
+``in_specs``/``out_specs`` are the source of truth for what lives where
+(the SNIPPETS pjit/shard_map contract pattern): a callsite that omits
+them, or names a mesh axis the project's mesh module never declared,
+compiles fine on a 1-device CPU harness and then silently replicates —
+or crashes — on the real pod.  The third contract is divisibility: a
+sharded dimension that does not divide by the mesh size either errors at
+dispatch or pads implicitly with garbage, so the module must visibly
+guard it (the ragged-batch assert in ``parallel/bls_sharded.py`` and the
+pad-to-multiple helpers in ``parallel/epoch_sharded.py`` are the two
+sanctioned shapes).
+
+SH01 checks every ``shard_map``/``pjit`` callsite (direct call,
+``jax.shard_map(...)``, or the ``functools.partial(jax.shard_map, ...)``
+decorator form):
+
+* ``in_specs`` AND ``out_specs`` must be bound as keywords (for ``pjit``,
+  ``in_shardings``/``out_shardings`` are the accepted spelling);
+* every string literal inside those spec expressions must be a mesh-axis
+  name declared by ``parallel/mesh.py`` (the project pass collects the
+  axis-parameter defaults; with no project — single-file fixture runs —
+  the known-good ``"v"`` axis is assumed);
+* the module must contain a divisibility guard: an ``assert``/branch
+  test using ``%``, or a binding/call whose name mentions ``pad``.
+
+``specs/`` sources are exempt (reference-pinned).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from ..core import Rule, register
+from ..symbols import name_matches
+
+_SPEC_KWARGS = {
+    "shard_map": ("in_specs", "out_specs"),
+    "pjit": ("in_shardings", "out_shardings"),
+}
+_DEFAULT_AXES = {"v"}
+
+
+def _tracer_kind(resolved: Optional[str]) -> Optional[str]:
+    if not resolved:
+        return None
+    r = resolved.lstrip(".")
+    if r == "shard_map" or r.endswith(".shard_map"):
+        return "shard_map"
+    if r.endswith(".pjit") or r == "pjit":
+        return "pjit"
+    return None
+
+
+@register
+class ShardingContractRule(Rule):
+    """shard_map/pjit callsite missing in_specs/out_specs, naming an
+    undeclared mesh axis, or in a module with no divisibility guard."""
+
+    code = "SH01"
+    summary = "shard_map/pjit callsite violates the sharding contract"
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.in_dir("specs"):
+            return
+        sym = ctx.symbols
+        allowed = self._allowed_axes(ctx)
+        guarded = self._has_divisibility_guard(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _tracer_kind(sym.resolve(node.func))
+            if kind is None and name_matches(sym.resolve(node.func),
+                                             {"partial"}) and node.args:
+                kind = _tracer_kind(sym.resolve(node.args[0]))
+            if kind is None:
+                continue
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            want_in, want_out = _SPEC_KWARGS[kind]
+            missing = [w for w in (want_in, want_out) if w not in kw]
+            if missing:
+                yield (node.lineno,
+                       f"{kind} callsite does not bind "
+                       f"{' / '.join(missing)} (partition specs are the "
+                       "source of truth for what lives where; bind them "
+                       "explicitly with mesh axes from parallel/mesh.py)")
+            bad_axes = sorted({a for w in (want_in, want_out) if w in kw
+                               for a in self._axis_literals(kw[w])
+                               if a not in allowed})
+            if bad_axes:
+                yield (node.lineno,
+                       f"{kind} partition spec names mesh ax"
+                       f"{'es' if len(bad_axes) > 1 else 'is'} "
+                       f"{', '.join(map(repr, bad_axes))} not declared by "
+                       f"parallel/mesh.py (declared: {sorted(allowed)})")
+            if not guarded:
+                yield (node.lineno,
+                       f"{kind} callsite in a module with no sharded-dim "
+                       "divisibility guard: assert the batch divides the "
+                       "mesh size (cf. parallel/bls_sharded.py) or pad to "
+                       "a multiple (cf. parallel/epoch_sharded.py)")
+
+    # -- helpers -------------------------------------------------------------
+
+    def _allowed_axes(self, ctx) -> Set[str]:
+        proj = ctx.project
+        if proj is not None:
+            axes = proj.mesh_axis_names()
+            if axes:
+                return axes
+        return set(_DEFAULT_AXES)
+
+    @staticmethod
+    def _axis_literals(spec_expr: ast.AST):
+        for n in ast.walk(spec_expr):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                yield n.value
+
+    @staticmethod
+    def _has_divisibility_guard(tree: ast.AST) -> bool:
+        for node in ast.walk(tree):
+            test = None
+            if isinstance(node, ast.Assert):
+                test = node.test
+            elif isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            if test is not None and any(
+                    isinstance(b, ast.BinOp) and isinstance(b.op, ast.Mod)
+                    for b in ast.walk(test)):
+                return True
+            word = None
+            if isinstance(node, ast.Name):
+                word = node.id
+            elif isinstance(node, ast.Attribute):
+                word = node.attr
+            elif isinstance(node, ast.FunctionDef):
+                word = node.name
+            if word and "pad" in word.lower():
+                return True
+        return False

@@ -1,11 +1,29 @@
-"""Analysis runner: file iteration, the incremental cache, noqa and
-baseline filtering, and the JSON report.
+"""Analysis runner: the two-pass pipeline, the incremental cache, noqa
+and baseline filtering, and the JSON report.
 
 ``run()`` is the one entry point every consumer shares — the ``make
 lint`` / ``make analyze`` CLI (tools/lint.py), the tier-1 gate
 (tests/analysis/test_live_tree_clean.py), and the mutation tests (via
 ``overrides``, which analyze hypothetical file contents against the real
 tree without touching disk).
+
+The pipeline is two passes over the tree:
+
+1. **summaries** — every file is reduced to its ``callgraph.FileSummary``
+   (cached by content hash, so a warm run parses nothing), and the
+   summaries become the ``dataflow.Project`` — the whole-tree call graph
+   with device/gwei/reduction/staging facts propagated to a fixed point;
+2. **rules** — every file runs the rule registry with ``ctx.project``
+   set, so interprocedural rules (HD01/EF01, call-graph-aware DT01/CC01)
+   see cross-file facts.  Findings are cached keyed on the file's own
+   sha AND the shas of its transitive import closure: editing a leaf
+   helper re-derives exactly its dependents.
+
+Cache policy: rule-subset runs and ``overrides`` runs READ the cache
+(full-registry findings filtered down to the requested codes; override
+files and their dependents miss by construction because the dependency
+digest shifts) but never write it — only a full-registry, no-override
+run may seed entries a later run will trust.
 """
 from __future__ import annotations
 
@@ -18,7 +36,9 @@ from typing import Dict, List, Optional
 
 from .baseline import Baseline
 from .cachefile import AnalysisCache, text_digest
+from .callgraph import FileSummary, summarize
 from .core import FileContext, Finding, all_rules
+from .dataflow import Project
 from .noqa import parse_noqa, suppressed
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
@@ -56,25 +76,38 @@ def analyzer_version() -> str:
     return h.hexdigest()
 
 
-def analyze_text(path, text: str, display: Optional[str] = None,
-                 rules=None) -> List[Finding]:
-    """Analyze one file's content: all rules + per-code noqa filtering.
-    Baseline matching is the caller's concern (``run`` applies it)."""
-    ctx = FileContext.build(path, text, display=display)
+def _check_ctx(ctx: FileContext, rules, stats=None) -> List[Finding]:
+    """Run rules over a built context: noqa filtering + per-rule stats."""
     noqa = parse_noqa(ctx.lines)
     findings: List[Finding] = []
-    for rule in (rules if rules is not None else all_rules()):
-        for line, message in rule.check(ctx):
+    for rule in rules:
+        t0 = time.perf_counter()
+        raw = list(rule.check(ctx))
+        kept = 0
+        for line, message in raw:
             if suppressed(noqa, line, rule.code):
                 continue
+            kept += 1
             findings.append(Finding(ctx.display, line, rule.code, message,
                                     ctx.snippet(line)))
+        if stats is not None:
+            s = stats.setdefault(rule.code, {"time_s": 0.0, "findings": 0})
+            s["time_s"] += time.perf_counter() - t0
+            s["findings"] += kept
     findings.sort(key=lambda f: (f.line, f.code))
     return findings
 
 
+def analyze_text(path, text: str, display: Optional[str] = None,
+                 rules=None, project=None) -> List[Finding]:
+    """Analyze one file's content: all rules + per-code noqa filtering.
+    Baseline matching is the caller's concern (``run`` applies it)."""
+    ctx = FileContext.build(path, text, display=display, project=project)
+    return _check_ctx(ctx, rules if rules is not None else all_rules())
+
+
 def analyze_file(path, text: Optional[str] = None, root: Optional[Path] = None,
-                 rules=None) -> List[Finding]:
+                 rules=None, project=None) -> List[Finding]:
     p = Path(path)
     display = _display(p, root or REPO_ROOT)
     if text is None:
@@ -83,7 +116,8 @@ def analyze_file(path, text: Optional[str] = None, root: Optional[Path] = None,
         except UnicodeDecodeError as e:
             return [Finding(display, 0, "E902",
                             f"not valid UTF-8: {e.reason}")]
-    return analyze_text(p, text, display=display, rules=rules)
+    return analyze_text(p, text, display=display, rules=rules,
+                        project=project)
 
 
 @dataclass
@@ -94,6 +128,9 @@ class Result:
     n_files: int = 0
     cache_hits: int = 0
     duration_s: float = 0.0
+    # per-rule wall time + unsuppressed finding counts over the files
+    # actually analyzed this run (cache hits skip rule execution)
+    rule_stats: Dict[str, dict] = field(default_factory=dict)
 
     def to_json(self) -> dict:
         def row(f: Finding) -> dict:
@@ -104,10 +141,29 @@ class Result:
             "files_analyzed": self.n_files,
             "cache_hits": self.cache_hits,
             "duration_s": round(self.duration_s, 3),
+            "rule_stats": {
+                code: {"time_s": round(s["time_s"], 4),
+                       "findings": s["findings"]}
+                for code, s in sorted(self.rule_stats.items())},
             "findings": [row(f) for f in self.findings],
             "baselined": [row(f) for f in self.baselined],
             "stale_baseline": self.stale_baseline,
         }
+
+
+@dataclass
+class _Entry:
+    """One scanned file flowing through the two passes."""
+
+    path: Path
+    display: str
+    text: Optional[str] = None          # None: not valid UTF-8 (E902)
+    digest: str = ""
+    error: Optional[Finding] = None
+    overridden: bool = False
+    report: bool = True                 # False: project-graph-only (pass 1)
+    summary: Optional[FileSummary] = None
+    ctx: Optional[FileContext] = None   # kept when pass 1 had to parse
 
 
 def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
@@ -117,60 +173,122 @@ def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
 
     ``overrides`` maps display paths (repo-relative posix) to replacement
     text: those files are analyzed with the given content instead of what
-    is on disk (and bypass the cache) — the seeded-mutation tests use this
-    to prove a reintroduced bug turns the gate red.
+    is on disk — the seeded-mutation tests use this to prove a
+    reintroduced bug turns the gate red.  Override and rule-subset runs
+    consult the cache read-only for untouched files.
     """
     t0 = time.perf_counter()
     root = Path(root) if root else REPO_ROOT
     roots = list(roots) if roots else [root / r for r in DEFAULT_ROOTS]
     rule_objs = rules if rules is not None else all_rules()
+    subset_codes = {r.code for r in rule_objs} if rules is not None else None
     baseline = Baseline.load(
         baseline_path if baseline_path is not None else DEFAULT_BASELINE)
-    # cached findings are only valid for the FULL registry: a rules=
-    # subset run must never seed entries a later full run would trust
-    use_cache = use_cache and rules is None
+    overrides = overrides or {}
     cache = AnalysisCache(
         (cache_path if cache_path is not None else DEFAULT_CACHE)
         if use_cache else None,
         analyzer_version())
-    overrides = overrides or {}
+    # cached findings are only valid for the FULL registry on the REAL
+    # tree: subset/override runs read (filtered) but must never seed
+    # entries a later full run would trust
+    write_cache = use_cache and rules is None and not overrides
 
     result = Result()
+    entries: List[_Entry] = []
     scanned = set()
-    for path in iter_py_files(roots):
-        display = _display(path, root)
-        if display in scanned:
-            continue  # overlapping roots must not double-report findings
-        scanned.add(display)
-        result.n_files += 1
-        if display in overrides:
-            findings = analyze_text(path, overrides[display],
-                                    display=display, rules=rule_objs)
-        else:
-            try:
-                text = path.read_text()
-            except UnicodeDecodeError as e:
-                result.findings.append(Finding(
-                    display, 0, "E902", f"not valid UTF-8: {e.reason}"))
-                continue
-            digest = text_digest(text)
-            findings = cache.get(display, digest) if use_cache else None
-            if findings is None:
-                findings = analyze_text(path, text, display=display,
-                                        rules=rule_objs)
-                cache.put(display, digest, findings)
+
+    def scan(paths, report: bool):
+        for path in paths:
+            display = _display(path, root)
+            if display in scanned:
+                continue  # overlapping roots must not double-report findings
+            scanned.add(display)
+            e = _Entry(path=path, display=display, report=report)
+            if display in overrides:
+                e.text = overrides[display]
+                e.overridden = True
+            else:
+                try:
+                    e.text = path.read_text()
+                except UnicodeDecodeError as exc:
+                    e.error = Finding(display, 0, "E902",
+                                      f"not valid UTF-8: {exc.reason}")
+            if e.text is not None:
+                e.digest = text_digest(e.text)
+            entries.append(e)
+
+    scan(iter_py_files(roots), report=True)
+    # widen pass 1 to the default roots: a path-scoped run (``python
+    # tools/lint.py stf/verify.py``) still builds the WHOLE project
+    # graph, so its cross-file facts — and its cache digests — are
+    # identical to a full run's; the extra files skip pass 2
+    scan(iter_py_files([root / r for r in DEFAULT_ROOTS]), report=False)
+    reported = {e.display for e in entries if e.report}
+    result.n_files = len(reported)
+
+    # -- pass 1: per-file call-graph summaries -> the project graph ----------
+    for e in entries:
+        if e.text is None:
+            e.summary = FileSummary(display=e.display, module="")
+            continue
+        cached = (cache.get_summary(e.display, e.digest)
+                  if use_cache and not e.overridden else None)
+        if cached is not None:
+            e.summary = FileSummary.from_json(cached)
+            continue
+        e.ctx = FileContext.build(e.path, e.text, display=e.display)
+        e.summary = summarize(e.display, e.ctx.tree,
+                              e.ctx.symbols if e.ctx.tree else None)
+        if write_cache:
+            cache.put_summary(e.display, e.digest, e.summary.to_json())
+    project = Project([e.summary for e in entries])
+
+    # the dependency digest folds in everything outside the file's own
+    # bytes that can influence its findings: the shas of its transitive
+    # import closure, plus the project-wide mesh-axis vocabulary SH01
+    # reads regardless of imports
+    shas = {e.display: e.digest for e in entries}
+    axis_salt = ",".join(sorted(project.mesh_axis_names()))
+
+    def deps_digest(display: str) -> str:
+        h = hashlib.sha256(axis_salt.encode())
+        for dep in sorted(project.dependencies(display)):
+            h.update(dep.encode())
+            h.update(shas.get(dep, "?").encode())
+        return h.hexdigest()
+
+    # -- pass 2: rules with ctx.project set ----------------------------------
+    for e in entries:
+        if not e.report:
+            continue  # project-graph-only: summaries feed pass 2, no findings
+        if e.error is not None:
+            result.findings.append(e.error)
+            continue
+        dd = deps_digest(e.display)
+        findings = (cache.get_findings(e.display, e.digest, dd)
+                    if use_cache and not e.overridden else None)
+        if findings is not None and subset_codes is not None:
+            findings = [f for f in findings if f.code in subset_codes]
+        if findings is None:
+            ctx = e.ctx or FileContext.build(e.path, e.text,
+                                             display=e.display)
+            ctx.project = project
+            findings = _check_ctx(ctx, rule_objs, result.rule_stats)
+            if write_cache:
+                cache.put_findings(e.display, e.digest, dd, findings)
         for f in findings:
             (result.baselined if baseline.matches(f)
              else result.findings).append(f)
-    if use_cache and not overrides:
+    if write_cache:
         cache.save()
     result.cache_hits = cache.hits
-    # stale = the entry's file was scanned and produced no matching
-    # finding, OR the file is gone entirely (deleted/renamed); a file
-    # merely outside this run's roots is not evidence either way
+    # stale = the entry's file was checked for findings and produced no
+    # match, OR the file is gone entirely (deleted/renamed); a file
+    # merely outside this run's report set is not evidence either way
     result.stale_baseline = [
         e for e in baseline.stale_entries()
-        if e["file"] in scanned or not (root / e["file"]).exists()]
+        if e["file"] in reported or not (root / e["file"]).exists()]
     result.duration_s = time.perf_counter() - t0
     return result
 

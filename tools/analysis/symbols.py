@@ -112,10 +112,20 @@ class ScopeInfo:
             if not isinstance(node, ast.Assign) or len(node.targets) != 1:
                 continue
             t = node.targets[0]
+            v = node.value
+            if isinstance(t, (ast.Tuple, ast.List)) and isinstance(v, ast.Call):
+                # ``a, b = producer(...)``: every unpacked name shares the
+                # producing call's origin (the taint rules need this for
+                # multi-output kernels like ``rewards, penalties = _jit(...)``)
+                dotted = table.resolve(v.func)
+                if dotted:
+                    for elt in t.elts:
+                        if isinstance(elt, ast.Name):
+                            self.origins[elt.id] = dotted
+                continue
             if not isinstance(t, ast.Name):
                 continue
             self.assigned.add(t.id)
-            v = node.value
             if isinstance(v, ast.Name):
                 self.aliases[t.id] = v.id
             elif isinstance(v, ast.Call):
@@ -168,7 +178,12 @@ class SymbolTable:
                     if alias.name == "*":
                         continue
                     local = alias.asname or alias.name
-                    self.imports[local] = f"{mod}.{alias.name}" if mod else alias.name
+                    if not mod:
+                        self.imports[local] = alias.name
+                    elif mod.endswith("."):  # ``from . import x`` -> .x
+                        self.imports[local] = mod + alias.name
+                    else:
+                        self.imports[local] = f"{mod}.{alias.name}"
             elif isinstance(node, _FUNC_NODES):
                 self.functions.setdefault(node.name, []).append(node)
 

@@ -5,9 +5,11 @@ installs are barred).
 
 All checking lives in ``tools/analysis/``: a rule-plugin registry
 (hygiene codes E501/E999/W191/W291/W605/F401/B001/B006 plus the
-engine-invariant rules FC01/ST01/CC01/RB01/JX01/DT01), per-code
-``# noqa`` suppression, a reviewed baseline for grandfathered findings
-(tools/analysis/baseline.json), and a content-hash incremental cache.
+engine-invariant rules FC01/ST01/CC01/CC02/RB01/JX01/DT01 and the
+interprocedural device-boundary rules HD01/SH01/EF01 riding on the
+two-pass call-graph core), per-code ``# noqa`` suppression, a reviewed
+baseline for grandfathered findings (tools/analysis/baseline.json), and
+a dependency-aware content-hash incremental cache.
 This wrapper keeps the historical interface: ``python tools/lint.py
 [paths...]`` prints ``path:line: CODE message`` rows plus a summary line
 and exits 1 on unbaselined findings; ``--json OUT`` additionally writes
@@ -65,6 +67,14 @@ def main(argv):
                   f"{e['file']}: {e['code']} {e['snippet']!r}")
     print(f"lint: {result.n_files} files checked, "
           f"{len(result.findings)} findings{extra}")
+    if result.rule_stats:
+        slowest = sorted(result.rule_stats.items(),
+                         key=lambda kv: -kv[1]["time_s"])[:3]
+        analyzed = result.n_files - result.cache_hits
+        print(f"rules: {analyzed} files analyzed in "
+              f"{result.duration_s:.2f}s; slowest "
+              + ", ".join(f"{code} {s['time_s']:.2f}s/{s['findings']}f"
+                          for code, s in slowest))
     if json_out:
         _runner.write_report(result, json_out)
     return 1 if (result.findings or result.stale_baseline) else 0
