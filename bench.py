@@ -346,6 +346,11 @@ def bench_epoch_e2e_bls(results):
     t_oracle_scaled = _oracle_verify_time(128) * n_atts
     phases = {k: round(stf.stats[k], 3) for k in
               ("sig_verify_s", "attestation_apply_s", "slot_roots_s", "other_s")}
+    # sig_verify_s split into its attributable interior (ISSUE 7): a
+    # pairing regression names hashing, the MSM folds, the Miller product,
+    # or marshalling instead of moving one opaque number
+    phases.update({k: round(stf_verify.stats[k], 3) for k in
+                   ("hash_to_g2_s", "msm_s", "miller_s", "marshal_s")})
 
     results["epoch_e2e_bls"] = {
         "metric": f"mainnet_epoch_e2e_bls_on_{N_VALIDATORS}",
@@ -468,6 +473,9 @@ def bench_epoch_e2e_bls_altair(results):
     phases = {k: round(stf.stats[k], 3) for k in
               ("sig_verify_s", "attestation_apply_s", "sync_apply_s",
                "slot_roots_s", "other_s")}
+    # same sig_verify_s sub-phase attribution as the phase0 row
+    phases.update({k: round(stf_verify.stats[k], 3) for k in
+                   ("hash_to_g2_s", "msm_s", "miller_s", "marshal_s")})
 
     results["epoch_e2e_bls_altair"] = {
         "metric": f"altair_mainnet_epoch_e2e_bls_on_{N_VALIDATORS}",
@@ -1103,6 +1111,59 @@ def _ensure_live_jax():
     os.execve(_sys.executable, [_sys.executable] + _sys.argv, env)
 
 
+# ---------------------------------------------------------------------------
+# Perf-trend gate (ROADMAP item 5): the headline must not silently erode
+# ---------------------------------------------------------------------------
+
+
+def newest_bench_snapshot(repo: str):
+    """The parsed headline row of the newest previous driver snapshot
+    (``BENCH_r0N.json``, highest N whose ``parsed`` row is usable), or
+    None when no comparable snapshot exists."""
+    import glob
+    import re
+
+    best_n, best = -1, None
+    for path in glob.glob(os.path.join(repo, "BENCH_r[0-9]*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        n = int(m.group(1))
+        if n <= best_n:
+            continue
+        try:
+            with open(path) as f:
+                row = json.load(f).get("parsed")
+        except (OSError, ValueError):
+            continue
+        if isinstance(row, dict) and "metric" in row and "value" in row:
+            best_n, best = n, row
+    return best
+
+
+def check_perf_trend(current: dict, previous, threshold: float = 0.15):
+    """Regression message when ``current`` (this run's headline row) is
+    more than ``threshold`` slower than ``previous`` (the newest prior
+    snapshot's parsed row); None when within budget or not comparable
+    (different metric — e.g. a BENCH_VALIDATORS override — or a missing /
+    unparseable snapshot).  Headline rows are seconds, so slower ==
+    larger."""
+    if not previous or not isinstance(current, dict):
+        return None
+    if current.get("metric") != previous.get("metric"):
+        return None
+    try:
+        cur, prev = float(current["value"]), float(previous["value"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if prev <= 0 or cur <= prev * (1.0 + threshold):
+        return None
+    return (f"perf-trend regression: {current['metric']} "
+            f"{cur:.3f}s vs {prev:.3f}s in the newest previous snapshot "
+            f"(+{(cur / prev - 1.0) * 100.0:.1f}% > "
+            f"{threshold * 100.0:.0f}% budget)")
+
+
 def main():
     device_fallback = _ensure_live_jax()
     if os.environ.get("CSTPU_FAULTS"):
@@ -1229,6 +1290,20 @@ def main():
     ns = results.get("epoch_e2e_bls", {})
     if "value" not in ns:
         ns = results["north_star_epoch"]
+
+    # perf-trend gate (ROADMAP item 5): diff the headline against the
+    # newest previous BENCH_r0N.json driver snapshot and refuse a >15%
+    # regression — a PR's wins can't silently erode run over run.
+    # BENCH_SKIP_TREND=1 opts out (e.g. deliberately benchmarking a
+    # degraded configuration).
+    if os.environ.get("BENCH_SKIP_TREND") != "1":
+        regression = check_perf_trend(ns, newest_bench_snapshot(repo))
+        if regression:
+            print(regression, file=sys.stderr)
+            print("refusing to print the headline row; set "
+                  "BENCH_SKIP_TREND=1 to bypass", file=sys.stderr)
+            sys.exit(4)
+
     print(json.dumps({
         "metric": ns["metric"],
         "value": ns["value"],

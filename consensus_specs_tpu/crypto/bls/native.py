@@ -16,6 +16,7 @@ import hashlib
 import os
 import subprocess
 import tempfile
+import time
 from typing import Sequence
 
 _HERE = os.path.join(os.path.dirname(__file__), "native")
@@ -52,7 +53,7 @@ def _build() -> str:
         tmp_path = tmp.name
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        "-fno-exceptions", "-fno-rtti",
+        "-fno-exceptions", "-fno-rtti", "-pthread",
         src, "-o", tmp_path,
     ]
     try:
@@ -90,7 +91,13 @@ def _load() -> ctypes.CDLL:
     sig("bls_aggregate_verify", u8p, sz, u8p, ctypes.POINTER(sz), u8p)
     sig("bls_batch_fast_aggregate_verify_affine",
         sz, u8p, ctypes.POINTER(sz), u8p, ctypes.POINTER(sz), u8p, u8p)
+    sig("bls_batch_fast_aggregate_verify_affine_timed",
+        sz, u8p, ctypes.POINTER(sz), u8p, ctypes.POINTER(sz), u8p, u8p,
+        ctypes.POINTER(ctypes.c_double))
     sig("bls_g1_msm", u8p, u8p, sz, u8p)
+    sig("bls_g2_msm", u8p, u8p, sz, u8p)
+    sig("bls_h2c_cache_stats", ctypes.POINTER(ctypes.c_uint64))
+    sig("bls_h2c_cache_clear")
     sig("bls_g1_msm_precompute", u8p, sz, u8p)
     sig("bls_g1_msm_fixed", u8p, sz, u8p, u8p)
     sig("bls_g1_msm_fixed_windows")
@@ -225,21 +232,61 @@ def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes, signature: byt
     )
 
 
-def BatchFastAggregateVerify(items, seed: bytes = None) -> bool:
+def _batch_call_flat(counts, flat: bytes, msgs, sigs, seed, stats=None) -> bool:
+    """The ONE marshal + seed-handling path under both batch entry points:
+    packs the flat-affine buffers, draws the RLC seed (``os.urandom`` once
+    per batch unless a deterministic seed is supplied), and dispatches the
+    timed native call.  ``stats``, when given, is a mutable mapping whose
+    ``hash_to_g2_s``/``msm_s``/``miller_s``/``marshal_s`` keys accumulate
+    the native per-phase breakdown plus this function's own Python-side
+    marshalling time."""
+    k = len(counts)
+    if seed is None:
+        seed = os.urandom(32)
+    elif len(seed) != 32:
+        # the C DRBG unconditionally reads 32 bytes; fail fast rather than
+        # hand it a short buffer
+        raise ValueError(f"seed must be exactly 32 bytes, got {len(seed)}")
+    t0 = time.perf_counter()
+    args = (
+        k,
+        _buf(flat),
+        (ctypes.c_size_t * k)(*counts),
+        _buf(b"".join(msgs)),
+        (ctypes.c_size_t * k)(*[len(m) for m in msgs]),
+        _buf(b"".join(sigs)),
+        _buf(seed),
+    )
+    py_marshal = time.perf_counter() - t0
+    phases = (ctypes.c_double * 4)()
+    ok = bool(_lib.bls_batch_fast_aggregate_verify_affine_timed(
+        *args, phases))
+    if stats is not None:
+        stats["hash_to_g2_s"] += phases[0]
+        stats["msm_s"] += phases[1]
+        stats["miller_s"] += phases[2]
+        stats["marshal_s"] += phases[3] + py_marshal
+    return ok
+
+
+def BatchFastAggregateVerify(items, seed: bytes = None, stats=None) -> bool:
     """Batched FastAggregateVerify: ``items`` is a sequence of
     ``(pubkeys, message, signature)`` triples; True iff EVERY item verifies.
 
     One random-linear-combination pairing product with a single shared
-    final exponentiation (C side: bls_batch_fast_aggregate_verify_affine).
-    Soundness 2^-128 per batch over the RLC seed (os.urandom unless a
-    deterministic ``seed`` is supplied for test replay).  This is the
-    capability the reference's milagro slot exists for — BLS cheap enough
-    for the mainnet workload (reference seam: eth2spec/utils/bls.py:67-74).
+    final exponentiation (C side: bls_batch_fast_aggregate_verify_affine,
+    MSM-folded interior).  Soundness 2^-128 per batch over the RLC seed
+    (os.urandom unless a deterministic ``seed`` is supplied for test
+    replay).  This is the capability the reference's milagro slot exists
+    for — BLS cheap enough for the mainnet workload (reference seam:
+    eth2spec/utils/bls.py:67-74).  The compressed-key path only resolves
+    keys through the affine cache; marshal + seed handling are the same
+    ``_batch_call_flat`` the preflattened entry point uses.
     """
     triples = list(items)
     if not triples:
         return True
-    counts, affines, msgs, msg_lens, sigs = [], [], [], [], []
+    counts, affines, msgs, sigs = [], [], [], []
     for pubkeys, message, signature in triples:
         pks = [bytes(p) for p in pubkeys]
         sig = bytes(signature)
@@ -251,26 +298,10 @@ def BatchFastAggregateVerify(items, seed: bytes = None) -> bool:
                 return False  # invalid member pubkey: that item cannot verify
             affines.append(xy)
         counts.append(len(pks))
-        msg = bytes(message)
-        msgs.append(msg)
-        msg_lens.append(len(msg))
+        msgs.append(bytes(message))
         sigs.append(sig)
-    if seed is None:
-        seed = os.urandom(32)
-    elif len(seed) != 32:
-        # the C DRBG unconditionally reads 32 bytes; fail fast rather than
-        # hand it a short buffer
-        raise ValueError(f"seed must be exactly 32 bytes, got {len(seed)}")
-    k = len(triples)
-    return bool(_lib.bls_batch_fast_aggregate_verify_affine(
-        k,
-        _buf(b"".join(affines)),
-        (ctypes.c_size_t * k)(*counts),
-        _buf(b"".join(msgs)),
-        (ctypes.c_size_t * k)(*msg_lens),
-        _buf(b"".join(sigs)),
-        _buf(seed),
-    ))
+    return _batch_call_flat(counts, b"".join(affines), msgs, sigs, seed,
+                            stats=stats)
 
 
 def pubkey_affine(pubkey: bytes):
@@ -291,7 +322,7 @@ def clear_affine_cache() -> None:
 def BatchFastAggregateVerifyFlat(counts: Sequence[int], flat_affines: bytes,
                                  messages: Sequence[bytes],
                                  signatures: Sequence[bytes],
-                                 seed: bytes = None) -> bool:
+                                 seed: bytes = None, stats=None) -> bool:
     """Preflattened BatchFastAggregateVerify: the member pubkeys of every
     item arrive as one contiguous affine-coordinate buffer (96-byte x||y
     each, item i owning ``counts[i]`` consecutive entries) instead of
@@ -299,7 +330,8 @@ def BatchFastAggregateVerifyFlat(counts: Sequence[int], flat_affines: bytes,
     ``pubkey_affine`` (validated + subgroup-checked); the C side trusts
     them, exactly as it trusts the ``_affine_of`` cache in the compressed
     path.  Same RLC multi-pairing and soundness as
-    ``BatchFastAggregateVerify``."""
+    ``BatchFastAggregateVerify``; ``stats`` forwards to the shared
+    ``_batch_call_flat`` per-phase accumulator."""
     counts = [int(c) for c in counts]
     k = len(counts)
     if k == 0:
@@ -313,19 +345,7 @@ def BatchFastAggregateVerifyFlat(counts: Sequence[int], flat_affines: bytes,
     flat = bytes(flat_affines)
     if len(flat) != 96 * sum(counts):
         raise ValueError("affine buffer size inconsistent with counts")
-    if seed is None:
-        seed = os.urandom(32)
-    elif len(seed) != 32:
-        raise ValueError(f"seed must be exactly 32 bytes, got {len(seed)}")
-    return bool(_lib.bls_batch_fast_aggregate_verify_affine(
-        k,
-        _buf(flat),
-        (ctypes.c_size_t * k)(*counts),
-        _buf(b"".join(msgs)),
-        (ctypes.c_size_t * k)(*[len(m) for m in msgs]),
-        _buf(b"".join(sigs)),
-        _buf(seed),
-    ))
+    return _batch_call_flat(counts, flat, msgs, sigs, seed, stats=stats)
 
 
 def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signature: bytes) -> bool:
@@ -361,6 +381,42 @@ def G1MSM(points_xy: bytes, scalars_be: bytes) -> bytes:
     if not _lib.bls_g1_msm(_buf(points_xy), _buf(scalars_be), n, out):
         raise ValueError("malformed or off-curve MSM input point")
     return bytes(out)
+
+
+def G2MSM(points: bytes, scalars_be: bytes) -> bytes:
+    """Variable-base Pippenger multi-scalar multiplication over G2 — the
+    bucketed machinery behind the batch verifier's signature fold
+    (``sum_i [r_i]sig_i`` in one pass instead of k serial double-and-add
+    chains), exported for differential pinning.  ``points`` holds n
+    compressed G2 points (96 bytes each, fully validated including the
+    psi-based subgroup check; infinity entries contribute the identity),
+    ``scalars_be`` n 32-byte big-endian scalars already reduced mod r.
+    Returns the compressed 96-byte sum; raises ValueError on malformed or
+    off-subgroup points."""
+    if len(points) % 96 or len(scalars_be) % 32:
+        raise ValueError("points must be 96-byte compressed G2, scalars 32-byte BE")
+    n = len(points) // 96
+    if n != len(scalars_be) // 32:
+        raise ValueError(f"{n} points vs {len(scalars_be) // 32} scalars")
+    out = (ctypes.c_uint8 * 96)()
+    if not _lib.bls_g2_msm(_buf(points), _buf(scalars_be), n, out):
+        raise ValueError("malformed or off-subgroup G2 MSM input point")
+    return bytes(out)
+
+
+def h2c_cache_stats() -> dict:
+    """Hit/miss/size counters of the native bounded hash_to_g2 cache that
+    fronts the batch verifier's per-message hashing."""
+    out = (ctypes.c_uint64 * 3)()
+    _lib.bls_h2c_cache_stats(out)
+    return {"hits": int(out[0]), "misses": int(out[1]), "size": int(out[2])}
+
+
+def clear_h2c_cache() -> None:
+    """Drop the native hash_to_g2 cache (and its counters).  Measurement
+    control, like ``clear_affine_cache``: a bench leg that should pay its
+    own message hashing must not inherit a warm cache."""
+    _lib.bls_h2c_cache_clear()
 
 
 # window count of the C side's fixed-base layout, read from the library so

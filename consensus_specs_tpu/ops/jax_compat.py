@@ -1,0 +1,16 @@
+"""Version-compat shims for the jax API surface the package relies on.
+
+jax < 0.5 ships ``shard_map`` under ``jax.experimental.shard_map``; newer
+releases promote it to the jax root.  Every sharded module imports the
+resolved symbol from here, so the fallback lives in exactly one place.
+"""
+from __future__ import annotations
+
+import jax
+
+try:  # jax < 0.5 ships shard_map under the experimental namespace
+    from jax.experimental.shard_map import shard_map
+except ImportError:  # promoted to the jax root in newer releases
+    shard_map = jax.shard_map
+
+__all__ = ["shard_map"]

@@ -23,6 +23,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from consensus_specs_tpu.ops.jax_compat import shard_map
+
 from consensus_specs_tpu.crypto.bls.curve import Point, g1_infinity
 from consensus_specs_tpu.crypto.fr import R as FR_ORDER
 
@@ -210,7 +212,7 @@ def _sharded_msm_fn(mesh, axis: str):
     if fn is None:
         from jax.sharding import PartitionSpec as P
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             _msm_lanes,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(None, axis)),

@@ -23,10 +23,12 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from consensus_specs_tpu.ops.bls_jax import pairing
+from consensus_specs_tpu.ops.jax_compat import shard_map
 
 # compiled per (mesh, axis): jit keys on callable identity, so a fresh
 # wrapper per call would recompile the Miller-loop pipeline every time
 _SHARDED_CHECK_CACHE: dict = {}
+_SHARDED_PARTIALS_CACHE: dict = {}
 
 
 def make_sharded_pairs_check(mesh: Mesh, axis: str = "v"):
@@ -46,12 +48,16 @@ def make_sharded_pairs_check(mesh: Mesh, axis: str = "v"):
         return pairing.final_exp_is_one_traced(f)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(P(None, axis), P(None, axis),
                       P(None, axis), P(None, axis)),
             out_specs=P(axis),
+            # the Miller loop's fori_loop carries have no replication
+            # rule; every in/out spec is explicit so nothing rides on the
+            # checker
+            check_rep=False,
         )
     )
     _SHARDED_CHECK_CACHE[key] = fn
@@ -116,3 +122,95 @@ def sharded_batch_fast_aggregate_verify(
     for (b, _), v in zip(clean, verdicts[:n]):
         results[b] = bool(v)
     return results
+
+
+# ---------------------------------------------------------------------------
+# Pairing-lane chunks: ONE product, its lanes split over the mesh
+# ---------------------------------------------------------------------------
+# The batch verifier's MSM-folded interior reduces a whole block to a
+# SINGLE multi-pairing — one lane per unique message plus the folded
+# signature lane — so the multi-chip seam is no longer B independent
+# checks but the lanes of one product.  Mirror of the native kernel's
+# chunk-parallel miller_loop_product: each device runs the shared-squaring
+# Miller chain of its contiguous lane chunk, the partial Fp12 products
+# multiply in FIXED chunk-index order, and ONE final exponentiation
+# decides the whole product.  Squaring distributes over products, so the
+# chunked result is bit-identical to the one-chain product wherever the
+# chunk boundaries fall.
+
+
+def make_sharded_lane_partials(mesh: Mesh, axis: str = "v"):
+    """Compile the per-chunk partial Miller product, chunk axis sharded.
+
+    Returns fn(px, py, qx, qy) -> f [D, 6, 2, 16]: px, py are [C, D, 16]
+    and qx, qy [C, D, 2, 16] Montgomery limb tensors where chunk d owns C
+    lanes; D divisible by the mesh size.  f[d] is the conjugated Miller
+    value of chunk d's lane product (conjugation is the p^6 Frobenius, a
+    ring automorphism, so per-chunk conjugates compose under the merge
+    multiply)."""
+    key = (mesh, axis)
+    fn = _SHARDED_PARTIALS_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    fn = jax.jit(
+        shard_map(
+            pairing._miller_product,
+            mesh=mesh,
+            in_specs=(P(None, axis), P(None, axis),
+                      P(None, axis), P(None, axis)),
+            out_specs=P(axis),
+            # same fori_loop-carry caveat as make_sharded_pairs_check
+            check_rep=False,
+        )
+    )
+    _SHARDED_PARTIALS_CACHE[key] = fn
+    return fn
+
+
+def sharded_pairing_lanes_check(mesh: Mesh, pairs) -> bool:
+    """prod_i e(P_i, Q_i) == 1, the lanes of ONE pairing product split
+    into contiguous chunks over the mesh.
+
+    ``pairs`` is a sequence of (G1 Point, G2 Point) lanes — the shape the
+    folded batch verifier emits (unique-message lanes + the signature
+    lane).  Infinity lanes contribute the identity and are dropped on the
+    host.  Ragged lane counts are padded up to a chunks-times-lanes
+    rectangle with self-canceling lanes (m-1 copies of e(G, H) and one
+    e([-(m-1)]G, H): their product is exactly 1, so the verdict is
+    untouched no matter which chunks the pads land in)."""
+    from consensus_specs_tpu.crypto.bls.curve import (
+        g1_generator,
+        g2_generator,
+    )
+    from consensus_specs_tpu.ops.bls_jax import _g1_coords, _g2_coords, limbs
+
+    lanes = [(p, q) for p, q in pairs
+             if not (p.is_infinity() or q.is_infinity())]
+    if not lanes:
+        return True  # empty product
+    D = int(np.prod(mesh.devices.shape))
+    C = -(-len(lanes) // D)  # lanes per chunk
+    m = C * D - len(lanes)
+    if m == 1:
+        # a single non-trivial lane cannot be the identity; widen the
+        # chunks so the pad group has >= 2 lanes to cancel within
+        C += 1
+        m += D
+    if m:
+        G, H = g1_generator(), g2_generator()
+        lanes += [(G, H)] * (m - 1) + [(-G.mul(m - 1), H)]
+    px = np.zeros((C, D, limbs.N_LIMBS), dtype=np.int64)
+    py = np.zeros_like(px)
+    qx = np.zeros((C, D, 2, limbs.N_LIMBS), dtype=np.int64)
+    qy = np.zeros_like(qx)
+    for l, (p, q) in enumerate(lanes):
+        d, c = divmod(l, C)  # chunk d owns lanes [d*C, (d+1)*C)
+        px[c, d], py[c, d] = _g1_coords(p)
+        qx[c, d], qy[c, d] = _g2_coords(q)
+    partials = make_sharded_lane_partials(mesh)(px, py, qx, qy)
+    # fixed chunk-index merge order, then the single shared final exp
+    f = partials[0]
+    for d in range(1, D):
+        f = pairing._mul12(f, partials[d])
+    return bool(pairing.final_exp_is_one(f[None])[0])

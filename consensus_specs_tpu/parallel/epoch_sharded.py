@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from consensus_specs_tpu.ops.jax_compat import shard_map
 from consensus_specs_tpu.ops.sha256_jax import sha256_block64
 
 jax.config.update("jax_enable_x64", True)
@@ -67,7 +68,7 @@ def make_sharded_epoch_step(mesh: Mesh, axis: str = "v"):
         -> (new_balances, layer_digests)
     """
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
                   P(axis), P(axis), P()),

@@ -237,6 +237,7 @@ def reset_caches() -> None:
         from consensus_specs_tpu.crypto.bls import native
 
         native.clear_affine_cache()
+        native.clear_h2c_cache()  # same cold-start control for hashing
     except ImportError:
         pass
 

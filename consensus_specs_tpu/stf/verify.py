@@ -40,10 +40,13 @@ _VERIFIED_MEMO: dict = {}
 _VERIFIED_MEMO_MAX = 1 << 16
 
 # fault probes (tests/chaos/): the native multi-pairing call, the
-# bisection walk, and the memo commit are the settlement path's fragile
-# seams — each must fail into the engine's replay contract, never into a
-# poisoned memo
+# MSM-folded interior it dispatches (probed separately so a crashed MSM is
+# proven to ride the same degradation ladder as any other native death),
+# the bisection walk, and the memo commit are the settlement path's
+# fragile seams — each must fail into the engine's replay contract, never
+# into a poisoned memo
 _SITE_NATIVE_CALL = faults.site("stf.verify.native_call")
+_SITE_MSM = faults.site("stf.verify.msm")
 _SITE_BISECT = faults.site("stf.verify.bisect")
 _SITE_MEMO_COMMIT = faults.site("stf.verify.memo_commit")
 
@@ -62,6 +65,16 @@ stats = {
     "memo_evictions": 0,
     "native_degraded": 0,
     "memo_cap": _VERIFIED_MEMO_MAX,
+    # sig_verify_s split into attributable sub-phases: the native batch
+    # call reports its interior (message hashing, the dual MSM folds, the
+    # chunked Miller product + shared final exp) and the marshal covers
+    # both C-side deserialization and the Python buffer packing, so a
+    # pairing regression names its component instead of moving one opaque
+    # number (ISSUE 7 satellite)
+    "hash_to_g2_s": 0.0,
+    "msm_s": 0.0,
+    "miller_s": 0.0,
+    "marshal_s": 0.0,
 }
 
 
@@ -70,7 +83,7 @@ def reset_stats() -> None:
     not a counter — it survives the reset; so does the degraded flag,
     which is operational state, reset via ``reset_degraded``)."""
     for k in stats:
-        stats[k] = 0
+        stats[k] = 0.0 if isinstance(stats[k], float) else 0
     stats["memo_cap"] = _VERIFIED_MEMO_MAX
     stats["native_degraded"] = int(_NATIVE_DEGRADED)
 
@@ -141,8 +154,12 @@ def _verify_batch(entries: Sequence[SigEntry], seed: bytes = None) -> bool:
     counts, flats, msgs, sigs = zip(*entries)
     try:
         _SITE_NATIVE_CALL()
+        # the MSM-folded interior is probed as its own seam: a crash here
+        # is indistinguishable from the bucketed fold dying inside the
+        # native call, and must degrade through the same ladder
+        _SITE_MSM()
         return native.BatchFastAggregateVerifyFlat(
-            counts, b"".join(flats), msgs, sigs, seed=seed)
+            counts, b"".join(flats), msgs, sigs, seed=seed, stats=stats)
     except faults.InjectedFault:
         raise
     except Exception as exc:

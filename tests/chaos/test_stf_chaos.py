@@ -181,6 +181,7 @@ _PHASE0_CASES = [
     [F("stf.attestations.resolve", nth=1)],
     [F("stf.attestations.affine_rows", nth=2, kind="corrupt")],
     [F("stf.verify.native_call", nth=2)],
+    [F("stf.verify.msm", nth=2)],
     [F("stf.verify.memo_commit", nth=1)],
     # corrupted member coordinates force the batch down the bisection
     # walk, where the second fault lands mid-bisection
@@ -388,6 +389,30 @@ def test_native_crash_degrades_and_recovers():
     assert stf.stats["replayed_blocks"] == 2
     assert stf.stats["replay_reasons"] == {"FastPathViolation": 2}
     # recovery: reset, and the same walk is all-fast again
+    stf.reset_stats()
+    stf_verify.reset_degraded()
+    _engine_replay(spec, pre, subset, subroots)
+    assert stf.stats["fast_blocks"] == 3
+    assert stf.stats["replayed_blocks"] == 0
+
+
+def test_msm_crash_degrades_like_any_native_death():
+    """A crash at the MSM-folded interior (the probe guarding the
+    Pippenger signature fold inside the native batch call) rides the SAME
+    degradation ladder as a generic native death: the in-flight batch
+    settles through the pure-Python oracle, later blocks gate to the
+    literal replay, and an operator reset restores the fast path (ISSUE 7
+    satellite: a crashed MSM must not invent a new failure mode)."""
+    spec, pre, blocks, roots = _corpus("phase0")
+    subset, subroots = blocks[:3], roots[:3]
+    _fresh_engine_env()
+    plan = faults.FaultPlan([F("stf.verify.msm", nth=1, kind="crash")])
+    with pytest.warns(RuntimeWarning, match="degraded to pure-Python"):
+        _engine_replay(spec, pre, subset, subroots, plan)
+    assert stf_verify.native_degraded()
+    assert stf.stats["fast_blocks"] == 1
+    assert stf.stats["replayed_blocks"] == 2
+    assert stf.stats["replay_reasons"] == {"FastPathViolation": 2}
     stf.reset_stats()
     stf_verify.reset_degraded()
     _engine_replay(spec, pre, subset, subroots)

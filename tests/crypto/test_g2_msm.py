@@ -1,0 +1,209 @@
+"""Differential pins for ``bls_g2_msm`` — the variable-base Pippenger
+bucket machinery the batch verifier's signature fold runs on (ISSUE 7).
+
+The native MSM is pinned against the pure-Python per-point scalar-mul
+oracle (sum of ``Point.mul`` — the dumbest possible evaluation), across
+random inputs, infinity/identity lanes, and off-subgroup rejection; the
+same-message lane folding of the batch verifier is pinned to the
+UNFOLDED verdict, including a tampered-entry case where bisection must
+still name the same leftmost original entry the unfolded walk would.
+"""
+import hashlib
+import random
+
+import pytest
+
+native = pytest.importorskip(
+    "consensus_specs_tpu.crypto.bls.native",
+    reason="native BLS backend unavailable on this host")
+
+from consensus_specs_tpu.crypto import bls as bls_facade
+from consensus_specs_tpu.crypto.bls.curve import (
+    g2_generator,
+    g2_to_bytes,
+    signature_to_point,
+)
+from consensus_specs_tpu.crypto.bls.fields import R
+from consensus_specs_tpu.stf import verify as stf_verify
+
+G2_INF = bytes([0xC0]) + b"\x00" * 95
+
+
+def _oracle_msm(points: bytes, scalars: bytes) -> bytes:
+    """sum_i [s_i]Q_i the slow way: per-point double-and-add + point add."""
+    n = len(points) // 96
+    acc = None
+    for i in range(n):
+        q = signature_to_point(points[96 * i:96 * (i + 1)])
+        s = int.from_bytes(scalars[32 * i:32 * (i + 1)], "big")
+        term = q.mul(s)
+        acc = term if acc is None else acc + term
+    return g2_to_bytes(acc)
+
+
+def _rand_inputs(rng, n):
+    points = b"".join(
+        bytes(native.Sign(rng.randrange(1, R), b"g2msm")) for _ in range(n))
+    scalars = b"".join(
+        rng.randrange(R).to_bytes(32, "big") for _ in range(n))
+    return points, scalars
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 16])
+def test_g2_msm_matches_per_point_oracle(n):
+    rng = random.Random(1000 + n)
+    points, scalars = _rand_inputs(rng, n)
+    assert native.G2MSM(points, scalars) == _oracle_msm(points, scalars)
+
+
+@pytest.mark.slow
+def test_g2_msm_matches_per_point_oracle_deep():
+    """Deep enough that multiple Pippenger windows and bucket-collision
+    paths are exercised (several points per bucket)."""
+    rng = random.Random(77)
+    points, scalars = _rand_inputs(rng, 192)
+    assert native.G2MSM(points, scalars) == _oracle_msm(points, scalars)
+
+
+def test_g2_msm_identity_lanes():
+    rng = random.Random(5)
+    points, scalars = _rand_inputs(rng, 3)
+    # zero scalars contribute nothing
+    zeroed = scalars[:32] + b"\x00" * 32 + scalars[64:]
+    assert native.G2MSM(points, zeroed) == _oracle_msm(points, zeroed)
+    # infinity points contribute nothing
+    with_inf = points[:96] + G2_INF + points[192:]
+    assert native.G2MSM(with_inf, scalars) == _oracle_msm(with_inf, scalars)
+    # all-infinity and empty inputs sum to the identity
+    assert native.G2MSM(G2_INF * 2, scalars[:64]) == G2_INF
+    assert native.G2MSM(b"", b"") == G2_INF
+    # scalar == r folds to the identity (the oracle reduces mod r the
+    # group-order way: [r]Q == inf)
+    r_scalar = R.to_bytes(32, "big")
+    assert native.G2MSM(points[:96], r_scalar) == G2_INF
+
+
+def test_g2_msm_scalar_one_roundtrip():
+    sig = bytes(native.Sign(42, b"roundtrip"))
+    one = (1).to_bytes(32, "big")
+    assert native.G2MSM(sig, one) == sig
+    # [2]G2 via two lanes of the generator
+    g = g2_to_bytes(g2_generator())
+    assert native.G2MSM(g + g, one + one) == g2_to_bytes(
+        g2_generator().mul(2))
+
+
+def test_g2_msm_rejects_off_subgroup():
+    """On-curve points outside the r-order subgroup must raise, exactly
+    as load_signature rejects them everywhere else — a hole here would
+    let a rogue fold input through the bucketed path."""
+    from consensus_specs_tpu.crypto.bls.curve import Point
+    from consensus_specs_tpu.crypto.bls.fields import Fq2, P
+
+    rng = random.Random(99)
+    b2 = Fq2(4, 4)
+    while True:
+        x = Fq2(rng.randrange(P), rng.randrange(P))
+        y = (x.square() * x + b2).sqrt()
+        if y is None:
+            continue
+        pt = Point(x, y, Fq2.one(), b2)
+        if not pt.in_subgroup():
+            break
+    bad = g2_to_bytes(pt)
+    one = (1).to_bytes(32, "big")
+    with pytest.raises(ValueError, match="off-subgroup|malformed"):
+        native.G2MSM(bad, one)
+    # malformed shapes fail fast
+    with pytest.raises(ValueError):
+        native.G2MSM(b"\x00" * 95, one)
+    with pytest.raises(ValueError):
+        native.G2MSM(bytes(native.Sign(1, b"x")), one + one)
+
+
+# ---------------------------------------------------------------------------
+# folded-vs-unfolded batch verdict parity
+# ---------------------------------------------------------------------------
+
+
+def _item(sks, msg):
+    pks = [native.SkToPk(sk) for sk in sks]
+    sig = native.Aggregate([native.Sign(sk, msg) for sk in sks])
+    return pks, msg, sig
+
+
+def _flat(pks, msg, sig):
+    affines = b"".join(native.pubkey_affine(pk) for pk in pks)
+    return (len(pks), affines, bytes(msg), bytes(sig))
+
+
+def _shared_msg_batch(n_msgs=4, lanes_per_msg=3):
+    """The engine's real same-message shape: aggregates re-covering the
+    SAME AttestationData with different committees — byte-identical
+    messages across items, so the C side folds them into one Miller
+    lane."""
+    items = []
+    for m in range(n_msgs):
+        msg = hashlib.sha256(bytes([0xA0 + m])).digest()
+        for lane in range(lanes_per_msg):
+            base = 100 * m + 10 * lane + 1
+            items.append(_item(range(base, base + 3), msg))
+    return items
+
+
+def test_folded_batch_accepts_what_unfolded_accepts():
+    items = _shared_msg_batch()
+    # folded path (shared messages collapse to n_msgs Miller lanes)
+    assert native.BatchFastAggregateVerify(items, seed=b"\x11" * 32)
+    # unfolded oracle: every item alone (k == 1 batches fold nothing)
+    for it in items:
+        assert native.BatchFastAggregateVerify([it], seed=b"\x12" * 32)
+
+
+@pytest.mark.parametrize("poison", [0, 4, 11])
+def test_folded_batch_bisects_to_same_leftmost_entry(poison):
+    """Tampering one entry of a shared-message batch: the folded batch
+    must fail, and the bisection walk must name the SAME leftmost
+    original entry the per-item oracle identifies — folding may merge
+    lanes inside one native call, but a sub-batch call re-folds within
+    the subset it was handed, so descent stays exact (the BDLO12
+    batch-forgery-identification contract)."""
+    items = _shared_msg_batch()  # 12 items, 4 unique messages
+    pks, msg, _ = items[poison]
+    items[poison] = (pks, msg, native.Aggregate([native.Sign(999, msg)]))
+    assert not native.BatchFastAggregateVerify(items, seed=b"\x13" * 32)
+    # per-item oracle: which entries are actually bad?
+    oracle_bad = [i for i, it in enumerate(items)
+                  if not native.BatchFastAggregateVerify([it])]
+    assert oracle_bad == [poison]
+    entries = [(tuple(bytes(p) for p in pks_), bytes(m), bytes(s))
+               for pks_, m, s in items]
+    assert bls_facade._first_invalid(entries) == poison
+    flat = [_flat(*it) for it in items]
+    assert stf_verify.first_invalid(flat) == poison
+
+
+def test_folded_batch_two_tampered_same_message_names_leftmost():
+    """Both lanes of one folded message group tampered: bisection must
+    still land on the LEFTMOST original entry, not the group."""
+    items = _shared_msg_batch(n_msgs=2, lanes_per_msg=3)
+    msg = items[2][1]
+    assert items[2][1] == items[1][1]  # same message group
+    for i in (1, 2):
+        pks, m, _ = items[i]
+        items[i] = (pks, m, native.Aggregate([native.Sign(998 + i, m)]))
+    flat = [_flat(*it) for it in items]
+    assert stf_verify.first_invalid(flat) == 1
+
+
+@pytest.mark.slow
+def test_folded_batch_parity_deep():
+    """128-item batch with heavy message sharing, every verdict pinned
+    both ways (tier-1 budget: slow-marked)."""
+    items = _shared_msg_batch(n_msgs=8, lanes_per_msg=16)
+    assert native.BatchFastAggregateVerify(items, seed=b"\x21" * 32)
+    pks, msg, _ = items[100]
+    items[100] = (pks, msg, native.Aggregate([native.Sign(997, msg)]))
+    assert not native.BatchFastAggregateVerify(items, seed=b"\x22" * 32)
+    flat = [_flat(*it) for it in items]
+    assert stf_verify.first_invalid(flat) == 100

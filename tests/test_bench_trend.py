@@ -1,0 +1,63 @@
+"""Perf-trend gate of the bench driver (ISSUE 7 satellite / ROADMAP item
+5): the headline row diffs against the newest previous ``BENCH_r0N.json``
+driver snapshot and the run exits non-zero on a >15% regression, so a
+PR's wins can't silently erode."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def _snapshot(tmp_path, n, parsed):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": parsed}))
+
+
+_ROW = {"metric": "mainnet_epoch_e2e_bls_on_400000", "value": 10.0,
+        "unit": "s", "vs_baseline": 100.0}
+
+
+def test_newest_snapshot_picks_highest_usable(tmp_path):
+    _snapshot(tmp_path, 1, dict(_ROW, value=30.0))
+    _snapshot(tmp_path, 2, dict(_ROW, value=20.0))
+    # newest file is corrupt: the gate must fall back to the newest USABLE
+    (tmp_path / "BENCH_r03.json").write_text("{not json")
+    row = bench.newest_bench_snapshot(str(tmp_path))
+    assert row["value"] == 20.0
+
+
+def test_newest_snapshot_skips_unparsed_rows(tmp_path):
+    _snapshot(tmp_path, 1, dict(_ROW, value=30.0))
+    _snapshot(tmp_path, 2, None)  # failed run: no parsed headline
+    assert bench.newest_bench_snapshot(str(tmp_path))["value"] == 30.0
+
+
+def test_newest_snapshot_none_when_empty(tmp_path):
+    assert bench.newest_bench_snapshot(str(tmp_path)) is None
+
+
+def test_trend_within_budget_passes():
+    cur = dict(_ROW, value=11.4)  # +14% of 10.0: inside the 15% budget
+    assert bench.check_perf_trend(cur, _ROW) is None
+    assert bench.check_perf_trend(dict(_ROW, value=6.0), _ROW) is None
+
+
+def test_trend_regression_flagged():
+    cur = dict(_ROW, value=11.6)  # +16%
+    msg = bench.check_perf_trend(cur, _ROW)
+    assert msg is not None and "perf-trend regression" in msg
+    assert _ROW["metric"] in msg
+
+
+def test_trend_not_comparable_is_silent():
+    # different metric (e.g. a BENCH_VALIDATORS override), missing
+    # snapshot, or garbled values must not block the run
+    other = dict(_ROW, metric="mainnet_epoch_e2e_bls_on_1000")
+    assert bench.check_perf_trend(dict(_ROW, value=99.0), other) is None
+    assert bench.check_perf_trend(dict(_ROW, value=99.0), None) is None
+    assert bench.check_perf_trend(dict(_ROW, value="nan?"), _ROW) is None
+    assert bench.check_perf_trend(
+        dict(_ROW, value=99.0), dict(_ROW, value=0.0)) is None

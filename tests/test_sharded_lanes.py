@@ -78,6 +78,76 @@ def test_sharded_bls_rejects_malformed_and_empty():
     assert got[3] is False          # malformed signature
 
 
+def _lane_fixture(n_valid, first_sk=500):
+    """Lanes of ONE pairing product in the folded verifier's shape: per
+    valid (pk, msg, sig) triple an e(pk, H(msg)) lane and an e(-G1, sig)
+    lane — the full product is the identity iff every triple verifies."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite as cs
+    from consensus_specs_tpu.crypto.bls.curve import (
+        pubkey_to_point,
+        signature_to_point,
+    )
+    from consensus_specs_tpu.ops.bls_jax import _NEG_G1_GEN, _hash_to_g2_point
+
+    pairs = []
+    for i in range(n_valid):
+        sk = first_sk + i
+        msg = bytes([0x60 + i]) * 32
+        pairs.append((pubkey_to_point(cs.SkToPk(sk)), _hash_to_g2_point(msg)))
+        pairs.append((_NEG_G1_GEN, signature_to_point(cs.Sign(sk, msg))))
+    return pairs
+
+
+def test_sharded_pairing_lanes_match_oracle():
+    """ISSUE 7: the lane-chunk path — ONE pairing product split into
+    per-device chunks (partial Fp12 Miller products, fixed merge order,
+    one shared final exp) — agrees with the host pairing oracle on both
+    verdicts, including a lane count that needs padding."""
+    from consensus_specs_tpu.crypto.bls.curve import g1_generator
+    from consensus_specs_tpu.crypto.bls.pairing import pairings_are_identity
+    from consensus_specs_tpu.parallel.bls_sharded import (
+        sharded_pairing_lanes_check,
+    )
+
+    mesh = _mesh(4)
+    pairs = _lane_fixture(3)  # 6 lanes over 4 chunks: 2 self-canceling pads
+    assert pairings_are_identity(pairs) is True
+    assert sharded_pairing_lanes_check(mesh, pairs) is True
+    # tamper one lane: the whole product must fail, exactly as on host
+    bad = list(pairs)
+    bad[0] = (g1_generator(), bad[0][1])
+    assert pairings_are_identity(bad) is False
+    assert sharded_pairing_lanes_check(mesh, bad) is False
+
+
+def test_sharded_pairing_lanes_ragged_and_infinity():
+    """Padding edges of the lane-chunk path: the m == 1 bump (a single
+    pad lane cannot cancel, so the chunks widen), an identity product
+    that SURVIVES the pads, and infinity lanes dropped on the host."""
+    from consensus_specs_tpu.crypto.bls.curve import (
+        g1_generator,
+        g2_generator,
+        g2_infinity,
+    )
+    from consensus_specs_tpu.parallel.bls_sharded import (
+        sharded_pairing_lanes_check,
+    )
+
+    mesh = _mesh(2)
+    G, H = g1_generator(), g2_generator()
+    # 5 lanes on 2 devices: C=3 leaves m=1, which must bump to C=4 / m=3.
+    # 2 valid lanes + a 3-lane self-canceling group keep the product at 1.
+    pairs = _lane_fixture(1) + [(G, H), (G, H), (-G.mul(2), H)]
+    assert len(pairs) == 5
+    assert sharded_pairing_lanes_check(mesh, pairs) is True
+    # infinity lanes contribute the identity and are dropped host-side
+    assert sharded_pairing_lanes_check(
+        mesh, pairs + [(g1_generator(), g2_infinity())]) is True
+    # all-infinity: empty product, vacuously true
+    assert sharded_pairing_lanes_check(
+        mesh, [(g1_generator(), g2_infinity())]) is True
+
+
 def test_sharded_bls_pair_count_derived_not_hardcoded(monkeypatch):
     """ADVICE r5 #3: the per-item pair count K is derived from the
     marshalled pairs (K = len(padded[0])), with a clear assert on ragged
