@@ -87,6 +87,7 @@ def _load() -> ctypes.CDLL:
     sig("bls_aggregate_pks", u8p, sz, u8p)
     sig("bls_fast_aggregate_verify", u8p, sz, u8p, sz, u8p)
     sig("bls_decompress_pubkey", u8p, u8p)
+    sig("bls_decompress_pubkeys", u8p, sz, u8p, u8p)
     sig("bls_fast_aggregate_verify_affine", u8p, sz, u8p, sz, u8p)
     sig("bls_aggregate_verify", u8p, sz, u8p, ctypes.POINTER(sz), u8p)
     sig("bls_batch_fast_aggregate_verify_affine",
@@ -310,6 +311,40 @@ def pubkey_affine(pubkey: bytes):
     engine gathers these into per-registry coordinate matrices so batch
     entries skip the per-member dict walk)."""
     return _affine_of(bytes(pubkey))
+
+
+def pubkey_affine_batch(pubkeys):
+    """``pubkey_affine`` for a whole key set in ONE native call: the
+    sqrt + subgroup check of every uncached key fans across the native
+    thread pool instead of paying a ctypes round-trip each (the registry
+    affine-matrix cold build decompresses ~8k unique keys).  Returns
+    {pubkey: 96-byte affine or None}, and seeds the per-key cache."""
+    pubkeys = {bytes(pk) for pk in pubkeys}
+    out = {}
+    fresh = []
+    for pk in pubkeys:
+        cached = _AFFINE_PKS.get(pk)
+        if cached is not None:
+            out[pk] = cached
+        elif len(pk) != 48:
+            out[pk] = None
+        else:
+            fresh.append(pk)
+    if fresh:
+        flat = b"".join(fresh)
+        xys = (ctypes.c_uint8 * (96 * len(fresh)))()
+        ok = (ctypes.c_uint8 * len(fresh))()
+        _lib.bls_decompress_pubkeys(_buf(flat), len(fresh), xys, ok)
+        raw = bytes(xys)
+        for i, pk in enumerate(fresh):
+            if ok[i]:
+                xy = raw[96 * i: 96 * (i + 1)]
+                out[pk] = xy
+                if len(_AFFINE_PKS) < _AFFINE_PKS_MAX:
+                    _AFFINE_PKS[pk] = xy
+            else:
+                out[pk] = None
+    return out
 
 
 def clear_affine_cache() -> None:

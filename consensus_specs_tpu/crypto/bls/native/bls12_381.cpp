@@ -1730,6 +1730,31 @@ int bls_decompress_pubkey(const uint8_t pk[48], uint8_t out_xy[96]) {
     return 1;
 }
 
+// Batched validated decompression over the thread pool: n compressed
+// pubkeys -> n affine x||y rows + per-key ok flags (0 marks malformed/
+// out-of-subgroup/infinity; its row is left untouched).  One native call
+// instead of n ctypes round-trips — the registry affine-matrix cold
+// build is the consumer (each key's sqrt + subgroup check is independent
+// work, so the shared-counter parallel_for self-balances).  Always
+// returns 1; validity is per-key in out_ok.
+int bls_decompress_pubkeys(const uint8_t *pks, size_t n, uint8_t *out_xys,
+                           uint8_t *out_ok) {
+    bls_init();
+    parallel_for(n, [&](size_t i) {
+        G1 pt;
+        if (load_pubkey(pt, pks + 48 * i) || pt.is_inf()) {
+            out_ok[i] = 0;
+            return;
+        }
+        Fp x, y;
+        pt.to_affine(x, y);
+        fp_to_bytes48(out_xys + 96 * i, x);
+        fp_to_bytes48(out_xys + 96 * i + 48, y);
+        out_ok[i] = 1;
+    });
+    return 1;
+}
+
 // FastAggregateVerify over pre-decompressed affine pubkeys (from
 // bls_decompress_pubkey, cached by the caller): no square roots, no
 // subgroup checks — the decompression already established both.

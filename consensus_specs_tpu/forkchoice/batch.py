@@ -95,15 +95,33 @@ def ingest_attestations(
     # the revalidation the spec loop pays per attestation.  Validation of
     # the whole batch still precedes any vote application (the reduce /
     # commit phases below).
+    #
+    # The BLS-off residue is fully deferred: the loop only EXTENDS one
+    # flat Python bit list (C-speed) and records per-attestation geometry;
+    # bit->validator resolution then runs as ONE numpy pass over the whole
+    # batch through a unique-committee indirection — at 100k unaggregated
+    # attestations the per-attestation ``np.asarray`` + gather + append
+    # walk this replaces was the batched path's Python floor.
     with tracing.span("forkchoice/ingest/index"):
         tstates = {}     # (target epoch, target root) -> checkpoint state
-        committees = {}  # (target epoch, target root, slot, index) -> ndarray
-        data_memo = {}   # id(data backing node) -> (committee, epoch, root)
-        parts_v = []
-        att_counts = np.empty(len(attestations), dtype=np.int64)
-        att_epochs = np.empty(len(attestations), dtype=np.int64)
+        committees = {}  # (target epoch, target root, slot, index) -> (ndarray, base)
+        data_memo = {}   # id(data backing node) -> per-data tuple
+        comm_concat = []     # unique committee arrays, in first-sight order
+        comm_concat_len = 0
+        n_atts = len(attestations)
+        att_epochs = np.empty(n_atts, dtype=np.int64)
         block_roots = []
+        att_msgs = []
+        LatestMessage = spec.LatestMessage
         verify_sigs = bls.bls_active
+        if verify_sigs:
+            parts_v = []
+            att_counts = np.empty(n_atts, dtype=np.int64)
+        else:
+            flat_bits: list = []
+            att_bases = np.empty(n_atts, dtype=np.int64)
+            att_comm_lens = np.empty(n_atts, dtype=np.int64)
+            att_comm_bases = np.empty(n_atts, dtype=np.int64)
         for a, att in enumerate(attestations):
             d = att.data
             node = d.get_backing()
@@ -113,8 +131,8 @@ def ingest_attestations(
                 spec.store_target_checkpoint_state(store, d.target)
                 tkey = (int(d.target.epoch), bytes(d.target.root))
                 ckey = tkey + (int(d.slot), int(d.index))
-                comm = committees.get(ckey)
-                if comm is None:
+                centry = committees.get(ckey)
+                if centry is None:
                     target_state = tstates.get(tkey)
                     if target_state is None:
                         target_state = store.checkpoint_states[d.target]
@@ -122,38 +140,66 @@ def ingest_attestations(
                     comm = np.fromiter(
                         spec.get_beacon_committee(target_state, d.slot, d.index),
                         dtype=np.int64)
-                    committees[ckey] = comm
+                    centry = committees[ckey] = (comm, comm_concat_len)
+                    if not verify_sigs:
+                        # the concat/base bookkeeping feeds only the
+                        # BLS-off bit-resolution gather below
+                        comm_concat.append(comm)
+                        comm_concat_len += len(comm)
                 # the node rides in the memo value so its id can't be
-                # recycled while the memo is alive
-                memo = (comm, tkey, d.beacon_block_root, node)
+                # recycled while the memo is alive; the prebuilt
+                # LatestMessage (shared by every winner voting this data —
+                # the fold only ever stores it) keeps the stage loop off
+                # the SSZ view protocol entirely
+                memo = (centry[0], centry[1], tkey, d.beacon_block_root,
+                        LatestMessage(epoch=d.target.epoch,
+                                      root=d.beacon_block_root), node)
                 data_memo[id(node)] = memo
-            comm, tkey, beacon_root, _ = memo
+            comm, comm_base, tkey, beacon_root, msg, _ = memo
             block_roots.append(beacon_root)
+            att_msgs.append(msg)
             if verify_sigs:
                 target_state = tstates[tkey]
                 indexed = spec.get_indexed_attestation(target_state, att)
                 assert spec.is_valid_indexed_attestation(target_state, indexed)
                 idx = np.asarray(indexed.attesting_indices, dtype=np.int64)
+                parts_v.append(idx)
+                att_counts[a] = len(idx)
             else:
-                # the Bitlist's internal bool list, without a copy when the
-                # implementation exposes it (the 100k-attestation hot path)
                 bl = att.aggregation_bits
-                bits = np.asarray(getattr(bl, "_bits", None) or list(bl),
-                                  dtype=bool)
+                bits = getattr(bl, "_bits", None)
+                if bits is None:
+                    bits = list(bl)
                 if len(bits) < len(comm):
                     # the spec's bit indexing raises IndexError here
                     raise IndexError("aggregation bits shorter than committee")
-                idx = comm[bits[:len(comm)]]
-                # residue of is_valid_indexed_attestation with BLS off
-                assert len(idx) > 0
-            parts_v.append(idx)
-            att_counts[a] = len(idx)
+                att_bases[a] = len(flat_bits)
+                att_comm_lens[a] = len(comm)
+                att_comm_bases[a] = comm_base
+                flat_bits.extend(bits)
             att_epochs[a] = tkey[0]
 
     with tracing.span("forkchoice/ingest/reduce"):
-        v = np.concatenate(parts_v)
-        e = np.repeat(att_epochs, att_counts)
-        a = np.repeat(np.arange(len(attestations), dtype=np.int64), att_counts)
+        if verify_sigs:
+            v = np.concatenate(parts_v)
+            a = np.repeat(np.arange(n_atts, dtype=np.int64), att_counts)
+        else:
+            all_bits = np.asarray(flat_bits, dtype=bool)
+            pos = np.nonzero(all_bits)[0]
+            # position -> owning attestation (bases are sorted by build)
+            a = np.searchsorted(att_bases, pos, side="right") - 1
+            offset = pos - att_bases[a]
+            # bits beyond the committee are ignored (the spec reads
+            # bits[i] only for committee members)
+            keep = offset < att_comm_lens[a]
+            a, offset = a[keep], offset[keep]
+            v = np.concatenate(comm_concat)[att_comm_bases[a] + offset]
+            # residue of is_valid_indexed_attestation with BLS off: every
+            # attestation must select at least one member
+            att_counts = np.zeros(n_atts, dtype=np.int64)
+            np.add.at(att_counts, a, 1)
+            assert att_counts.all()
+        e = att_epochs[a]
         if store.equivocating_indices:
             eq = np.fromiter(store.equivocating_indices, dtype=np.int64)
             live = ~np.isin(v, eq)
@@ -179,12 +225,8 @@ def ingest_attestations(
         wv, we, wa = wv[upd], we[upd], wa[upd]
 
     with tracing.span("forkchoice/ingest/stage"):
-        LatestMessage = spec.LatestMessage
         ValidatorIndex = spec.ValidatorIndex
-        staged_messages = []
-        for vi, ai in zip(wv.tolist(), wa.tolist()):
-            d = attestations[ai].data
-            staged_messages.append((ValidatorIndex(vi), LatestMessage(
-                epoch=d.target.epoch, root=d.beacon_block_root)))
+        staged_messages = [(ValidatorIndex(vi), att_msgs[ai])
+                           for vi, ai in zip(wv.tolist(), wa.tolist())]
 
     return StagedVotes(wv, we, wa, block_roots, staged_messages)

@@ -35,12 +35,114 @@ def _inactivity_quotient(spec) -> int:
 
 
 def _participation_columns(spec, state):
-    from consensus_specs_tpu.ssz import bulk
+    """Both epoch participation columns as READONLY resident arrays
+    (stf/columns.py): after the block path's last mirror flush these are
+    dict probes, not tree walks — the epoch phases below read the same
+    physical arrays the engine registered, so a 4-phase transition stops
+    paying ~8 full-column unpacks."""
+    from consensus_specs_tpu.stf import columns
 
     return (
-        bulk.packed_uint8_to_numpy(state.previous_epoch_participation),
-        bulk.packed_uint8_to_numpy(state.current_epoch_participation),
+        columns.participation_column(state, current=False),
+        columns.participation_column(state, current=True),
     )
+
+
+def _device_columns_policy() -> bool:
+    """Whether the per-flag reward loop runs as the fused device program
+    over the resident participation column.  ``CSTPU_DEVICE_COLUMNS=1``
+    forces it on (``0`` off); the auto policy stays host-side — on the
+    CPU XLA backend the dispatch overhead loses to numpy, the same
+    measured-not-assumed call ``ops/merkle_resident.py`` makes for the
+    balance reduction.  Either path produces bit-identical deltas (exact
+    int64; differential: tests/test_device_columns.py)."""
+    import os
+
+    env = os.environ.get("CSTPU_DEVICE_COLUMNS")
+    if env is not None:
+        return env == "1"
+    return False
+
+
+def _flag_deltas_device(spec, state, cols, eligible, in_leak,
+                        active_increments, base_reward_per_increment):
+    """Fused device twin of the per-flag reward/penalty loop: ONE jit
+    dispatch consuming the previous-epoch participation column as a
+    device-resident buffer (``stf/columns.device_column`` — uploaded once
+    per column VERSION and shared across epoch phases, pjit-partitioned
+    over the mesh's validator axis on multi-device backends), instead of
+    three host passes over a re-staged copy.  All arithmetic is the same
+    exact int64 as the host loop (bounds in the module docstring)."""
+    import jax.numpy as jnp
+
+    from consensus_specs_tpu.stf import columns
+
+    prev_epoch = int(spec.get_previous_epoch(state))
+    flags_dev = columns.device_column(state, current=False)
+    rewards, penalties = _ensure_jit()(
+        flags_dev,
+        jnp.asarray(active_mask(cols, prev_epoch)),
+        jnp.asarray(cols["slashed"]),
+        jnp.asarray(np.asarray(cols["effective_balance"], dtype=np.int64)),
+        jnp.asarray(eligible),
+        jnp.asarray([int(w) for w in spec.PARTICIPATION_FLAG_WEIGHTS],
+                    dtype=jnp.int64),
+        jnp.asarray([
+            int(spec.EFFECTIVE_BALANCE_INCREMENT),
+            base_reward_per_increment,
+            active_increments,
+            int(spec.WEIGHT_DENOMINATOR),
+            int(in_leak),
+            int(spec.TIMELY_HEAD_FLAG_INDEX),
+        ], dtype=jnp.int64),
+    )
+    # host-sync: staged view — the one pull-back of the fused flag
+    # program's outputs; the balance fold below stays host-side
+    rewards = np.asarray(rewards)
+    penalties = np.asarray(penalties)
+    return [(rewards[i], penalties[i]) for i in range(rewards.shape[0])]
+
+
+def _flag_deltas_kernel(flags, active_prev, slashed, eff, eligible,
+                        weights, scalars):
+    import jax.numpy as jnp
+
+    ebi, brpi, active_increments, weight_den, in_leak, head_index = (
+        scalars[0], scalars[1], scalars[2], scalars[3], scalars[4],
+        scalars[5])
+    base_reward = (eff // ebi) * brpi
+    rewards_out, penalties_out = [], []
+    for flag_index in range(3):  # static unroll: one fused program
+        participating = (active_prev
+                         & (((flags >> flag_index) & 1) != 0)
+                         & ~slashed)
+        participating_increments = (
+            jnp.sum(jnp.where(participating, eff, 0)) // ebi)
+        weight = weights[flag_index]
+        reward_numerator = base_reward * weight * participating_increments
+        rewards_out.append(jnp.where(
+            eligible & participating & (in_leak == 0),
+            reward_numerator // (active_increments * weight_den),
+            0))
+        penalties_out.append(jnp.where(
+            eligible & ~participating & (flag_index != head_index),
+            base_reward * weight // weight_den,
+            0))
+    return jnp.stack(rewards_out), jnp.stack(penalties_out)
+
+
+_jit_flag_deltas = None  # jitted lazily: this module must import jax-free
+
+
+def _ensure_jit():
+    global _jit_flag_deltas
+    if _jit_flag_deltas is None:
+        import jax
+
+        from consensus_specs_tpu.ops import epoch_jax  # noqa: F401 - x64 config
+
+        _jit_flag_deltas = jax.jit(_flag_deltas_kernel)
+    return _jit_flag_deltas
 
 
 def _eligible_mask(spec, state, cols):
@@ -84,29 +186,36 @@ def rewards_and_penalties(spec, state) -> None:
     timely_head_index = int(spec.TIMELY_HEAD_FLAG_INDEX)
     timely_target_index = int(spec.TIMELY_TARGET_FLAG_INDEX)
 
-    deltas = []
-    for flag_index, weight in enumerate(weights):
-        participating = _unslashed_participating_mask(
-            spec, state, cols, prev_flags, flag_index)
-        participating_increments = (
-            int(np.sum(np.where(participating, eff, 0), dtype=np.uint64)) // ebi
-        )
-        rewards = np.zeros_like(eff)
-        penalties = np.zeros_like(eff)
-        if not in_leak:
-            reward_numerator = base_reward * weight * participating_increments
-            rewards = np.where(
-                eligible & participating,
-                reward_numerator // (active_increments * weight_denominator),
-                0,
+    if _device_columns_policy():
+        deltas = _flag_deltas_device(
+            spec, state, cols, eligible, in_leak, active_increments,
+            base_reward_per_increment)
+    else:
+        deltas = []
+        for flag_index, weight in enumerate(weights):
+            participating = _unslashed_participating_mask(
+                spec, state, cols, prev_flags, flag_index)
+            participating_increments = (
+                int(np.sum(np.where(participating, eff, 0),
+                           dtype=np.uint64)) // ebi
             )
-        if flag_index != timely_head_index:
-            penalties = np.where(
-                eligible & ~participating,
-                base_reward * weight // weight_denominator,
-                0,
-            )
-        deltas.append((rewards, penalties))
+            rewards = np.zeros_like(eff)
+            penalties = np.zeros_like(eff)
+            if not in_leak:
+                reward_numerator = (base_reward * weight
+                                    * participating_increments)
+                rewards = np.where(
+                    eligible & participating,
+                    reward_numerator // (active_increments * weight_denominator),
+                    0,
+                )
+            if flag_index != timely_head_index:
+                penalties = np.where(
+                    eligible & ~participating,
+                    base_reward * weight // weight_denominator,
+                    0,
+                )
+            deltas.append((rewards, penalties))
 
     # inactivity penalties (altair/beacon-chain.md get_inactivity_penalty_deltas)
     # raw uint64 view: scores can exceed int63, so guard on the unsigned max
@@ -202,12 +311,13 @@ def inactivity_updates(spec, state) -> None:
 def participation_flag_updates(spec, state) -> None:
     """altair+ process_participation_flag_updates: rotate current into
     previous and zero current — two bulk writes instead of an O(n) list
-    comprehension of fresh flag objects."""
-    from consensus_specs_tpu.ssz import bulk
+    comprehension of fresh flag objects, registered with the resident
+    store so the next epoch's readers keep hitting."""
+    from consensus_specs_tpu.stf import columns
 
     _, current = _participation_columns(spec, state)
-    bulk.set_packed_uint8_from_numpy(state.previous_epoch_participation, current)
-    bulk.set_packed_uint8_from_numpy(
-        state.current_epoch_participation,
-        np.zeros(len(current), dtype=np.uint8),
-    )
+    # the rotated array is the store's own readonly current column —
+    # registering it under the previous column's new root just shares it
+    columns.flush(state, current=False, col=current)
+    columns.flush(state, current=True,
+                  col=np.zeros(len(current), dtype=np.uint8))

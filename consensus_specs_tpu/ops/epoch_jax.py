@@ -77,20 +77,38 @@ class DeltaInputs(NamedTuple):
     effective_balance_increment: int
 
 
-def attesting_indices(spec, state, data, bits) -> np.ndarray:
+def attesting_indices(spec, state, data, bits, plan_ctx=None) -> np.ndarray:
     """``get_attesting_indices`` for a state-resident pending attestation
     as one numpy gather off the cached whole-epoch committee geometry
     (stf/attestations.committee_context) — the spec call materializes the
     committee as a Python list per attestation, which made the epoch's
     pending-attestation scans the block-path replay's second-largest cost.
-    ``data`` was validated at inclusion, so ``compute_epoch_at_slot(slot)``
-    indexes a real committee.  Element-set equality with the spec call is
-    pinned by tests/spec/phase0/test_epoch_kernel.py."""
+    With ``plan_ctx`` (a per-SCAN ``{epoch: plan ctx key}`` memo — pass a
+    fresh ``{}`` per scan) the attestation-plan memo is probed first
+    (ISSUE 8): the pendings ARE the aggregates the block path already
+    resolved, so the content-addressed hit replaces even the gather +
+    bits unpack (callers are set-semantics scatters, so the plan's sorted
+    order is equivalent).  ``data`` was validated at inclusion, so
+    ``compute_epoch_at_slot(slot)`` indexes a real committee.
+    Element-set equality with the spec call is pinned by
+    tests/spec/phase0/test_epoch_kernel.py."""
     from consensus_specs_tpu.ssz import bulk
-    from consensus_specs_tpu.stf.attestations import committee_context
+    from consensus_specs_tpu.stf.attestations import (
+        cached_plan_attesters,
+        committee_context,
+        plan_ctx_key,
+    )
 
     slot = int(data.slot)
-    ctx = committee_context(spec, state, slot // int(spec.SLOTS_PER_EPOCH))
+    epoch = slot // int(spec.SLOTS_PER_EPOCH)
+    if plan_ctx is not None:
+        pk = plan_ctx.get(epoch)
+        if pk is None:
+            pk = plan_ctx[epoch] = plan_ctx_key(spec, state, epoch)
+        planned = cached_plan_attesters(pk, data, bits)
+        if planned is not None:
+            return planned
+    ctx = committee_context(spec, state, epoch)
     committee = ctx.committee(slot, int(data.index))
     return committee[bulk.bitlist_to_numpy(bits)]
 
@@ -115,31 +133,54 @@ def extract_delta_inputs(spec, state) -> DeltaInputs:
         slashed & (prev_epoch + 1 < cols["withdrawable_epoch"])
     )
 
-    source_atts = list(spec.get_matching_source_attestations(state, prev_epoch))
-    target_atts = list(spec.get_matching_target_attestations(state, prev_epoch))
-    head_atts = list(spec.get_matching_head_attestations(state, prev_epoch))
-
-    def participation(atts):
-        mask = np.zeros(n, dtype=bool)
-        for a in atts:
-            mask[attesting_indices(spec, state, a.data, a.aggregation_bits)] = True
-        return mask & ~slashed
-
-    source_part = participation(source_atts)
-    target_part = participation(target_atts)
-    head_part = participation(head_atts)
-
-    # min-inclusion-delay attestation per source attester: first minimal
-    # element in list order (spec: Python min(), beacon-chain.md:1500-1505)
+    # ONE fused pass over the epoch's pending attestations replaces the
+    # spec's three get_matching_* scans + three participation scans + the
+    # inclusion-delay walk (seven list traversals, each rebuilding the
+    # same ``a.data`` views).  Semantics per scan are the spec's exactly:
+    # every attestation of the epoch matches source (the matching_source
+    # selector), target matches on ``get_block_root(state, epoch)``
+    # (computed at the first attestation — the spec's listcomp evaluates
+    # it per item, so first-use raises identically and an empty list
+    # never evaluates it), head refines target on the per-slot block root
+    # (memoized per slot), and min-inclusion-delay keeps the FIRST
+    # minimal element in list order (strict <, beacon-chain.md:1500-1505).
+    if prev_epoch == int(spec.get_current_epoch(state)):
+        epoch_atts = state.current_epoch_attestations
+    else:
+        epoch_atts = state.previous_epoch_attestations
+    plan_ctx: dict = {}   # per-epoch plan-key memo for attesting_indices
+    head_roots: dict = {}  # slot -> block root (typically two slots/epoch)
+    expected_target = None
+    source_part = np.zeros(n, dtype=bool)
+    target_part = np.zeros(n, dtype=bool)
+    head_part = np.zeros(n, dtype=bool)
     incl_delay = np.full(n, np.iinfo(np.int64).max, dtype=np.int64)
     incl_proposer = np.zeros(n, dtype=np.int64)
-    for a in source_atts:
-        idx = attesting_indices(spec, state, a.data, a.aggregation_bits)
+    for a in epoch_atts:
+        data = a.data
+        idx = attesting_indices(
+            spec, state, data, a.aggregation_bits, plan_ctx)
+        source_part[idx] = True
         d = int(a.inclusion_delay)
         upd = d < incl_delay[idx]
         upd_idx = idx[upd]
         incl_delay[upd_idx] = d
         incl_proposer[upd_idx] = int(a.proposer_index)
+        if expected_target is None:
+            expected_target = bytes(
+                spec.get_block_root(state, spec.Epoch(prev_epoch)))
+        if bytes(data.target.root) == expected_target:
+            target_part[idx] = True
+            slot = int(data.slot)
+            head_root = head_roots.get(slot)
+            if head_root is None:
+                head_root = head_roots[slot] = bytes(
+                    spec.get_block_root_at_slot(state, data.slot))
+            if bytes(data.beacon_block_root) == head_root:
+                head_part[idx] = True
+    source_part &= ~slashed
+    target_part &= ~slashed
+    head_part &= ~slashed
     incl_delay[incl_delay == np.iinfo(np.int64).max] = 1  # unused lanes
 
     total_balance = int(spec.get_total_active_balance(state))
@@ -304,8 +345,10 @@ def attestation_deltas_for_state(spec, state):
 
 def participation_mask(spec, state, attestations, n: int) -> np.ndarray:
     mask = np.zeros(n, dtype=bool)
+    plan_ctx: dict = {}  # per-scan plan-key memo
     for a in attestations:
-        mask[attesting_indices(spec, state, a.data, a.aggregation_bits)] = True
+        mask[attesting_indices(
+            spec, state, a.data, a.aggregation_bits, plan_ctx)] = True
     return mask
 
 

@@ -17,7 +17,16 @@ from typing import Dict
 
 import numpy as np
 
-from .node import BranchNode, Node, pack_chunks, subtree_fill_to_contents, uint_to_leaf
+from .node import (
+    BranchNode,
+    Node,
+    PackedLazySubtree,
+    ZERO_HASHES,
+    pack_chunks,
+    subtree_fill_to_contents,
+    uint_to_leaf,
+    zero_node,
+)
 from .types import _collect_leaf_roots
 
 
@@ -32,16 +41,43 @@ def _packed_to_numpy(view, elem_bytes: int, np_dtype: str) -> np.ndarray:
     return np.frombuffer(data, dtype=np_dtype)[:n]
 
 
-def _set_packed_from_numpy(view, arr: np.ndarray) -> None:
+def packed_lazy_contents(data: bytes, contents_depth: int) -> Node:
+    """Contents node for a freshly bulk-written packed subtree: the dense
+    power-of-two region is a ``PackedLazySubtree`` (eager level-loop root,
+    children materialized only on demand), the zero spine above carries
+    eagerly folded roots — a whole-column write costs one vectorized hash
+    pass instead of ~n/32 leaf nodes plus a wave re-merkleization."""
+    import hashlib
+
+    n_chunks = (len(data) + 31) // 32
+    if n_chunks == 0 or not any(data):
+        return zero_node(contents_depth)
+    dense_depth = (n_chunks - 1).bit_length()
+    if dense_depth == 0:
+        node: Node = pack_chunks(data)[0]
+    else:
+        node = PackedLazySubtree(data, dense_depth)
+    root = node._root
+    for d in range(dense_depth, contents_depth):
+        parent = BranchNode(node, zero_node(d))
+        root = parent._root = hashlib.sha256(root + ZERO_HASHES[d]).digest()
+        node = parent
+    return node
+
+
+def _set_packed_from_numpy(view, arr: np.ndarray, lazy: bool = False) -> None:
     cls = type(view)
     if cls.IS_LIST:
         if len(arr) > cls.LENGTH:
             raise ValueError(f"{len(arr)} exceeds list limit {cls.LENGTH}")
     elif len(arr) != cls.LENGTH:
         raise ValueError(f"vector needs exactly {cls.LENGTH} elements")
-    contents = subtree_fill_to_contents(
-        pack_chunks(arr.tobytes()), cls.contents_depth()
-    )
+    if lazy:
+        contents = packed_lazy_contents(arr.tobytes(), cls.contents_depth())
+    else:
+        contents = subtree_fill_to_contents(
+            pack_chunks(arr.tobytes()), cls.contents_depth()
+        )
     backing = (
         BranchNode(contents, uint_to_leaf(len(arr))) if cls.IS_LIST else contents
     )
@@ -71,7 +107,15 @@ def packed_uint8_to_numpy(view) -> np.ndarray:
 
 
 def set_packed_uint8_from_numpy(view, arr: np.ndarray) -> None:
-    _set_packed_from_numpy(view, np.ascontiguousarray(arr, dtype=np.uint8))
+    """uint8 columns take the lazy-subtree write: participation flags are
+    rewritten once per block and their subtree ROOT is always consumed by
+    the next state-root check, while their chunk nodes are read back only
+    on a resident-store miss — the eager-root/lazy-children split is
+    exactly that access pattern.  (uint64 balance writes stay node-built:
+    epoch processing rewrites them several times between root reads, so
+    an eager root per write would hash MORE, not less.)"""
+    _set_packed_from_numpy(
+        view, np.ascontiguousarray(arr, dtype=np.uint8), lazy=True)
 
 
 def bitlist_to_numpy(bits) -> np.ndarray:

@@ -212,6 +212,88 @@ def with_updated_subtrees(
     return BranchNode(new_left, new_right)
 
 
+class PackedLazySubtree(BranchNode):
+    """A packed subtree held as raw bytes with an EAGER root and LAZY
+    children — the tree half of the resident-column contract
+    (stf/columns.py): per-block bulk writes of a whole packed column
+    (participation flags are the canonical case) need the subtree's ROOT
+    at the next state-root check, but its ~n/32 chunk nodes only if some
+    later consumer actually descends — and the resident column store
+    answers almost every read before the tree is touched.  The root comes
+    from one vectorized hashlib level-loop over the raw bytes (~2x the
+    node-layer wave hash, with zero node churn); ``left``/``right``
+    materialize on first access (a per-element read, an SSZ encode, a
+    path-copy write landing inside the subtree) and recursively stay
+    lazy, so a single-leaf descent builds one path, not the whole tree.
+
+    Instances are immutable like every node: ``_data`` is private bytes,
+    children memoize, and the eager ``_root`` makes ``merkle_root`` a
+    field read."""
+
+    __slots__ = ("_data", "_depth", "_l", "_r")
+
+    def __init__(self, data: bytes, depth: int, root: bytes = None):
+        self._data = data
+        self._depth = depth
+        self._l = self._r = None
+        self._root = root if root is not None else packed_subtree_root(
+            data, depth)
+
+    @property
+    def left(self) -> Node:
+        if self._l is None:
+            self._l = self._child(0)
+        return self._l
+
+    @property
+    def right(self) -> Node:
+        if self._r is None:
+            self._r = self._child(1)
+        return self._r
+
+    def _child(self, side: int) -> Node:
+        d = self._depth - 1
+        half = 32 << d  # bytes per half subtree
+        data = self._data[side * half: (side + 1) * half]
+        if not any(data):
+            return zero_node(d)
+        if d == 0:
+            return LeafNode(data.ljust(32, b"\x00"))
+        return PackedLazySubtree(data, d)
+
+    def leaf_roots(self, count: int) -> List[bytes]:
+        """First ``count`` chunk roots straight off the raw bytes — the
+        bulk-unpack shortcut (ssz/types._collect_leaf_roots)."""
+        data = self._data
+        need = count * 32
+        if len(data) < need:
+            data = data.ljust(need, b"\x00")
+        return [data[i: i + 32] for i in range(0, need, 32)]
+
+
+def packed_subtree_root(data: bytes, depth: int) -> bytes:
+    """Root of a depth-``depth`` subtree whose leading chunks are ``data``
+    (zero chunks beyond): one hashlib level-loop over contiguous buffers,
+    folding the all-zero tail with the shared zero hashes instead of
+    hashing it."""
+    from hashlib import sha256
+
+    n_chunks = (len(data) + 31) // 32
+    assert n_chunks <= (1 << depth)
+    if n_chunks == 0 or not any(data):
+        return ZERO_HASHES[depth]
+    if len(data) % 32:
+        data = data + b"\x00" * (32 - len(data) % 32)
+    level = data
+    for d in range(depth):
+        if (len(level) // 32) & 1:
+            level += ZERO_HASHES[d]
+        level = b"".join(
+            sha256(level[i: i + 64]).digest()
+            for i in range(0, len(level), 64))
+    return level
+
+
 def subtree_fill_to_contents(nodes: Sequence[Node], depth: int) -> Node:
     """Build a depth-`depth` subtree whose first len(nodes) leaves are `nodes`,
     zero-padded on the right (shared zero subtrees)."""

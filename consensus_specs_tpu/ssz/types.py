@@ -943,7 +943,9 @@ class Container(View):
     @classmethod
     def coerce_for_store(cls, value, parent=None, pkey=None):
         if isinstance(value, Container):
-            if value._layout_key() == cls._layout_key():
+            # same class -> same layout a priori: the deep layout-key
+            # tuple compare is only for cross-fork-namespace stores
+            if type(value) is cls or value._layout_key() == cls._layout_key():
                 return cls.view_from_backing(value.get_backing(), parent, pkey)
             # fork-extension reinterpretation (e.g. a bellatrix
             # ExecutionPayloadHeader stored into capella's, fork.md
@@ -1690,6 +1692,8 @@ def _decode_variable_list(data: bytes, elem_type) -> list:
 
 def _collect_leaf_roots(node: Node, depth: int, count: int) -> list:
     """First `count` leaf chunk roots of a subtree, left to right (iterative)."""
+    from .node import PackedLazySubtree
+
     out: list = []
     if count == 0:
         return out
@@ -1698,6 +1702,10 @@ def _collect_leaf_roots(node: Node, depth: int, count: int) -> list:
         n, d = stack.pop()
         if d == 0:
             out.append(n._root if n._root is not None else merkle_root(n))
+            continue
+        if isinstance(n, PackedLazySubtree) and d == n._depth:
+            # raw-bytes shortcut: the chunks ARE the stored buffer
+            out.extend(n.leaf_roots(min(count - len(out), 1 << d)))
             continue
         assert isinstance(n, BranchNode)
         stack.append((n.right, d - 1))

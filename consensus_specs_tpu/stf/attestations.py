@@ -25,7 +25,7 @@ exception (stf/engine.py).
 """
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, NamedTuple
 
 import numpy as np
 
@@ -45,9 +45,13 @@ class FastPathViolation(Exception):
 
 # fault probes (tests/chaos/): whole-block resolution and the affine
 # gather feed the signature batch — both must fail into the replay
-# contract without poisoning a memo
+# contract without poisoning a memo; the plan memo is probed on the
+# value it is about to insert, so a corrupted plan is both consumed by
+# the faulted block (bad members -> failed batch or root mismatch) and
+# popped again by the cache transaction when that block rolls back
 _SITE_RESOLVE = faults.site("stf.attestations.resolve")
 _SITE_AFFINE_ROWS = faults.site("stf.attestations.affine_rows")
+_SITE_PLAN_MEMO = faults.site("stf.attestations.plan_memo")
 
 
 # -- per-epoch committee geometry --------------------------------------------
@@ -116,18 +120,25 @@ def _spec_geometry_key(spec) -> tuple:
             int(spec.TARGET_COMMITTEE_SIZE), int(spec.SHUFFLE_ROUND_COUNT))
 
 
+def _ctx_lookup_key(spec, state, epoch: int) -> tuple:
+    """The memoized-root lookup key of one epoch's committee geometry —
+    also the context half of every attestation-plan key (below): two
+    states sharing it resolve every committee identically."""
+    return (
+        bytes(state.validators.hash_tree_root()),
+        bytes(state.randao_mixes.hash_tree_root()),
+        int(epoch),
+        _spec_geometry_key(spec),
+    )
+
+
 def committee_context(spec, state, epoch: int) -> _CommitteeContext:
     """Cached committee geometry.  The context itself is keyed on registry
     root + attester seed (the full input set of the spec's committee
     computation); a lookup layer keyed on the memoized registry/randao
     roots makes the per-attestation hit path a dict probe instead of a
     ``get_seed`` hash chain."""
-    lookup_key = (
-        bytes(state.validators.hash_tree_root()),
-        bytes(state.randao_mixes.hash_tree_root()),
-        int(epoch),
-        _spec_geometry_key(spec),
-    )
+    lookup_key = _ctx_lookup_key(spec, state, epoch)
     ctx = _CTX_LOOKUP.get(lookup_key)
     if ctx is not None:
         return ctx
@@ -190,13 +201,14 @@ _ZERO_ROW = b"\x00" * 96
 
 def _new_affine_matrix(validators):
     """Eager whole-registry affine matrix: decompress each UNIQUE pubkey
-    once (native cache), then one C-speed join over the column.  Rows whose
-    pubkey cannot decompress are zero-marked, not fatal — the spec only
-    fails when such a validator actually attests."""
+    once through the batched native entry (one thread-pooled call, not a
+    ctypes round-trip per key), then one C-speed join over the column.
+    Rows whose pubkey cannot decompress are zero-marked, not fatal — the
+    spec only fails when such a validator actually attests."""
     from consensus_specs_tpu.crypto.bls import native
 
     column = bulk.cached_validator_pubkeys(validators)
-    affine_of = {pk: native.pubkey_affine(pk) for pk in set(column)}
+    affine_of = native.pubkey_affine_batch(set(column))
     invalid_pks = {pk for pk, xy in affine_of.items() if xy is None}
     for pk in invalid_pks:
         affine_of[pk] = _ZERO_ROW
@@ -222,17 +234,20 @@ def affine_matrix(validators) -> dict:
 
 def reset_caches() -> None:
     """Drop every derived-geometry cache (committee contexts, active sets,
-    proposer walks, affine matrices, sync-committee seat rows) plus the
-    native decompression cache — bench cold-start control and test
-    isolation."""
-    from . import sync
+    proposer walks, attestation plans, affine matrices, sync-committee
+    seat rows, resident columns) plus the native decompression cache —
+    bench cold-start control and test isolation."""
+    from . import columns, sync
 
     _ACTIVE_CACHE.clear()
     _CTX_CACHE.clear()
     _CTX_LOOKUP.clear()
     _PROPOSER_CACHE.clear()
+    _PLAN_CACHE.clear()
+    _PLAN_CTX_LOOKUP.clear()
     _AFFINE_MATRIX_CACHE._store.clear()
     sync.reset_caches()
+    columns.reset_caches()
     try:
         from consensus_specs_tpu.crypto.bls import native
 
@@ -255,7 +270,74 @@ def affine_rows(validators, indices: np.ndarray) -> bytes:
     return _SITE_AFFINE_ROWS(entry["mat"][indices].tobytes())
 
 
-# -- whole-block resolution ---------------------------------------------------
+# -- whole-block resolution: the epoch-scoped attestation plan ---------------
+
+# plan memo: (plan ctx key, attestation-data root, aggregation-bits
+# root) -> AttestationPlan.  The corpus a live node sees re-carries
+# aggregates heavily (every attestation rides in the next two blocks;
+# gossip re-delivery does the same), so most of a block's resolutions are
+# repeats of work an earlier block already did — committee gather, bits
+# unpack, attester sort.  Both root halves are memoized SSZ roots, so the
+# key is content-addressed: distinct decoded copies of the same aggregate
+# hit the same plan.  The ctx half is the committee computation's TRUE
+# input set — (registry root, epoch, attester seed, geometry) — NOT the
+# full randao_mixes root: the current epoch's mix changes every block
+# (process_randao), while the seed reads a mix pinned epochs ago, so
+# seed-keying is what makes plans live across the blocks that re-carry
+# them (and across the epoch boundary's pending-attestation scans).
+# Capacity covers two full mainnet epochs of unique aggregates
+# (2 * 32 slots * 64 committees) with headroom.
+_PLAN_CACHE: dict = {}
+_PLAN_CACHE_MAX = 8192
+
+# (ctx lookup key) -> (plan ctx key): maps the cheap memoized-root lookup
+# identity onto the seed identity so repeat callers (the epoch kernel's
+# per-pending scans) pay a dict probe, not a get_seed hash chain
+_PLAN_CTX_LOOKUP: dict = {}
+
+
+def plan_ctx_key(spec, state, epoch: int) -> tuple:
+    """The plan key's context half for one (state, epoch): registry root +
+    epoch + attester seed + geometry constants (CC02-covered through
+    ``_ctx_lookup_key``'s transparency)."""
+    lk = _ctx_lookup_key(spec, state, epoch)
+    pk = _PLAN_CTX_LOOKUP.get(lk)
+    if pk is None:
+        seed = bytes(spec.get_seed(
+            state, spec.Epoch(epoch), spec.DOMAIN_BEACON_ATTESTER))
+        pk = (lk[0], int(epoch), seed, lk[3])
+        _fifo_put(_PLAN_CTX_LOOKUP, lk, pk)
+    return pk
+
+
+def cached_plan_attesters(plan_ctx: tuple, data, bits):
+    """The owner-side read seam for state-resident pending attestations
+    (``ops/epoch_jax.attesting_indices``): the epoch transition's
+    per-pending scans resolve the very aggregates the block path already
+    planned, so a probe on the content-addressed key replaces the
+    committee gather + bits unpack.  ``plan_ctx`` is ``plan_ctx_key``
+    computed ONCE per scan — recomputing it per pending would re-pay the
+    two state-field view constructions 14k times per epoch.  Returns the
+    SORTED attester array on a hit (callers are set-semantics scatters),
+    or None."""
+    plan = _PLAN_CACHE.get((plan_ctx,
+                            bytes(data.hash_tree_root()),
+                            bytes(bits.hash_tree_root())))
+    return plan.attesters if plan is not None else None
+
+
+class AttestationPlan(NamedTuple):
+    """One aggregate's resolved application plan: everything about the
+    attestation that is pure in (committee geometry, data, bits) — the
+    per-block work left is the state-slot window checks, the justified-
+    checkpoint compare, and the state writes themselves."""
+
+    attesters: np.ndarray  # sorted attesting validator indices (readonly)
+    data_root: bytes       # hash_tree_root(att.data) — signing-root input
+    target_epoch: int      # int(data.target.epoch) — the apply loops'
+    #                        current/previous discriminator, off the plan
+    #                        instead of a per-attestation SSZ field chain
+
 
 def resolve_block_attestations(spec, state) -> "_BlockResolver":
     return _BlockResolver(spec, state)
@@ -273,26 +355,68 @@ class _BlockResolver:
         self.state_slot = int(state.slot)
         self.min_delay = int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
         self.slots_per_epoch = int(spec.SLOTS_PER_EPOCH)
+        # the two plan ctx keys a block can touch, computed once per
+        # block instead of per attestation (memoized-root reads + tuple
+        # build were the hit path's dominant cost)
+        self._ctx_keys: dict = {}
 
-    def resolve(self, attestations) -> List[Tuple[np.ndarray, np.ndarray]]:
-        """[(committee, bits)] per attestation, after the spec's structural
-        asserts (process_attestation, beacon-chain.md:1686-1714) — target
-        epoch window, slot inclusion window, committee index range, and
-        bit-count/committee-size match — evaluated in spec order."""
+    def _ctx_key(self, target_epoch: int) -> tuple:
+        key = self._ctx_keys.get(target_epoch)
+        if key is None:
+            key = self._ctx_keys[target_epoch] = plan_ctx_key(
+                self.spec, self.state, target_epoch)
+        return key
+
+    def resolve(self, attestations) -> List[AttestationPlan]:
+        """One ``AttestationPlan`` per attestation, after the spec's
+        structural asserts (process_attestation, beacon-chain.md:1686-1714)
+        — target epoch window, slot inclusion window, committee index
+        range, and bit-count/committee-size match.  State-dependent checks
+        (epoch window, inclusion window) re-run per block; data-pure checks
+        and the committee gather + bits unpack + attester sort are served
+        from the plan memo (a hit proves they passed when the plan was
+        built — any fast-path ordering difference is unobservable because
+        EVERY violation routes to the same literal replay, which raises
+        the spec's own exception at the spec's own point)."""
         spec, state = self.spec, self.state
-        out = []
-        for att in attestations:
+        plans: List = [None] * len(attestations)
+        cold = []
+        for i, att in enumerate(attestations):
             _SITE_RESOLVE()
             data = att.data
             target_epoch = int(data.target.epoch)
             slot = int(data.slot)
             if target_epoch not in (self.previous_epoch, self.current_epoch):
                 raise FastPathViolation("target epoch outside window")
-            if target_epoch != slot // self.slots_per_epoch:
-                raise FastPathViolation("target epoch != epoch of slot")
             if not (slot + self.min_delay <= self.state_slot
                     <= slot + self.slots_per_epoch):
                 raise FastPathViolation("inclusion window")
+            plan_key = (self._ctx_key(target_epoch),
+                        bytes(data.hash_tree_root()),
+                        bytes(att.aggregation_bits.hash_tree_root()))
+            plan = _PLAN_CACHE.get(plan_key)
+            if plan is None:
+                cold.append((i, att, plan_key, target_epoch))
+            else:
+                plans[i] = plan
+        if cold:
+            self._resolve_cold(cold, plans)
+        return plans
+
+    def _resolve_cold(self, cold, plans) -> None:
+        """Batched first-sight resolution: per-item structural checks +
+        committee gathers, then ONE concatenated mask/segment-count/argsort
+        pass over the whole cold set (the per-item ``np.sort``/``np.split``
+        walk this replaces was the cold path's Python floor).  Committee
+        members are unique by construction (permutation slices), so the
+        per-segment sorted gather IS the spec's ``sorted(set(...))``."""
+        spec, state = self.spec, self.state
+        comms, bit_arrays = [], []
+        for i, att, plan_key, target_epoch in cold:
+            data = att.data
+            slot = int(data.slot)
+            if target_epoch != slot // self.slots_per_epoch:
+                raise FastPathViolation("target epoch != epoch of slot")
             ctx = committee_context(spec, state, target_epoch)
             if int(data.index) >= ctx.committees_per_slot:
                 raise FastPathViolation("committee index out of range")
@@ -300,29 +424,26 @@ class _BlockResolver:
             bits = bulk.bitlist_to_numpy(att.aggregation_bits)
             if len(bits) != len(committee):
                 raise FastPathViolation("aggregation bits != committee size")
-            out.append((committee, bits))
-        return out
-
-
-def attesting_index_sets(resolved) -> List[np.ndarray]:
-    """Sorted attesting-index arrays for a block's resolved attestations.
-
-    One concatenated mask selects every attester in the block; per-item
-    participation counts are one ``segment_sum`` over the item axis (the
-    indexed-attestation emptiness rule — is_valid_indexed_attestation's
-    ``len(indices) == 0`` reject — checked for all items in bulk).
-    Committee members are unique by construction (permutation slices), so
-    the sorted gather IS the spec's ``sorted(set(...))``."""
-    if not resolved:
-        return []
-    k = len(resolved)
-    lens = np.fromiter((len(bits) for _, bits in resolved), np.int64, k)
-    item_ids = np.repeat(np.arange(k, dtype=np.int64), lens)
-    all_bits = np.concatenate([bits for _, bits in resolved])
-    counts = segment_sum(all_bits.astype(np.int64), item_ids, k)
-    if not counts.all():
-        raise FastPathViolation("empty attesting set")
-    members = np.concatenate([committee for committee, _ in resolved])
-    selected = members[all_bits]
-    offsets = np.cumsum(counts)[:-1]
-    return [np.sort(part) for part in np.split(selected, offsets)]
+            comms.append(committee)
+            bit_arrays.append(bits)
+        k = len(cold)
+        lens = np.fromiter((len(b) for b in bit_arrays), np.int64, k)
+        item_ids = np.repeat(np.arange(k, dtype=np.int64), lens)
+        all_bits = np.concatenate(bit_arrays)
+        counts = segment_sum(all_bits.astype(np.int64), item_ids, k)
+        if not counts.all():
+            raise FastPathViolation("empty attesting set")
+        selected = np.concatenate(comms)[all_bits]
+        # one argsort for the whole block: stable sort on (item, value)
+        order = np.lexsort((selected, item_ids[all_bits]))
+        parts = np.split(selected[order], np.cumsum(counts)[:-1])
+        for (i, att, plan_key, target_epoch), attesters in zip(cold, parts):
+            # probed on the attester set about to enter the memo: a
+            # corrupted plan is consumed by THIS block (wrong members ->
+            # failed batch or root mismatch -> replay) and the poisoned
+            # insert pops with the block's cache transaction
+            attesters = _SITE_PLAN_MEMO(attesters)
+            attesters.setflags(write=False)
+            plan = AttestationPlan(attesters, plan_key[1], target_epoch)
+            plans[i] = plan
+            _fifo_put(_PLAN_CACHE, plan_key, plan, cap=_PLAN_CACHE_MAX)

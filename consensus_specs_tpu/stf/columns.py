@@ -1,0 +1,135 @@
+"""Resident validator-state columns (ROADMAP item 3).
+
+The altair fast path and the epoch kernels both consume whole-registry
+columns (participation flags, effective balances) that the SSZ tree only
+hands out one chunk walk at a time: before this module, EVERY block's
+attestation scatter re-unpacked both participation columns from the tree
+(``bulk.packed_uint8_to_numpy`` — a ~n/32-chunk walk each), and every
+epoch-transition phase re-unpacked them again, so a 32-block epoch paid
+~70 full-column tree walks for data that changed only incrementally.
+
+This module keeps those columns *resident*:
+
+* **host residency** — a content-addressed store keyed by the column's
+  memoized SSZ tree root.  A flush registers the freshly written array
+  under the column's new root, so the next reader (the following block's
+  mirror read, or any epoch-transition phase) gets the SAME array back as
+  a dict probe instead of a tree walk.  Root keying makes staleness
+  impossible: any tree write the store did not see (a deposit appending a
+  participation entry, the literal replay rewriting a column) produces a
+  new root and the next read rebuilds honestly.  Cached arrays are
+  READONLY; mutating readers take ``staged_view`` (an explicit copy — the
+  numpy mirror demoted to a staged view per the HD01 contract).
+* **device residency** — ``device_column`` uploads a column to the JAX
+  backend once per root (partitioned over the ``parallel/mesh.py`` axis
+  when the backend has multiple devices, replicated otherwise) and serves
+  the same buffer to every later device consumer of that version — the
+  altair epoch kernel reads participation flags without re-staging them,
+  the way ``ops/merkle_resident.py`` keeps balance leaves resident for
+  the fused root reduction.
+
+Insertions ride the block cache transaction (``staging.note_insert``)
+like every other fast-path memo: a failed block's flush is popped with
+the rollback, so the store can never serve a column version whose block
+was rolled back (chaos-pinned via the engine's mirror probes).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from . import staging
+
+# column tree root -> {"host": readonly ndarray, "device": jax array|None}
+_COLUMN_STORE: Dict[bytes, dict] = {}
+_COLUMN_STORE_MAX = 8
+
+
+def _store_put(root: bytes, host: np.ndarray) -> dict:
+    if len(_COLUMN_STORE) >= _COLUMN_STORE_MAX:
+        _COLUMN_STORE.pop(next(iter(_COLUMN_STORE)))
+    entry = _COLUMN_STORE[root] = {"host": host, "device": None}
+    staging.note_insert(_COLUMN_STORE, root)
+    return entry
+
+
+def _participation_view(state, current: bool):
+    return (state.current_epoch_participation if current
+            else state.previous_epoch_participation)
+
+
+def _entry_for(view) -> dict:
+    """The store entry of a packed-uint8 column view, keyed by its
+    memoized tree root (cheap after any state-root computation; a fresh
+    write pays one subtree hash that the block's own state-root check
+    would have paid anyway)."""
+    from consensus_specs_tpu.ssz import bulk
+
+    root = bytes(view.hash_tree_root())
+    entry = _COLUMN_STORE.get(root)
+    if entry is None:
+        host = bulk.packed_uint8_to_numpy(view)
+        host.setflags(write=False)
+        entry = _store_put(root, host)
+    return entry
+
+
+def participation_column(state, current: bool) -> np.ndarray:
+    """READONLY resident numpy column of one epoch's participation flags.
+    Mutating consumers must copy (``staged_view``); read-only consumers
+    (the epoch phases) use it directly."""
+    return _entry_for(_participation_view(state, current))["host"]
+
+
+def staged_view(state, current: bool) -> np.ndarray:
+    """A mutable staged view (copy) of one participation column — the
+    engine's per-block scatter target.  Hand it back via ``flush`` so the
+    next reader hits residency instead of re-walking the tree."""
+    return participation_column(state, current).copy()
+
+
+def flush(state, current: bool, col: np.ndarray) -> None:
+    """Write a staged column back into the state tree as ONE packed write
+    and register the array under the column's new root — the resident
+    half of the mirror-flush contract."""
+    from consensus_specs_tpu.ssz import bulk
+
+    view = _participation_view(state, current)
+    bulk.set_packed_uint8_from_numpy(view, col)
+    col.setflags(write=False)
+    _store_put(bytes(view.hash_tree_root()), col)
+
+
+def device_column(state, current: bool):
+    """The resident column as a device array, uploaded once per column
+    version and shared by every later consumer of that root (the altair
+    epoch kernel's participation input)."""
+    entry = _entry_for(_participation_view(state, current))
+    if entry["device"] is None:
+        entry["device"] = _device_put(entry["host"])
+    return entry["device"]
+
+
+def _device_put(host: np.ndarray):
+    """Upload a column, partitioned over the mesh's validator axis when
+    the backend has more than one device (and the length divides evenly —
+    ragged columns replicate; the epoch kernels reduce over the full axis
+    either way), single-device otherwise."""
+    import jax
+
+    devices = jax.devices()
+    if len(devices) > 1 and len(host) % len(devices) == 0:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from consensus_specs_tpu.parallel.mesh import default_mesh
+
+        sharding = NamedSharding(default_mesh(), PartitionSpec("v"))
+        return jax.device_put(host, sharding)
+    return jax.device_put(host, devices[0])
+
+
+def reset_caches() -> None:
+    """Drop every resident column (bench cold-start control and test
+    isolation)."""
+    _COLUMN_STORE.clear()

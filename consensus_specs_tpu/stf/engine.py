@@ -29,15 +29,15 @@ tests/spec/phase0/sanity/test_stf_engine_differential.py).
 """
 from __future__ import annotations
 
+import hashlib
 import time
 
 from consensus_specs_tpu import faults, tracing
 
-from . import slot_roots, staging, sync, verify
+from . import columns, slot_roots, staging, sync, verify
 from .attestations import (
     FastPathViolation,
     affine_rows,
-    attesting_index_sets,
     beacon_proposer_index,
     resolve_block_attestations,
 )
@@ -87,6 +87,13 @@ stats = {
     "replay_reasons": {},
     "sig_verify_s": 0.0,
     "attestation_apply_s": 0.0,
+    # attestation_apply_s attributed (ISSUE 8): plan resolution (memo
+    # probes + cold committee gathers), the state-application loop, and
+    # the participation mirror flush — a regression names its phase
+    # instead of moving one opaque number
+    "resolve_s": 0.0,
+    "apply_s": 0.0,
+    "mirror_flush_s": 0.0,
     "sync_apply_s": 0.0,
     "slot_roots_s": 0.0,
     "other_s": 0.0,
@@ -376,109 +383,177 @@ def _attestations(spec, state, attestations, collect, bls_on,
         stats["attestation_apply_s"] += time.perf_counter() - t0
 
 
+def _attester_domains(spec, state, resolver) -> dict:
+    """The (at most two) beacon-attester domains a block's attestations
+    can sign under, computed once per block.  ``compute_signing_root`` of
+    an attestation then reduces to one sha256 of (data root || domain) —
+    the SigningData container's own merkleization shape — instead of a
+    per-attestation container build."""
+    return {
+        epoch: bytes(spec.get_domain(
+            state, spec.DOMAIN_BEACON_ATTESTER, spec.Epoch(epoch)))
+        for epoch in {resolver.previous_epoch, resolver.current_epoch}
+    }
+
+
 def _attestations_inner(spec, state, attestations, collect, bls_on) -> None:
+    t0 = time.perf_counter()
     resolver = resolve_block_attestations(spec, state)
-    resolved = resolver.resolve(attestations)
-    index_sets = attesting_index_sets(resolved)
-    tracing.count("stf.attestations", len(index_sets))
+    plans = resolver.resolve(attestations)
+    t1 = time.perf_counter()
+    stats["resolve_s"] += t1 - t0
+    tracing.count("stf.attestations", len(plans))
 
     # identical for every attestation in the block: state.slot is fixed and
-    # process_block_header already pinned it to the block's proposer
+    # process_block_header already pinned it to the block's proposer.
+    # Every loop-invariant view is hoisted — at 122 aggregates/block the
+    # per-attestation SSZ field chains were a measurable apply_s share
     proposer_index = beacon_proposer_index(spec, state)
     current_epoch = resolver.current_epoch
     validators = state.validators
     registry_root = bytes(validators.hash_tree_root())
+    domains = _attester_domains(spec, state, resolver) if bls_on else None
+    state_slot = state.slot
+    cur_justified = state.current_justified_checkpoint
+    prev_justified = state.previous_justified_checkpoint
+    cur_pendings = state.current_epoch_attestations
+    prev_pendings = state.previous_epoch_attestations
+    PendingAttestation = spec.PendingAttestation
 
-    for att, attesters in zip(attestations, index_sets):
+    for att, plan in zip(attestations, plans):
         data = att.data
-        pending = spec.PendingAttestation(
+        pending = PendingAttestation(
             data=data,
             aggregation_bits=att.aggregation_bits,
-            inclusion_delay=state.slot - data.slot,
+            inclusion_delay=state_slot - data.slot,
             proposer_index=proposer_index,
         )
-        if int(data.target.epoch) == current_epoch:
-            if data.source != state.current_justified_checkpoint:
+        if plan.target_epoch == current_epoch:
+            if data.source != cur_justified:
                 raise FastPathViolation("source != current justified")
-            state.current_epoch_attestations.append(pending)
+            cur_pendings.append(pending)
         else:
-            if data.source != state.previous_justified_checkpoint:
+            if data.source != prev_justified:
                 raise FastPathViolation("source != previous justified")
-            state.previous_epoch_attestations.append(pending)
+            prev_pendings.append(pending)
         if bls_on:
-            signing_root = spec.compute_signing_root(
-                data, spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
-                                      data.target.epoch))
+            attesters = plan.attesters
+            signing_root = hashlib.sha256(
+                plan.data_root + domains[plan.target_epoch]).digest()
             collect(registry_root + attesters.tobytes(), len(attesters),
                     lambda a=attesters: affine_rows(validators, a),
-                    bytes(signing_root), bytes(att.signature))
+                    signing_root, bytes(att.signature))
+    stats["apply_s"] += time.perf_counter() - t1
 
 
-def _participation_flag_mask(spec, state, resolver, data, is_current) -> int:
-    """``get_attestation_participation_flag_indices`` (altair.py:303-330)
-    as a bit mask, with the spec's ``assert is_matching_source`` mapped to
-    the replay contract.  The matching-target/head short-circuits are
-    preserved so ``get_block_root*`` raises exactly when the spec's
-    would."""
-    justified = (state.current_justified_checkpoint if is_current
-                 else state.previous_justified_checkpoint)
-    if data.source != justified:
-        raise FastPathViolation("source != justified checkpoint")
-    inclusion_delay = resolver.state_slot - int(data.slot)
-    is_matching_target = bytes(data.target.root) == bytes(
-        spec.get_block_root(state, data.target.epoch))
-    is_matching_head = is_matching_target and bytes(
-        data.beacon_block_root) == bytes(
-        spec.get_block_root_at_slot(state, data.slot))
-    mask = 0
-    if inclusion_delay <= int(spec.integer_squareroot(spec.SLOTS_PER_EPOCH)):
-        mask |= 1 << int(spec.TIMELY_SOURCE_FLAG_INDEX)
-    if is_matching_target and inclusion_delay <= int(spec.SLOTS_PER_EPOCH):
-        mask |= 1 << int(spec.TIMELY_TARGET_FLAG_INDEX)
-    if is_matching_head and inclusion_delay == int(
-            spec.MIN_ATTESTATION_INCLUSION_DELAY):
-        mask |= 1 << int(spec.TIMELY_HEAD_FLAG_INDEX)
-    return mask
+class _FlagMaskContext:
+    """Per-block context for ``get_attestation_participation_flag_indices``
+    (altair.py:303-330) as a bit mask, with the spec's ``assert
+    is_matching_source`` mapped to the replay contract.  Everything
+    loop-invariant — the justified checkpoints, the spec constants, and
+    the (at most two) target-epoch block roots and (typically two)
+    per-slot head roots — is computed once per block instead of once per
+    attestation; the matching-target/head short-circuits and the
+    ``get_block_root*`` raise points are preserved (memoized lookups
+    raise at the same first-use point the spec's per-attestation call
+    would, and a successful lookup would have re-succeeded identically)."""
+
+    __slots__ = ("spec", "state", "state_slot", "cur_justified",
+                 "prev_justified", "sqrt_spe", "spe", "min_delay",
+                 "src_bit", "tgt_bit", "head_bit", "_target_roots",
+                 "_head_roots")
+
+    def __init__(self, spec, state, resolver):
+        self.spec = spec
+        self.state = state
+        self.state_slot = resolver.state_slot
+        self.cur_justified = state.current_justified_checkpoint
+        self.prev_justified = state.previous_justified_checkpoint
+        self.sqrt_spe = int(spec.integer_squareroot(spec.SLOTS_PER_EPOCH))
+        self.spe = int(spec.SLOTS_PER_EPOCH)
+        self.min_delay = int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
+        self.src_bit = 1 << int(spec.TIMELY_SOURCE_FLAG_INDEX)
+        self.tgt_bit = 1 << int(spec.TIMELY_TARGET_FLAG_INDEX)
+        self.head_bit = 1 << int(spec.TIMELY_HEAD_FLAG_INDEX)
+        self._target_roots: dict = {}
+        self._head_roots: dict = {}
+
+    def mask(self, data, target_epoch: int, is_current: bool) -> int:
+        justified = self.cur_justified if is_current else self.prev_justified
+        if data.source != justified:
+            raise FastPathViolation("source != justified checkpoint")
+        slot = int(data.slot)
+        inclusion_delay = self.state_slot - slot
+        target_root = self._target_roots.get(target_epoch)
+        if target_root is None:
+            target_root = self._target_roots[target_epoch] = bytes(
+                self.spec.get_block_root(self.state, data.target.epoch))
+        is_matching_target = bytes(data.target.root) == target_root
+        if is_matching_target:
+            head_root = self._head_roots.get(slot)
+            if head_root is None:
+                head_root = self._head_roots[slot] = bytes(
+                    self.spec.get_block_root_at_slot(self.state, data.slot))
+            is_matching_head = bytes(data.beacon_block_root) == head_root
+        else:
+            is_matching_head = False
+        mask = 0
+        if inclusion_delay <= self.sqrt_spe:
+            mask |= self.src_bit
+        if is_matching_target and inclusion_delay <= self.spe:
+            mask |= self.tgt_bit
+        if is_matching_head and inclusion_delay == self.min_delay:
+            mask |= self.head_bit
+        return mask
 
 
 def _attestations_inner_altair(spec, state, attestations, collect, bls_on) -> None:
     """The altair-lineage process_attestation loop (altair.py:413-446),
-    vectorized: the same whole-block resolution as phase0, then per
-    attestation a participation-flag OR-scatter on a numpy mirror of the
-    epoch participation column, the proposer-reward numerator as one
-    masked increment sum per newly-set flag, and one signature entry per
-    aggregate.  Mirrors flush as ONE packed write per dirtied column and
-    the proposer reward lands as one balance write (per-attestation floor
-    division preserved — the spec divides before each increase)."""
+    vectorized: the same plan-cached whole-block resolution as phase0,
+    then per attestation a participation-flag OR-scatter on a staged view
+    of the resident epoch participation column (stf/columns.py — a dict
+    probe after the first block, not a tree walk), the proposer-reward
+    numerator as one masked increment sum per newly-set flag, and one
+    signature entry per aggregate.  Staged views flush as ONE packed
+    write per dirtied column (re-registered under the column's new root,
+    so the NEXT block's read hits residency) and the proposer reward
+    lands as one balance write (per-attestation floor division preserved
+    — the spec divides before each increase)."""
     import numpy as np
 
     from consensus_specs_tpu.ops.epoch_jax import registry_columns
-    from consensus_specs_tpu.ssz import bulk
 
+    t_res0 = time.perf_counter()
     resolver = resolve_block_attestations(spec, state)
-    resolved = resolver.resolve(attestations)
-    index_sets = attesting_index_sets(resolved)
-    tracing.count("stf.attestations", len(index_sets))
+    plans = resolver.resolve(attestations)
+    t_res1 = time.perf_counter()
+    stats["resolve_s"] += t_res1 - t_res0
+    tracing.count("stf.attestations", len(plans))
 
     proposer_index = beacon_proposer_index(spec, state)
     current_epoch = resolver.current_epoch
     validators = state.validators
     registry_root = bytes(validators.hash_tree_root())
+    domains = _attester_domains(spec, state, resolver) if bls_on else None
 
-    # participation mirrors: read lazily once per block, written back once
-    # per dirtied column after the loop (deposits append only later in
-    # process_operations, so the column length is stable here)
-    columns = {}
+    # participation mirrors: staged views of the resident columns, read
+    # lazily once per block, written back once per dirtied column after
+    # the loop (deposits append only later in process_operations, so the
+    # column length is stable here).  ``resident`` keeps the store's
+    # readonly original so a column whose bits were all already set (a
+    # block of re-carried aggregates) skips the flush AND the subtree
+    # re-hash its packed write would force.
+    staged, resident = {}, {}
 
     def column_for(is_current):
-        col = columns.get(is_current)
+        col = staged.get(is_current)
         if col is None:
-            view = (state.current_epoch_participation if is_current
-                    else state.previous_epoch_participation)
+            resident[is_current] = columns.participation_column(
+                state, is_current)
             # probed between read and use: a corrupted mirror must be
             # caught by the post-state root check, never flushed silently
-            col = columns[is_current] = _SITE_MIRROR_READ(
-                bulk.packed_uint8_to_numpy(view))
+            col = staged[is_current] = _SITE_MIRROR_READ(
+                columns.staged_view(state, is_current))
         return col
 
     # exact get_base_reward column: effective // increment * per-increment
@@ -493,11 +568,13 @@ def _attestations_inner_altair(spec, state, attestations, collect, bls_on) -> No
     denominator = ((weight_denominator - proposer_weight)
                    * weight_denominator // proposer_weight)
     proposer_reward_total = 0
+    flag_ctx = _FlagMaskContext(spec, state, resolver)
 
-    for att, attesters in zip(attestations, index_sets):
+    for att, plan in zip(attestations, plans):
         data = att.data
-        is_current = int(data.target.epoch) == current_epoch
-        mask = _participation_flag_mask(spec, state, resolver, data, is_current)
+        attesters = plan.attesters
+        is_current = plan.target_epoch == current_epoch
+        mask = flag_ctx.mask(data, plan.target_epoch, is_current)
         column = column_for(is_current)
         held = column[attesters]
         numerator = 0
@@ -514,22 +591,21 @@ def _attestations_inner_altair(spec, state, attestations, collect, bls_on) -> No
         # proposer balance; summing the floored rewards is exact
         proposer_reward_total += numerator * per_increment // denominator
         if bls_on:
-            signing_root = spec.compute_signing_root(
-                data, spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
-                                      data.target.epoch))
+            signing_root = hashlib.sha256(
+                plan.data_root + domains[plan.target_epoch]).digest()
             collect(registry_root + attesters.tobytes(), len(attesters),
                     lambda a=attesters: affine_rows(validators, a),
-                    bytes(signing_root), bytes(att.signature))
+                    signing_root, bytes(att.signature))
+    t_apply = time.perf_counter()
+    stats["apply_s"] += t_apply - t_res1
 
     _SITE_MIRROR_FLUSH()  # pre-flush: mirrors dirty, state still clean
-    if True in columns:
-        bulk.set_packed_uint8_from_numpy(
-            state.current_epoch_participation, columns[True])
-    if False in columns:
-        bulk.set_packed_uint8_from_numpy(
-            state.previous_epoch_participation, columns[False])
+    for is_current, col in staged.items():
+        if not np.array_equal(col, resident[is_current]):
+            columns.flush(state, is_current, col)
     if proposer_reward_total:
         # Gwei() raises on uint64 overflow exactly where the spec's
         # sequential += would have (increments are non-negative)
         state.balances[proposer_index] = spec.Gwei(
             int(state.balances[proposer_index]) + proposer_reward_total)
+    stats["mirror_flush_s"] += time.perf_counter() - t_apply

@@ -126,6 +126,35 @@ def test_noqa_suppresses():
     assert cc02(_OWNER, src) == []
 
 
+_HELPER_KEY = """\
+def _row_key(spec, state, period):
+    return (bytes(state.validators.hash_tree_root()),
+            {geometry}int(period))
+
+
+def sync_committee_rows(spec, state, period):
+    key = _row_key(spec, state, period)
+    hit = _SYNC_ROWS_CACHE.get(key)
+    if hit is not None:
+        return hit
+    rows = resolve(spec, state, period)
+    _SYNC_ROWS_CACHE[key] = rows
+    return rows
+"""
+
+
+def test_helper_built_key_is_transparent():
+    """A key hoisted into a local builder function keeps the rule's
+    power (ISSUE 8): only the callsite arguments the helper's RETURN
+    actually reaches count as bound — naming ``spec`` in the call is not
+    coverage when the helper drops it."""
+    covered = _HELPER_KEY.format(geometry="int(spec.SYNC_COMMITTEE_SIZE), ")
+    assert cc02(_OWNER, covered) == []
+    dropped = _HELPER_KEY.format(geometry="")
+    found = cc02(_OWNER, dropped)
+    assert len(found) == 1 and "spec" in found[0].message, found
+
+
 # -- the live tree, gate-shaped ----------------------------------------------
 
 

@@ -179,6 +179,12 @@ _PHASE0_CASES = [
     [F("stf.engine.native_gate", nth=3, kind="corrupt")],
     [F("stf.engine.cache_commit", nth=2)],
     [F("stf.attestations.resolve", nth=1)],
+    # a corrupted plan enters the memo AND is consumed by the same block:
+    # the batch fails on the wrong member set, the block replays, and the
+    # cache transaction pops the poisoned plan — the clean re-run in
+    # _run_case then proves the memo serves no corrupted entry
+    [F("stf.attestations.plan_memo", nth=1, kind="corrupt")],
+    [F("stf.attestations.plan_memo", nth=5)],
     [F("stf.attestations.affine_rows", nth=2, kind="corrupt")],
     [F("stf.verify.native_call", nth=2)],
     [F("stf.verify.msm", nth=2)],

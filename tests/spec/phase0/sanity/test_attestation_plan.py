@@ -1,0 +1,181 @@
+"""Unit pins for the epoch-scoped attestation plan cache (ISSUE 8).
+
+The cache (``stf/attestations._PLAN_CACHE``) memoizes whole-aggregate
+resolution — committee gather + bits unpack + attester sort — on
+(committee-geometry lookup key, attestation-data root, aggregation-bits
+root).  These tests pin the contract edges the differential suites can't
+isolate: content-addressed hits, bits-digest misses, FIFO eviction,
+rollback under the block cache transaction, and geometry-keyed
+invalidation (a state whose committees could differ can never consume
+another state's plan).
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.stf import attestations as atts_mod
+from consensus_specs_tpu.stf import staging
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.helpers.attestations import (
+    next_slots_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+def _attesting_block_position(spec, state):
+    """(state at the last block's slot, that block's attestations): one
+    attestation-bearing resolve position built with the sanity helpers."""
+    next_epoch(spec, state)
+    _, signed_blocks, _ = next_slots_with_attestations(
+        spec, state.copy(), int(spec.SLOTS_PER_EPOCH) + 2, True, False)
+    s = state.copy()
+    for sb in signed_blocks[:-1]:
+        spec.state_transition(s, sb, True)
+    last = signed_blocks[-1].message
+    spec.process_slots(s, last.slot)
+    atts = list(last.body.attestations)
+    assert atts, "corpus position carries no attestations"
+    return s, atts
+
+
+def _resolve(spec, s, atts):
+    return atts_mod.resolve_block_attestations(spec, s).resolve(atts)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_plan_hit_serves_recarried_aggregate(spec, state):
+    """A re-resolved aggregate is served the SAME plan object — the
+    re-carried-aggregate corpus shape (sig_memo_hits 1920/2048) never
+    re-gathers, re-unpacks, or re-sorts."""
+    s, atts = _attesting_block_position(spec, state)
+    atts_mod.reset_caches()
+    plans = _resolve(spec, s, atts)
+    n_unique = len(atts_mod._PLAN_CACHE)
+    assert n_unique == len(atts)  # corpus carries distinct aggregates
+    again = _resolve(spec, s, atts)
+    assert all(a is b for a, b in zip(plans, again))
+    assert len(atts_mod._PLAN_CACHE) == n_unique
+    # a DECODED copy of the same aggregate (fresh SSZ objects, same
+    # content) hits too: the key is content-addressed roots, not ids
+    copies = [type(a).decode_bytes(a.encode_bytes()) for a in atts]
+    assert all(a is b for a, b in zip(plans, _resolve(spec, s, copies)))
+    yield None
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_plan_miss_on_bits_digest(spec, state):
+    """Same attestation data, different aggregation bits -> different
+    plan (the bits-root key half), with the attester set tracking the
+    flipped bit exactly."""
+    s, atts = _attesting_block_position(spec, state)
+    atts_mod.reset_caches()
+    base = _resolve(spec, s, atts)[0]
+    att2 = atts[0].copy()
+    flip = next(i for i, b in enumerate(att2.aggregation_bits) if b)
+    if sum(att2.aggregation_bits) == 1:
+        # keep the attesting set non-empty: set another bit instead
+        flip = next(i for i, b in enumerate(att2.aggregation_bits) if not b)
+        att2.aggregation_bits[flip] = True
+    else:
+        att2.aggregation_bits[flip] = False
+    size_before = len(atts_mod._PLAN_CACHE)
+    plan2 = _resolve(spec, s, [att2])[0]
+    assert len(atts_mod._PLAN_CACHE) == size_before + 1  # miss, new entry
+    assert plan2.data_root == base.data_root  # data half unchanged
+    assert not np.array_equal(plan2.attesters, base.attesters)
+    yield None
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_plan_fifo_eviction(spec, state):
+    """At capacity the OLDEST plan leaves first (insertion-ordered dict
+    pop), and an evicted plan simply re-resolves — no correctness edge."""
+    s, atts = _attesting_block_position(spec, state)
+    # three unique plans: the original plus two bit-variants (distinct
+    # bits digests) — block width doesn't matter, key uniqueness does
+    base = atts[0]
+    assert sum(base.aggregation_bits) >= 3
+    variants = [base]
+    set_bits = [j for j, b in enumerate(base.aggregation_bits) if b]
+    for i in range(2):
+        v = base.copy()
+        v.aggregation_bits[set_bits[i]] = False
+        variants.append(v)
+    atts_mod.reset_caches()
+    old_cap = atts_mod._PLAN_CACHE_MAX
+    atts_mod._PLAN_CACHE_MAX = 2
+    try:
+        _resolve(spec, s, variants[:1])
+        first_key = next(iter(atts_mod._PLAN_CACHE))
+        _resolve(spec, s, variants[1:])  # second fills, third evicts first
+        assert len(atts_mod._PLAN_CACHE) == 2
+        assert first_key not in atts_mod._PLAN_CACHE
+        re_resolved = _resolve(spec, s, variants[:1])[0]
+        assert len(re_resolved.attesters) > 0
+    finally:
+        atts_mod._PLAN_CACHE_MAX = old_cap
+        atts_mod.reset_caches()
+    yield None
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_plan_rollback_pops_transactional_inserts(spec, state):
+    """Plans inserted inside a failing block's cache transaction roll
+    back with it — a poisoned plan can never outlive its block (the
+    chaos case's unit-level half)."""
+    s, atts = _attesting_block_position(spec, state)
+    atts_mod.reset_caches()
+    with pytest.raises(RuntimeError, match="mid-block fault"):
+        with staging.block_transaction():
+            _resolve(spec, s, atts)
+            assert len(atts_mod._PLAN_CACHE) == len(atts)  # visible inserts
+            raise RuntimeError("mid-block fault")
+    assert len(atts_mod._PLAN_CACHE) == 0
+    # and a clean transaction commits them
+    with staging.block_transaction():
+        _resolve(spec, s, atts)
+    assert len(atts_mod._PLAN_CACHE) == len(atts)
+    yield None
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_plan_stale_geometry_never_reused(spec, state):
+    """A state whose committee geometry inputs differ (here: every randao
+    mix mutated, so the attester seed changes) MISSES on every plan the
+    original state built — the context half of the key makes stale reuse
+    structurally impossible."""
+    s, atts = _attesting_block_position(spec, state)
+    atts_mod.reset_caches()
+    plans = _resolve(spec, s, atts)
+    size_before = len(atts_mod._PLAN_CACHE)
+    s2 = s.copy()
+    for i in range(len(s2.randao_mixes)):
+        s2.randao_mixes[i] = b"\xfe" * 32  # every seed input differs
+    plans2 = _resolve(spec, s2, atts)
+    assert len(atts_mod._PLAN_CACHE) == size_before + len(atts)
+    assert all(a is not b for a, b in zip(plans, plans2))
+    yield None
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_plan_survives_randao_progress(spec, state):
+    """The ctx half keys on the attester SEED, not the full randao_mixes
+    root: a state differing only in a mix the seed does not read (the
+    current epoch's, which process_randao rewrites every block) HITS —
+    this is what makes plans live across the blocks that re-carry an
+    aggregate."""
+    s, atts = _attesting_block_position(spec, state)
+    atts_mod.reset_caches()
+    plans = _resolve(spec, s, atts)
+    s2 = s.copy()
+    # the mix process_randao touches: current epoch % EPOCHS_PER_VECTOR
+    ix = int(spec.get_current_epoch(s2)) % len(s2.randao_mixes)
+    s2.randao_mixes[ix] = b"\xab" * 32
+    plans2 = _resolve(spec, s2, atts)
+    assert all(a is b for a, b in zip(plans, plans2))
+    yield None

@@ -119,6 +119,40 @@ def test_stf_differential_random_scenario_deep(seed):
     case(phase="phase0", bls_active=True)
 
 
+@pytest.mark.slow
+def test_engine_vs_literal_parity_1m_validators():
+    """Validator-count axis of the differential contract (ISSUE 8): a
+    short full-block walk at 2^20 validators, engine vs literal with
+    per-block byte-identical roots and no silent fallback — the
+    scale-bench row's correctness story, pinned in the suite.  BLS off:
+    what scales with validator count is committee geometry, the
+    attestation plan, and the participation/balance writes, and those
+    are exactly the parity surface here."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))))
+    import bench
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.stf import attestations as stf_attestations
+
+    n = 1 << 20
+    spec = get_spec("phase0", "mainnet")
+    was_active = bls.bls_active
+    bls.bls_active = False
+    try:
+        state = bench.build_state(spec, n)
+        bench._install_real_pubkeys(spec, state, n)
+        signed_blocks = bench._build_epoch_blocks(spec, state, n_slots=4)
+        stf_attestations.reset_caches()
+        _per_block_differential(spec, state, signed_blocks)
+    finally:
+        bls.bls_active = was_active
+        stf_attestations.reset_caches()  # don't leak 1M-sized columns
+
+
 # -- identical failure behavior ----------------------------------------------
 
 

@@ -61,3 +61,38 @@ def test_trend_not_comparable_is_silent():
     assert bench.check_perf_trend(dict(_ROW, value="nan?"), _ROW) is None
     assert bench.check_perf_trend(
         dict(_ROW, value=99.0), dict(_ROW, value=0.0)) is None
+
+
+# -- forkchoice_batch_ingest row gate (ISSUE 8) ------------------------------
+
+_FC_ROW = {"metric": "forkchoice_batch_ingest_100000_attestations_400000_validators",
+           "value": 50_000.0, "unit": "attestations/s", "vs_baseline": 12.0}
+
+
+def test_fc_trend_error_row_blocks():
+    msg = bench.check_forkchoice_trend({"error": "AssertionError('6.3x')"}, None)
+    assert msg is not None and "errored" in msg
+
+
+def test_fc_trend_margin_floor_blocks():
+    msg = bench.check_forkchoice_trend(dict(_FC_ROW, vs_baseline=9.9), None)
+    assert msg is not None and "10x floor" in msg
+    assert bench.check_forkchoice_trend(dict(_FC_ROW, vs_baseline=10.0),
+                                        None) is None
+
+
+def test_fc_trend_throughput_regression_flagged():
+    # value is attestations/s: SMALLER is the regression direction
+    cur = dict(_FC_ROW, value=40_000.0)  # -20% vs 50k
+    msg = bench.check_forkchoice_trend(cur, _FC_ROW)
+    assert msg is not None and "perf-trend regression" in msg
+    assert bench.check_forkchoice_trend(dict(_FC_ROW, value=44_000.0),
+                                        _FC_ROW) is None  # -12%: in budget
+
+
+def test_fc_trend_not_comparable_is_silent():
+    assert bench.check_forkchoice_trend(None, _FC_ROW) is None  # QUICK skip
+    assert bench.check_forkchoice_trend(_FC_ROW, None) is None
+    assert bench.check_forkchoice_trend(_FC_ROW, {"error": "x"}) is None
+    other = dict(_FC_ROW, metric="forkchoice_batch_ingest_other")
+    assert bench.check_forkchoice_trend(dict(_FC_ROW, value=1.0), other) is None

@@ -1,0 +1,97 @@
+"""Differential pin for the fused device flag-deltas path (ISSUE 8).
+
+``ops/epoch_altair.rewards_and_penalties`` can run its per-flag
+reward/penalty loop as ONE jit dispatch over the device-resident
+participation column (``stf/columns.device_column``), gated by the
+``CSTPU_DEVICE_COLUMNS`` policy.  Both paths must be bit-identical —
+exact int64 on either side — so the policy can flip per backend without
+a semantics question.  (On the CPU XLA backend the host path wins, which
+is why the auto policy stays host-side; this test FORCES the device path
+to pin parity regardless of backend.)
+"""
+import os
+
+import numpy as np
+
+from consensus_specs_tpu.ops import epoch_altair
+from consensus_specs_tpu.ssz import bulk
+from consensus_specs_tpu.stf import attestations as stf_attestations
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.helpers.attestations import (
+    next_epoch_with_attestations,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+
+def _force_device_columns(value):
+    prev = os.environ.get("CSTPU_DEVICE_COLUMNS")
+    if value is None:
+        os.environ.pop("CSTPU_DEVICE_COLUMNS", None)
+    else:
+        os.environ["CSTPU_DEVICE_COLUMNS"] = value
+    return prev
+
+
+def _participating_state(spec, state):
+    """Two attestation-bearing epochs: both participation columns carry
+    real flag spreads when rewards run."""
+    next_epoch(spec, state)
+    _, _, s = next_epoch_with_attestations(spec, state, True, True)
+    _, _, s = next_epoch_with_attestations(spec, s, True, True)
+    return s
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_device_flag_deltas_bit_identical(spec, state):
+    s = _participating_state(spec, state)
+    s_host, s_dev = s.copy(), s.copy()
+    stf_attestations.reset_caches()
+    assert not epoch_altair._device_columns_policy()  # auto stays host
+    epoch_altair.rewards_and_penalties(spec, s_host)
+    prev = _force_device_columns("1")
+    try:
+        assert epoch_altair._device_columns_policy()
+        epoch_altair.rewards_and_penalties(spec, s_dev)
+    finally:
+        _force_device_columns(prev)
+    host_bal = bulk.packed_uint64_to_numpy(s_host.balances)
+    dev_bal = bulk.packed_uint64_to_numpy(s_dev.balances)
+    assert np.array_equal(host_bal, dev_bal)
+    assert bytes(s_host.hash_tree_root()) == bytes(s_dev.hash_tree_root())
+    yield None
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_device_column_uploaded_once_per_version(spec, state):
+    """The device buffer is keyed by the column's tree root: a second
+    consumer of the same version gets the SAME device array back, and a
+    flush (new root) re-uploads."""
+    from consensus_specs_tpu.stf import columns
+
+    s = _participating_state(spec, state)
+    stf_attestations.reset_caches()
+    first = columns.device_column(s, current=False)
+    assert columns.device_column(s, current=False) is first
+    # a flush registers a new version under the new root
+    col = columns.staged_view(s, current=False)
+    col[:] = 0
+    columns.flush(s, current=False, col=col)
+    assert columns.device_column(s, current=False) is not first
+    yield None
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_device_policy_off_forces_host(spec, state):
+    s = _participating_state(spec, state)
+    prev = _force_device_columns("0")
+    try:
+        assert not epoch_altair._device_columns_policy()
+        # and the full epoch still runs (host loop) with flags present
+        s2 = s.copy()
+        epoch_altair.rewards_and_penalties(spec, s2)
+    finally:
+        _force_device_columns(prev)
+    yield None

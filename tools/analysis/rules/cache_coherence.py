@@ -76,9 +76,18 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         owner=("stf", "attestations.py"),
         module="consensus_specs_tpu.stf.attestations",
         module_globals=frozenset({"_ACTIVE_CACHE", "_CTX_CACHE", "_CTX_LOOKUP",
-                                  "_PROPOSER_CACHE", "_AFFINE_MATRIX_CACHE"}),
+                                  "_PROPOSER_CACHE", "_AFFINE_MATRIX_CACHE",
+                                  "_PLAN_CACHE", "_PLAN_CTX_LOOKUP"}),
         producers=frozenset({"active_indices", "committee_context",
                              "affine_matrix"}),
+        invalidators=frozenset({"reset_caches"}),
+    ),
+    CacheSpec(
+        name="resident column store",
+        owner=("stf", "columns.py"),
+        module="consensus_specs_tpu.stf.columns",
+        module_globals=frozenset({"_COLUMN_STORE"}),
+        producers=frozenset({"participation_column", "device_column"}),
         invalidators=frozenset({"reset_caches"}),
     ),
     CacheSpec(

@@ -44,13 +44,80 @@ from .cache_coherence import CACHE_REGISTRY, _parts_contain
 _IGNORED_PARAMS = {"self", "cls"}
 
 
-def _load_names(expr: ast.AST) -> Set[str]:
+def _load_names(expr: ast.AST, helpers: Optional[Dict] = None) -> Set[str]:
     """Every Name read inside an expression (comprehension targets and
-    nested loads included — over-approximation is safe here)."""
-    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+    nested loads included — over-approximation is safe here).
+
+    ``helpers`` makes local key-builder calls TRANSPARENT (ISSUE 8): a
+    call to a module-level function contributes only the arguments bound
+    to parameters its return value actually reaches — so hoisting a key
+    tuple into ``_ctx_lookup_key(spec, state, epoch)`` keeps the rule's
+    power: dropping a component inside the helper un-covers the matching
+    callsite argument, exactly as if the tuple were still inline."""
+    if not helpers:
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+    names: Set[str] = set()
+
+    def visit(node: ast.AST) -> None:
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in helpers):
+            param_order, covered, vararg, kwarg, declared = (
+                helpers[node.func.id])
+            # a *splat misaligns the index->parameter binding below, so
+            # claiming coverage for any positional would over-approximate
+            # (= silently pardon an uncovered key) — contribute nothing
+            positional = ([] if any(isinstance(a, ast.Starred)
+                                    for a in node.args) else node.args)
+            for i, arg in enumerate(positional):
+                # extra positionals bind to *vararg: covered only if the
+                # helper's return actually reaches it
+                pname = param_order[i] if i < len(param_order) else vararg
+                if pname is not None and pname in covered:
+                    names.update(_load_names(arg, helpers))
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue  # **splat: unknowable binding, not covered
+                if kw.arg in covered or (kw.arg not in declared
+                                         and kwarg is not None
+                                         and kwarg in covered):
+                    names.update(_load_names(kw.value, helpers))
+            return
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return names
 
 
-def _assignment_sources(func: ast.AST) -> Dict[str, Set[str]]:
+def _helper_signatures(tree: ast.AST) -> Dict[str, tuple]:
+    """Module-level function -> (positional parameter order, params its
+    return expressions reach through the helper's own assignment chains,
+    vararg name, kwarg name, declared named params) — the transparency
+    map for key-builder calls."""
+    helpers: Dict[str, tuple] = {}
+    for node in getattr(tree, "body", []):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sources = _assignment_sources(node)
+        returned: Set[str] = set()
+        for r in ast.walk(node):
+            if isinstance(r, ast.Return) and r.value is not None:
+                returned |= _load_names(r.value)
+        covered = _closure(returned, sources) & _func_params(node)
+        a = node.args
+        param_order = [arg.arg for arg in (*a.posonlyargs, *a.args)]
+        helpers[node.name] = (
+            param_order, covered,
+            a.vararg.arg if a.vararg else None,
+            a.kwarg.arg if a.kwarg else None,
+            set(param_order) | {arg.arg for arg in a.kwonlyargs})
+    return helpers
+
+
+def _assignment_sources(
+        func: ast.AST, helpers: Optional[Dict] = None) -> Dict[str, Set[str]]:
     """name -> union of Names appearing in every expression assigned to it
     in this function (plain/aug/ann assignments and for-targets)."""
     sources: Dict[str, Set[str]] = {}
@@ -58,7 +125,7 @@ def _assignment_sources(func: ast.AST) -> Dict[str, Set[str]]:
     def add(target: ast.AST, value: Optional[ast.AST]) -> None:
         if value is None:
             return
-        names = _load_names(value)
+        names = _load_names(value, helpers)
         # Store-context Names only: in ``cache[key] = v`` neither ``cache``
         # nor ``key`` is being (re)bound, so neither may inherit v's sources
         for t in ast.walk(target):
@@ -120,21 +187,22 @@ class KeyCoverageRule(Rule):
         cache_names: Set[str] = set()
         for s in owned:
             cache_names |= s.module_globals
+        helpers = _helper_signatures(ctx.tree)
         for func in ast.walk(ctx.tree):
             if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            yield from self._check_function(func, cache_names)
+            yield from self._check_function(func, cache_names, helpers)
 
     # -- per-function memo-shape analysis ------------------------------------
 
-    def _check_function(self, func, cache_names: Set[str]):
+    def _check_function(self, func, cache_names: Set[str], helpers=None):
         lookups: List[Tuple[str, ast.AST, ast.AST]] = []  # (cache, key, site)
         inserts: Dict[str, List[ast.AST]] = {}            # cache -> values
         for node in ast.walk(func):
             self._collect(node, cache_names, lookups, inserts)
         if not lookups:
             return
-        sources = _assignment_sources(func)
+        sources = _assignment_sources(func, helpers)
         params = _func_params(func)
         for cache, key_expr, site in lookups:
             values = inserts.get(cache)
@@ -143,7 +211,8 @@ class KeyCoverageRule(Rule):
             read_params = set()
             for v in values:
                 read_params |= _closure(_load_names(v), sources) & params
-            key_params = _closure(_load_names(key_expr), sources) & params
+            key_params = _closure(
+                _load_names(key_expr, helpers), sources) & params
             missing = sorted(read_params - key_params - cache_names)
             if missing:
                 yield (site.lineno,

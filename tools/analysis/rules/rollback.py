@@ -51,6 +51,11 @@ PROTECTED_REGION: Dict[str, FrozenSet[str]] = {
     # sync.py's writers run only from _fast_transition, inside the
     # snapshot region (altair-lineage sync-aggregate rewards)
     "sync.py": frozenset({"process_sync_aggregate", "_apply_rewards"}),
+    # columns.py's only state writer is the staged-view flush (ISSUE 8):
+    # called from _attestations_inner_altair (snapshot region) and the
+    # altair epoch phases (inside process_slots' epoch boundary, also
+    # snapshot-protected); the read-side helpers never write
+    "columns.py": frozenset({"flush"}),
 }
 
 
