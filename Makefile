@@ -38,6 +38,15 @@ dcn-dryrun:
 chaos:
 	python -m pytest tests/chaos tests/analysis/test_live_tree_clean.py -q -m 'not slow'
 
+# soak-endurance harness (ISSUE 9 / ROADMAP item 5): bounded ~2-min
+# profile — seeded faulted block walks with breaker-recovery, parity,
+# cache-coherence and memory-flatness asserts; writes SOAK.json.
+# `soak-deep` adds the long phase0+altair endurance profile.
+soak:
+	python -m pytest tests/soak -q
+soak-deep:
+	CSTPU_SOAK_DEEP=1 python -m pytest tests/soak -q
+
 lint:
 	python tools/lint.py
 
@@ -68,4 +77,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench chaos limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE
+.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE

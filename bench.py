@@ -330,8 +330,8 @@ def bench_epoch_e2e_bls(results):
     # thread pool's per-run jitter would otherwise swing the recorded
     # headline by ~10%.  Root parity and no-silent-fallback are asserted
     # on EVERY pass, not just the winner.
-    t_e2e, engine_stats, verify_stats = _best_cold_engine_pass(
-        spec, state, signed_blocks, spec_post)
+    t_e2e, engine_stats, verify_stats, telemetry_summary = \
+        _best_cold_engine_pass(spec, state, signed_blocks, spec_post)
     bls.bls_active = False
 
     t_oracle_scaled = _oracle_verify_time(128) * n_atts
@@ -365,6 +365,9 @@ def bench_epoch_e2e_bls(results):
         "breaker_state": engine_stats["breaker_state"],
         "breaker_trips": engine_stats["breaker_trips"],
         "native_degraded": verify_stats["native_degraded"],
+        # counter-invariant telemetry (ISSUE 9): the trend gate reads
+        # this subtree, so behavioral drift fails as loudly as a slowdown
+        "telemetry": telemetry_summary,
         **phases,
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
@@ -378,31 +381,98 @@ def _best_cold_engine_pass(spec, state, signed_blocks, spec_post, passes=2):
     """min-of-``passes`` engine replays, each fully COLD (dedup memo,
     native decompression cache, committee geometry, resident columns all
     reset) with root parity + no-silent-fallback asserted per pass.
-    Returns (seconds, engine-stats snapshot, verify-stats snapshot) of
-    the winning pass so the reported phase breakdown matches the
-    reported value."""
+    Returns (seconds, engine-stats snapshot, verify-stats snapshot,
+    telemetry summary) of the winning pass so the reported phase
+    breakdown matches the reported value.
+
+    The flight recorder runs ENABLED through the measured passes (the
+    headline is reported with telemetry on — ISSUE 9 acceptance); on a
+    parity/fallback assertion failure the last-N timeline dumps to
+    TELEMETRY_FAIL.json so the broken run carries its own post-mortem."""
     from consensus_specs_tpu import stf
     from consensus_specs_tpu.stf import attestations as stf_attestations
     from consensus_specs_tpu.stf import verify as stf_verify
+    from consensus_specs_tpu.telemetry import recorder
 
+    was_recording = recorder.enabled()
+    if not was_recording:
+        # fresh ring for THIS row's passes: a parity-failure dump must
+        # not misattribute an earlier row's events to the broken run (an
+        # ambient operator-enabled recorder keeps its history untouched)
+        recorder.reset()
+        recorder.enable()
     best = None
-    for _ in range(passes):
-        stf.reset_stats()
-        stf_verify.reset_memo()  # cold dedup memo: the engine warms it itself
-        stf_attestations.reset_caches()
-        s = state.copy()
-        t, _ = _timed(stf.apply_signed_blocks, spec, s, signed_blocks, True)
-        assert int(s.slot) % int(spec.SLOTS_PER_EPOCH) == 0  # epoch hit
-        assert bytes(s.hash_tree_root()) == bytes(spec_post.hash_tree_root()), \
-            "engine post-state diverged from the literal spec replay"
-        assert stf.stats["fast_blocks"] == len(signed_blocks), \
-            f"engine fell back to spec replay on {stf.stats['replayed_blocks']} blocks"
-        if best is None or t < best[0]:
-            best = (t,
-                    {**stf.stats,
-                     "replay_reasons": dict(stf.stats["replay_reasons"])},
-                    dict(stf_verify.stats))
+    try:
+        for _ in range(passes):
+            stf.reset_stats()
+            stf_verify.reset_memo()  # cold dedup memo: engine warms it itself
+            stf_attestations.reset_caches()
+            s = state.copy()
+            t, _ = _timed(stf.apply_signed_blocks, spec, s, signed_blocks, True)
+            try:
+                assert int(s.slot) % int(spec.SLOTS_PER_EPOCH) == 0  # epoch hit
+                assert bytes(s.hash_tree_root()) == bytes(spec_post.hash_tree_root()), \
+                    "engine post-state diverged from the literal spec replay"
+                assert stf.stats["fast_blocks"] == len(signed_blocks), \
+                    f"engine fell back to spec replay on {stf.stats['replayed_blocks']} blocks"
+            except AssertionError as exc:
+                recorder.dump(f"bench parity failure: {exc}",
+                              path=os.path.join(os.path.dirname(
+                                  os.path.abspath(__file__)),
+                                  "TELEMETRY_FAIL.json"))
+                raise
+            if best is None or t < best[0]:
+                best = (t,
+                        {**stf.stats,
+                         "replay_reasons": dict(stf.stats["replay_reasons"])},
+                        dict(stf_verify.stats),
+                        _telemetry_summary())
+    finally:
+        if not was_recording:
+            recorder.disable()
     return best
+
+
+def _ratio(hits, misses):
+    total = hits + misses
+    return round(hits / total, 3) if total else None
+
+
+def _telemetry_summary():
+    """The compact per-pass telemetry the e2e rows embed (ISSUE 9): cache
+    hit ratios, breaker/degradation state, replay count — the counter
+    invariants the trend gate checks, snapshotted from the SAME pass the
+    reported timings come from.  Read off the telemetry BUS (one source
+    of truth, and every bench run exercises the providers the soak and
+    post-mortem paths depend on) rather than reaching into the producer
+    modules' stats dicts directly."""
+    from consensus_specs_tpu import telemetry
+
+    p = telemetry.snapshot()["providers"]
+    att, ver = p.get("stf.plan_cache", {}), p.get("stf.verify", {})
+    col, eng = p.get("stf.columns", {}), p.get("stf.engine", {})
+    summary = {
+        "plan_hits": att.get("plan_hits", 0),
+        "plan_misses": att.get("plan_misses", 0),
+        "plan_hit_ratio": _ratio(att.get("plan_hits", 0),
+                                 att.get("plan_misses", 0)),
+        "memo_hits": ver.get("memo_hits", 0),
+        "memo_hit_ratio": _ratio(ver.get("memo_hits", 0),
+                                 ver.get("entries", 0)),
+        "column_hits": col.get("hits", 0),
+        "column_misses": col.get("misses", 0),
+        "replayed_blocks": eng.get("replayed_blocks", 0),
+        "breaker_state": eng.get("breaker_state"),
+        "breaker_trips": eng.get("breaker_trips", 0),
+        "native_degraded": ver.get("native_degraded", 0),
+    }
+    native = p.get("native.bls", {})
+    if native.get("loaded"):
+        h2c = native["h2c"]
+        summary["h2c_hits"] = h2c["hits"]
+        summary["h2c_misses"] = h2c["misses"]
+        summary["h2c_hit_ratio"] = _ratio(h2c["hits"], h2c["misses"])
+    return summary
 
 
 def _oracle_verify_time(n_keys: int) -> float:
@@ -474,8 +544,8 @@ def bench_epoch_e2e_bls_altair(results):
 
     # min-of-two fully-cold engine passes: same scheduling-noise control
     # and per-pass parity asserts as the phase0 row
-    t_e2e, engine_stats, verify_stats = _best_cold_engine_pass(
-        spec, state, signed_blocks, spec_post)
+    t_e2e, engine_stats, verify_stats, telemetry_summary = \
+        _best_cold_engine_pass(spec, state, signed_blocks, spec_post)
     bls.bls_active = False
 
     # both aggregate shapes measured directly (the oracle is
@@ -514,6 +584,8 @@ def bench_epoch_e2e_bls_altair(results):
         "breaker_state": engine_stats["breaker_state"],
         "breaker_trips": engine_stats["breaker_trips"],
         "native_degraded": verify_stats["native_degraded"],
+        # same counter-invariant telemetry subtree as the phase0 row
+        "telemetry": telemetry_summary,
         **phases,
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
@@ -1104,8 +1176,8 @@ def bench_e2e_scale_probe(results):
     # same min-of-two fully-cold methodology + per-pass asserts as the
     # 400k rows (and the same helper), so scaling_vs_400k divides
     # like-measured quantities
-    t_e2e, engine_stats, _verify_stats = _best_cold_engine_pass(
-        spec, state, signed_blocks, spec_post)
+    t_e2e, engine_stats, _verify_stats, telemetry_summary = \
+        _best_cold_engine_pass(spec, state, signed_blocks, spec_post)
     bls.bls_active = False
 
     n400 = results.get("epoch_e2e_bls", {}).get("value")
@@ -1122,6 +1194,7 @@ def bench_e2e_scale_probe(results):
         "vs_literal_spec": round(t_spec / t_e2e, 1),
         "engine_spec_root_parity": True,
         "replay_reasons": engine_stats["replay_reasons"],
+        "telemetry": telemetry_summary,
         **phases,
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
@@ -1280,6 +1353,57 @@ def check_forkchoice_trend(current, previous, threshold: float = 0.15):
             f"{threshold * 100.0:.0f}% budget)")
 
 
+def check_counter_invariants(current, previous=None, plan_floor=0.25,
+                             memo_floor=0.25, h2c_drift=0.15):
+    """Counter-invariant half of the trend gate (ISSUE 9): the headline's
+    wall-time can hold while its *behavior* silently rots — blocks
+    replaying, the breaker open, a cache key change zeroing a hit ratio.
+    Returns a refusal message when an e2e row's embedded telemetry shows:
+
+    * any silently replayed block, an open breaker, or a degraded native
+      backend (the in-run asserts catch the headline rows; this also
+      covers rows whose asserts are weaker);
+    * the plan-cache or verified-triple hit ratio under its floor (the
+      corpus re-carries every aggregate once, so ~0.45+ is structural —
+      a floor breach means the keying broke, not the workload);
+    * the h2c hit ratio dropping more than ``h2c_drift`` absolute vs the
+      previous BENCH_DETAILS row (no absolute floor: memo dedup keeps
+      repeat messages out of the hasher, so its healthy value is
+      corpus-dependent).
+
+    None when within budget or not comparable (a pre-telemetry row, an
+    errored row, a QUICK run that skipped the row)."""
+    if not isinstance(current, dict) or "error" in current:
+        return None
+    tel = current.get("telemetry")
+    if not isinstance(tel, dict):
+        return None
+    metric = current.get("metric", "e2e row")
+    if tel.get("replayed_blocks"):
+        return (f"counter invariant: {metric} replayed "
+                f"{tel['replayed_blocks']} blocks (expected 0)")
+    if tel.get("breaker_state") not in (None, "closed"):
+        return (f"counter invariant: {metric} finished with the breaker "
+                f"{tel['breaker_state']}")
+    if tel.get("native_degraded"):
+        return f"counter invariant: {metric} ran with native BLS degraded"
+    for key, floor in (("plan_hit_ratio", plan_floor),
+                       ("memo_hit_ratio", memo_floor)):
+        ratio = tel.get(key)
+        if ratio is not None and ratio < floor:
+            return (f"counter invariant: {metric} {key} {ratio:.3f} under "
+                    f"the {floor:.2f} floor — hit-rate collapse")
+    prev_tel = previous.get("telemetry") if isinstance(previous, dict) else None
+    if isinstance(prev_tel, dict):
+        cur_h2c, prev_h2c = tel.get("h2c_hit_ratio"), prev_tel.get("h2c_hit_ratio")
+        if (cur_h2c is not None and prev_h2c is not None
+                and prev_h2c - cur_h2c > h2c_drift):
+            return (f"counter invariant: {metric} h2c_hit_ratio fell "
+                    f"{prev_h2c:.3f} -> {cur_h2c:.3f} "
+                    f"(> {h2c_drift:.2f} absolute drift)")
+    return None
+
+
 def main():
     device_fallback = _ensure_live_jax()
     if os.environ.get("CSTPU_FAULTS"):
@@ -1428,6 +1552,11 @@ def main():
                 results.get("forkchoice_batch_ingest"),
                 prev_details.get("forkchoice_batch_ingest"))
             regressions.append(fc_regression)
+            # counter invariants (ISSUE 9): behavioral drift in the e2e
+            # rows' embedded telemetry refuses the headline like a slowdown
+            for row_key in ("epoch_e2e_bls", "epoch_e2e_bls_altair"):
+                regressions.append(check_counter_invariants(
+                    results.get(row_key), prev_details.get(row_key)))
         regressions = [r for r in regressions if r]
         if regressions:
             fc_row = results.get("forkchoice_batch_ingest")

@@ -36,12 +36,45 @@ from __future__ import annotations
 
 import numpy as np
 
-from consensus_specs_tpu import faults, tracing
+from consensus_specs_tpu import faults, telemetry, tracing
+from consensus_specs_tpu.telemetry import recorder
 
 from . import batch
 from .proto_array import ProtoArray
 
 _ZERO32 = b"\x00" * 32
+
+# handler/cache activity counters (ISSUE 9): the engine's health was
+# previously visible only through tracing spans; these feed the telemetry
+# bus so a soak run can watch ingest volume, head-cache effectiveness,
+# and prune/refresh cadence over time
+stats = {
+    "on_block": 0,
+    "on_tick": 0,
+    "on_attestations": 0,
+    "attestations_ingested": 0,
+    "on_attester_slashing": 0,
+    "head_cache_hits": 0,
+    "head_recomputes": 0,
+    "prunes": 0,
+    "justified_refreshes": 0,
+}
+
+
+def reset_stats() -> None:
+    """Zero the handler counters (they are module-wide like the stf
+    engine's — one process may run several engines, the counters read as
+    node-level activity)."""
+    for k in stats:
+        stats[k] = 0
+
+
+def _telemetry_provider() -> dict:
+    return dict(stats)
+
+
+telemetry.register_provider("forkchoice.engine", _telemetry_provider,
+                            replace=True)
 
 # fault probes (tests/chaos/): each fires BEFORE its handler's first
 # mutation, so an injected failure leaves the wrapped store and the
@@ -125,9 +158,13 @@ class ForkChoiceEngine:
         if jc != self._justified_seen:
             # seen-marker moves only after the refresh succeeds: a failure
             # mid-refresh must retry on the next handler call, not leave
-            # stale balances behind a marker that says they're fresh
+            # stale balances behind a marker that says they're fresh.
+            # Counter + event move with it — a failed refresh must not be
+            # logged as if it happened (same placement as the prune below)
             self._refresh_justified()
             self._justified_seen = jc
+            stats["justified_refreshes"] += 1
+            recorder.record("fc_justified_refresh", epoch=jc[0])
         fc = _cp(self.store.finalized_checkpoint)
         if fc != self._finalized_seen:
             with tracing.span("forkchoice/prune"):
@@ -137,10 +174,13 @@ class ForkChoiceEngine:
                 _SITE_PRUNE()
                 self.proto.prune(self.store.finalized_checkpoint.root)
             self._finalized_seen = fc
+            stats["prunes"] += 1
+            recorder.record("fc_prune", epoch=fc[0])
 
     # -- handlers ------------------------------------------------------------
 
     def on_tick(self, time) -> None:
+        stats["on_tick"] += 1
         with tracing.span("forkchoice/on_tick"):
             try:
                 self.spec.on_tick(self.store, time)
@@ -151,6 +191,10 @@ class ForkChoiceEngine:
                 self._head = None
 
     def on_block(self, signed_block) -> None:
+        stats["on_block"] += 1
+        if recorder.enabled():
+            recorder.record("fc_on_block",
+                            slot=int(signed_block.message.slot))
         with tracing.span("forkchoice/on_block"):
             _SITE_ON_BLOCK()  # pre-mutation: a fault leaves store + proto as-is
             try:
@@ -167,6 +211,8 @@ class ForkChoiceEngine:
         the proto-array weight update commit together in a region with no
         failure modes — a fault anywhere up to the commit leaves no
         partially-applied vote deltas."""
+        stats["on_attestations"] += 1
+        stats["attestations_ingested"] += len(attestations)
         with tracing.span("forkchoice/on_attestations"):
             try:
                 staged = batch.ingest_attestations(
@@ -190,6 +236,7 @@ class ForkChoiceEngine:
         self.on_attestations([attestation], is_from_block=is_from_block)
 
     def on_attester_slashing(self, attester_slashing) -> None:
+        stats["on_attester_slashing"] += 1
         with tracing.span("forkchoice/on_attester_slashing"):
             try:
                 self.spec.on_attester_slashing(self.store, attester_slashing)
@@ -208,7 +255,9 @@ class ForkChoiceEngine:
 
     def get_head(self):
         if self._head is not None:
+            stats["head_cache_hits"] += 1
             return self._head
+        stats["head_recomputes"] += 1
         with tracing.span("forkchoice/find_head"):
             store = self.store
             boost_root = bytes(store.proposer_boost_root)

@@ -29,7 +29,7 @@ from typing import List, NamedTuple
 
 import numpy as np
 
-from consensus_specs_tpu import faults
+from consensus_specs_tpu import faults, telemetry
 from consensus_specs_tpu.ops.segment import segment_sum
 from consensus_specs_tpu.ops.shuffle import committee_bounds, compute_shuffle_permutation
 from consensus_specs_tpu.ssz import bulk
@@ -52,6 +52,19 @@ class FastPathViolation(Exception):
 _SITE_RESOLVE = faults.site("stf.attestations.resolve")
 _SITE_AFFINE_ROWS = faults.site("stf.attestations.affine_rows")
 _SITE_PLAN_MEMO = faults.site("stf.attestations.plan_memo")
+
+# plan-cache effectiveness counters (ISSUE 9): the e2e speed story leans
+# on re-carried aggregates hitting the plan memo, so the hit/miss split
+# is first-class telemetry — bench embeds the ratio and the trend gate
+# refuses a run whose ratio silently collapsed
+stats = {"plan_hits": 0, "plan_misses": 0}
+
+
+def reset_stats() -> None:
+    """Zero the plan-cache counters (``reset_caches`` calls this too, so
+    a cold-start-controlled bench pass reports its own ratio)."""
+    for k in stats:
+        stats[k] = 0
 
 
 # -- per-epoch committee geometry --------------------------------------------
@@ -246,6 +259,7 @@ def reset_caches() -> None:
     _PLAN_CACHE.clear()
     _PLAN_CTX_LOOKUP.clear()
     _AFFINE_MATRIX_CACHE._store.clear()
+    reset_stats()
     sync.reset_caches()
     columns.reset_caches()
     try:
@@ -399,6 +413,8 @@ class _BlockResolver:
                 cold.append((i, att, plan_key, target_epoch))
             else:
                 plans[i] = plan
+        stats["plan_hits"] += len(attestations) - len(cold)
+        stats["plan_misses"] += len(cold)
         if cold:
             self._resolve_cold(cold, plans)
         return plans
@@ -447,3 +463,28 @@ class _BlockResolver:
             plan = AttestationPlan(attesters, plan_key[1], target_epoch)
             plans[i] = plan
             _fifo_put(_PLAN_CACHE, plan_key, plan, cap=_PLAN_CACHE_MAX)
+
+
+# -- telemetry ----------------------------------------------------------------
+
+
+def _telemetry_provider() -> dict:
+    """Plan-cache effectiveness + the sizes of every geometry memo this
+    module owns (all FIFO-bounded; the soak harness asserts the sizes
+    never exceed the caps)."""
+    return {
+        "plan_hits": stats["plan_hits"],
+        "plan_misses": stats["plan_misses"],
+        "plan_size": len(_PLAN_CACHE),
+        "plan_cap": _PLAN_CACHE_MAX,
+        "ctx_size": len(_CTX_CACHE),
+        "ctx_lookup_size": len(_CTX_LOOKUP),
+        "plan_ctx_lookup_size": len(_PLAN_CTX_LOOKUP),
+        "active_size": len(_ACTIVE_CACHE),
+        "proposer_size": len(_PROPOSER_CACHE),
+        "geometry_cap": _CACHE_MAX,
+    }
+
+
+telemetry.register_provider("stf.plan_cache", _telemetry_provider,
+                            replace=True)

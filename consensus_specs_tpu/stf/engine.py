@@ -30,9 +30,11 @@ tests/spec/phase0/sanity/test_stf_engine_differential.py).
 from __future__ import annotations
 
 import hashlib
+import sys
 import time
 
-from consensus_specs_tpu import faults, tracing
+from consensus_specs_tpu import faults, telemetry, tracing
+from consensus_specs_tpu.telemetry import recorder
 
 from . import columns, slot_roots, staging, sync, verify
 from .attestations import (
@@ -149,6 +151,7 @@ def _breaker_note_success() -> None:
         _breaker["since_skipped"] = 0
         stats["breaker_state"] = "closed"
         tracing.count("stf.breaker_closed")
+        recorder.record("breaker_close")
 
 
 def _breaker_note_error() -> None:
@@ -156,6 +159,7 @@ def _breaker_note_error() -> None:
     if _breaker["open"]:
         # a failed recovery probe: stay open, restart the skip countdown
         _breaker["since_skipped"] = 0
+        recorder.record("breaker_probe_failed")
         return
     if _breaker["consecutive_errors"] >= BREAKER_THRESHOLD:
         _breaker["open"] = True
@@ -163,6 +167,8 @@ def _breaker_note_error() -> None:
         stats["breaker_trips"] += 1
         stats["breaker_state"] = "open"
         tracing.count("stf.breaker_tripped")
+        recorder.record("breaker_open",
+                        consecutive_errors=_breaker["consecutive_errors"])
 
 
 def _breaker_allows_attempt() -> bool:
@@ -173,6 +179,7 @@ def _breaker_allows_attempt() -> bool:
     if _breaker["since_skipped"] % BREAKER_PROBE_INTERVAL == 0:
         stats["breaker_probes"] += 1
         tracing.count("stf.breaker_probe")
+        recorder.record("breaker_probe")
         return True
     return False
 
@@ -188,14 +195,22 @@ def apply_signed_blocks(spec, state, signed_blocks, validate_result: bool = True
 
 
 def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
+    # flight-recorder gate hoisted once per block: the per-event field
+    # computation (slot reads, stats deltas) is paid only while recording
+    rec = recorder.enabled()
     if not _breaker_allows_attempt():
         stats["replayed_blocks"] += 1
         stats["breaker_skipped"] += 1
         _count_reason("breaker_open")
         tracing.count("stf.replayed_block")
+        if rec:
+            recorder.record("block_replayed",
+                            slot=int(signed_block.message.slot),
+                            reason="breaker_open")
         spec.state_transition(state, signed_block, validate_result)
         return
     pre_backing = state.get_backing()
+    snap = _block_snapshot() if rec else None
     try:
         if not _fast_path_ready(spec):
             # uncovered forks keep their own kernel substitutions + the
@@ -210,6 +225,12 @@ def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
         stats["fast_blocks"] += 1
         _breaker_note_success()
         tracing.count("stf.fast_block")
+        if rec:
+            # after the transaction settled (OB01 discipline: a rolled
+            # back block must never log a fast application)
+            recorder.record("block_fast",
+                            slot=int(signed_block.message.slot),
+                            **_block_delta(snap))
     except Exception as exc:
         if not isinstance(exc, FastPathViolation):
             stats["fast_path_errors"] += 1
@@ -217,8 +238,62 @@ def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
         _count_reason(type(exc).__name__)
         stats["replayed_blocks"] += 1
         tracing.count("stf.replayed_block")
+        if rec:
+            recorder.record("block_replayed",
+                            slot=int(signed_block.message.slot),
+                            reason=type(exc).__name__,
+                            detail=str(exc)[:160])
         state.set_backing(pre_backing)
         spec.state_transition(state, signed_block, validate_result)
+
+
+# phase attribution captured per block by the flight recorder (deltas of
+# the cumulative stats above, plus the plan/h2c cache movement)
+_PHASE_KEYS = ("slot_roots_s", "sig_verify_s", "attestation_apply_s",
+               "sync_apply_s", "other_s")
+
+
+def _h2c_stats():
+    """The native hash_to_g2 cache counters, via sys.modules so a block
+    applied without the native backend never imports it as a side effect."""
+    native = sys.modules.get("consensus_specs_tpu.crypto.bls.native")
+    if native is None:
+        return None
+    try:
+        return native.h2c_cache_stats()
+    except Exception:  # counter read must never fail a block
+        return None
+
+
+def _block_snapshot() -> dict:
+    """Pre-block counter snapshot (recorder-enabled path only)."""
+    from . import attestations
+
+    snap = {k: stats[k] for k in _PHASE_KEYS}
+    snap["plan_hits"] = attestations.stats["plan_hits"]
+    snap["plan_misses"] = attestations.stats["plan_misses"]
+    h2c = _h2c_stats()
+    if h2c is not None:
+        snap["h2c_hits"] = h2c["hits"]
+        snap["h2c_misses"] = h2c["misses"]
+    return snap
+
+
+def _block_delta(snap: dict) -> dict:
+    """This block's phase timings and cache movement, as deltas of the
+    cumulative counters against the pre-block snapshot."""
+    from . import attestations
+
+    out = {k: round(stats[k] - snap[k], 6) for k in _PHASE_KEYS}
+    out["plan_hits"] = attestations.stats["plan_hits"] - snap["plan_hits"]
+    out["plan_misses"] = (attestations.stats["plan_misses"]
+                          - snap["plan_misses"])
+    if "h2c_hits" in snap:
+        h2c = _h2c_stats()
+        if h2c is not None:
+            out["h2c_hits"] = h2c["hits"] - snap["h2c_hits"]
+            out["h2c_misses"] = h2c["misses"] - snap["h2c_misses"]
+    return out
 
 
 def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
@@ -609,3 +684,20 @@ def _attestations_inner_altair(spec, state, attestations, collect, bls_on) -> No
         state.balances[proposer_index] = spec.Gwei(
             int(state.balances[proposer_index]) + proposer_reward_total)
     stats["mirror_flush_s"] += time.perf_counter() - t_apply
+
+
+# -- telemetry ----------------------------------------------------------------
+
+
+def _telemetry_provider() -> dict:
+    """The engine's cumulative counters + the breaker's live internals
+    (consecutive-error count and skip countdown — the two numbers that
+    predict the NEXT transition, which the state string alone hides)."""
+    return {
+        **{k: v for k, v in stats.items() if k != "replay_reasons"},
+        "replay_reasons": dict(stats["replay_reasons"]),
+        "breaker": dict(_breaker),
+    }
+
+
+telemetry.register_provider("stf.engine", _telemetry_provider, replace=True)

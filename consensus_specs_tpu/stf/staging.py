@@ -31,6 +31,8 @@ from __future__ import annotations
 import contextlib
 from typing import Optional
 
+from consensus_specs_tpu.telemetry import recorder
+
 _TXN: Optional["CacheTransaction"] = None
 
 
@@ -54,6 +56,7 @@ class CacheTransaction:
         """Apply deferred commits; on any failure mid-commit, undo the
         block's visible inserts too and re-raise (already-applied deferred
         entries are content-addressed facts — safe to keep)."""
+        n_deferred, n_visible = len(self._deferred), len(self._undo)
         try:
             while self._deferred:
                 fn, args = self._deferred.pop(0)
@@ -62,15 +65,22 @@ class CacheTransaction:
             self.rollback()
             raise
         self._undo.clear()
+        # the event fires only after every deferred commit landed — a
+        # torn commit takes the rollback branch and logs honestly
+        recorder.record("cache_commit", deferred=n_deferred,
+                        visible=n_visible)
 
     def rollback(self) -> None:
         """Pop every visible insert this block made (newest first) and
         drop the deferred queue: the memos read as if the block never
         ran.  Removal-only, so concurrent FIFO evictions stay safe."""
+        n_undo, n_deferred = len(self._undo), len(self._deferred)
         while self._undo:
             cache, key = self._undo.pop()
             cache.pop(key, None)
         self._deferred.clear()
+        recorder.record("cache_rollback", undone=n_undo,
+                        deferred_dropped=n_deferred)
 
 
 def current() -> Optional[CacheTransaction]:

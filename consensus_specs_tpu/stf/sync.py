@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from consensus_specs_tpu import faults, tracing
+from consensus_specs_tpu import faults, telemetry, tracing
 
 from .attestations import (
     FastPathViolation,
@@ -90,6 +90,13 @@ def reset_caches() -> None:
     """Drop the seat-resolution memo (bench cold-start control and test
     isolation)."""
     _SYNC_ROWS_CACHE.clear()
+
+
+def _telemetry_provider() -> dict:
+    return {"rows_memo_size": len(_SYNC_ROWS_CACHE), "cap": _CACHE_MAX}
+
+
+telemetry.register_provider("stf.sync", _telemetry_provider, replace=True)
 
 
 # -- process_sync_aggregate, engine shape -------------------------------------

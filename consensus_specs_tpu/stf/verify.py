@@ -29,7 +29,8 @@ from __future__ import annotations
 import hashlib
 from typing import List, Optional, Sequence, Tuple
 
-from consensus_specs_tpu import faults, tracing
+from consensus_specs_tpu import faults, telemetry, tracing
+from consensus_specs_tpu.telemetry import recorder
 
 from . import staging
 
@@ -110,6 +111,7 @@ def _degrade(exc: BaseException) -> None:
     _NATIVE_DEGRADED = True
     stats["native_degraded"] = 1
     tracing.count("stf.native_degraded")
+    recorder.record("native_degraded", error=f"{type(exc).__name__}: {exc}"[:200])
     if not _DEGRADED_WARNED:
         _DEGRADED_WARNED = True
         import warnings
@@ -264,3 +266,13 @@ def reset_memo() -> None:
     so staleness is impossible, but deterministic timing runs want a cold
     start)."""
     _VERIFIED_MEMO.clear()
+
+
+def _telemetry_provider() -> dict:
+    """Settlement counters + the memo's live fill (the stats dict already
+    carries the cap; size rides alongside so the soak harness can assert
+    the bound holds)."""
+    return {**stats, "memo_size": len(_VERIFIED_MEMO)}
+
+
+telemetry.register_provider("stf.verify", _telemetry_provider, replace=True)

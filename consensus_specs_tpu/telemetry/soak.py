@@ -1,0 +1,342 @@
+"""Soak-endurance harness (ISSUE 9 tentpole, layer 3; ROADMAP item 5).
+
+The chaos suite proves containment per fault; the benches prove speed per
+run.  Neither watches the system *over time* — a breaker that recovers in
+a 10-block test can still wedge open across epochs, a bounded cache can
+still creep, and a regression between headline benches is invisible.
+The soak run closes that gap: a long seeded random block/attestation
+walk, epochs alternately faulted (seeded ``FaultPlan`` schedules over the
+stf seams, error + corrupt kinds — crashes are chaos-suite territory:
+native degradation is one-way by design and would fail the recovery
+claim vacuously) and clean, with four endurance assertions:
+
+* **breaker recovery** — the first faulted epoch deterministically trips
+  the breaker (three consecutive injected errors); by the end of the
+  walk's trailing clean epochs the breaker must be CLOSED again, through
+  its own probe machinery (never re-armed by the harness);
+* **root parity throughout** — every block's post-state root matches the
+  literal spec replay, faults or no faults;
+* **cache coherence** — a fault-free re-run of the whole walk over the
+  SAME process-global caches takes the fast path on every block
+  (``replayed_blocks == 0``): no fault in any epoch stranded a poisoned
+  entry;
+* **memory flatness** — after every epoch, each bounded cache/ring
+  (attestation plans, geometry memos, verified triples, resident
+  columns, sync seats, the flight-recorder ring) is sampled off the
+  telemetry bus and must sit at or under its registered cap.
+
+The run emits ``SOAK.json``: profile, per-epoch cache samples, the
+engine/verify counters, the full telemetry snapshot, and the flight
+recorder's last-N timeline — the artifact IS the post-mortem when an
+assertion trips (written before the failure is raised).
+
+Profiles: ``bounded`` (~2 min on the 1 vCPU host: phase0 + altair, 32
+epochs each — long enough for finality to advance, FIFO memos to rotate,
+and the plan cache to shed old epochs) is the ``make soak`` default;
+``deep`` (96 epochs each) is the slow endurance tier (``make
+soak-deep``).  An ambient
+``CSTPU_FAULTS`` schedule stays armed during the walk's clean epochs
+(extra chaos, same assertions) but is masked during the verification
+re-run, which must be genuinely fault-free to prove coherence.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+PROFILES = {
+    # ring_cap sizes the flight recorder to hold the WHOLE walk (still a
+    # bound — flatness is asserted against it like every other cap); the
+    # default 512-event ring is tuned for serving, not endurance reports
+    "bounded": {"forks": ("phase0", "altair"), "epochs": 32,
+                "ring_cap": 4096},
+    "deep": {"forks": ("phase0", "altair"), "epochs": 96,
+             "ring_cap": 16384},
+}
+
+# the seams soak schedules draw from: every stf site the chaos suite
+# already proves containment for, minus nothing — kinds are restricted
+# instead (error/corrupt only, see module docstring)
+_SOAK_KINDS = ("error", "corrupt")
+
+
+class SoakFailure(AssertionError):
+    """An endurance assertion failed; SOAK.json carries the post-mortem."""
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _stf_sites() -> List[str]:
+    from consensus_specs_tpu import faults, stf  # noqa: F401  (registers sites)
+
+    return sorted(n for n in faults.registry() if n.startswith("stf."))
+
+
+def _build_corpus(fork: str, epochs: int):
+    """(spec, pre_state, signed_blocks, per-block literal roots) for an
+    ``epochs``-long full-block walk (the chaos corpus pattern, longer)."""
+    from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+    from consensus_specs_tpu.testing.helpers.attestations import (
+        next_slots_with_attestations,
+    )
+    from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+    out = {}
+
+    @with_phases([fork])
+    @spec_state_test
+    def build(spec, state):
+        next_epoch(spec, state)
+        pre = state.copy()
+        _, signed, _ = next_slots_with_attestations(
+            spec, state.copy(), epochs * int(spec.SLOTS_PER_EPOCH),
+            True, True)
+        s = pre.copy()
+        roots = []
+        for sb in signed:
+            spec.state_transition(s, sb, True)
+            roots.append(bytes(s.hash_tree_root()))
+        out["corpus"] = (spec, pre, signed, roots)
+        yield None
+
+    build(phase=fork)  # DEFAULT_BLS_ACTIVE: signatures are real
+    return out["corpus"]
+
+
+def bounded_cache_sizes() -> List[dict]:
+    """(name, size, cap) of every bounded structure the telemetry bus
+    reports — the memory-flatness sample."""
+    from . import snapshot
+
+    providers = snapshot()["providers"]
+    plan = providers.get("stf.plan_cache", {})
+    verify = providers.get("stf.verify", {})
+    columns = providers.get("stf.columns", {})
+    sync = providers.get("stf.sync", {})
+    ring = providers.get("flight_recorder", {})
+    samples = [
+        {"name": "stf.plan_cache.plan", "size": plan.get("plan_size", 0),
+         "cap": plan.get("plan_cap", 0)},
+        {"name": "stf.verify.memo", "size": verify.get("memo_size", 0),
+         "cap": verify.get("memo_cap", 0)},
+        {"name": "stf.columns.store", "size": columns.get("size", 0),
+         "cap": columns.get("cap", 0)},
+        {"name": "stf.sync.rows_memo",
+         "size": sync.get("rows_memo_size", 0), "cap": sync.get("cap", 0)},
+        {"name": "flight_recorder.ring", "size": ring.get("events", 0),
+         "cap": ring.get("cap", 0)},
+    ]
+    for key in ("ctx_size", "ctx_lookup_size", "plan_ctx_lookup_size",
+                "active_size", "proposer_size"):
+        samples.append({"name": f"stf.plan_cache.{key[:-5]}",
+                        "size": plan.get(key, 0),
+                        "cap": plan.get("geometry_cap", 0)})
+    return samples
+
+
+def _epoch_plan(epoch_index: int, seed: int, sites: List[str],
+                breaker_trip: bool):
+    """The fault schedule of one faulted epoch: a deterministic breaker
+    trip (three consecutive early errors) on the first, seeded random
+    error/corrupt draws on the rest."""
+    from consensus_specs_tpu import faults
+
+    if breaker_trip:
+        trip = [faults.Fault("stf.engine.operations", nth=n)
+                for n in (1, 2, 3)]
+        extra = faults.FaultPlan.seeded(
+            seed + epoch_index, sites, n_faults=2, max_nth=6,
+            kinds=_SOAK_KINDS).faults()
+        return faults.FaultPlan(trip + extra)
+    return faults.FaultPlan.seeded(
+        seed + epoch_index, sites, n_faults=3, max_nth=8,
+        kinds=_SOAK_KINDS)
+
+
+def _fresh_engine_env() -> None:
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.stf import attestations as stf_attestations
+    from consensus_specs_tpu.stf import verify as stf_verify
+
+    stf.reset_stats()
+    stf_verify.reset_memo()
+    stf_verify.reset_degraded()
+    stf_attestations.reset_caches()
+
+
+def _soak_fork(fork: str, epochs: int, seed: int, report: dict) -> dict:
+    """One fork's endurance walk; returns the fork's report section and
+    raises ``SoakFailure`` (after dumping) on any broken assertion."""
+    from consensus_specs_tpu import faults, stf
+
+    spec, pre, blocks, roots = _build_corpus(fork, epochs)
+    sites = _stf_sites()
+    spe = int(spec.SLOTS_PER_EPOCH)
+    epoch_chunks = [blocks[i:i + spe] for i in range(0, len(blocks), spe)]
+    # faulted prefix, clean tail: the LAST TWO epochs always run clean so
+    # the breaker has >= 2*SLOTS_PER_EPOCH blocks to probe its way closed
+    n_faulted = max(1, len(epoch_chunks) - 2)
+
+    _fresh_engine_env()
+    section: dict = {"fork": fork, "blocks": len(blocks),
+                     "epochs": len(epoch_chunks), "faulted_epochs": n_faulted,
+                     "fired": [], "cache_samples": []}
+    s = pre.copy()
+    applied = 0
+    for e, chunk in enumerate(epoch_chunks):
+        plan = (_epoch_plan(e, seed, sites, breaker_trip=(e == 0))
+                if e < n_faulted else None)
+        ctx = faults.inject(plan) if plan is not None else _ambient()
+        with ctx:
+            for sb in chunk:
+                stf.apply_signed_blocks(spec, s, [sb], True)
+                if bytes(s.hash_tree_root()) != roots[applied]:
+                    _fail(report, section,
+                          f"{fork}: root diverged from the literal replay "
+                          f"at block {applied} (epoch {e})")
+                applied += 1
+        if plan is not None:
+            section["fired"].extend(
+                [site, hit, kind] for site, hit, kind in plan.fired)
+        sample = {"epoch": e, "sizes": bounded_cache_sizes(),
+                  "breaker_state": stf.stats["breaker_state"]}
+        section["cache_samples"].append(sample)
+        for entry in sample["sizes"]:
+            if entry["cap"] and entry["size"] > entry["cap"]:
+                _fail(report, section,
+                      f"{fork}: {entry['name']} grew past its cap after "
+                      f"epoch {e}: {entry['size']} > {entry['cap']}")
+
+    section["walk_stats"] = {
+        **{k: stf.stats[k] for k in
+           ("fast_blocks", "replayed_blocks", "breaker_trips",
+            "breaker_probes", "breaker_skipped", "breaker_state")},
+        "replay_reasons": dict(stf.stats["replay_reasons"]),
+    }
+    if stf.stats["breaker_state"] != "closed":
+        _fail(report, section,
+              f"{fork}: breaker still open after the clean tail "
+              f"({stf.stats['breaker_trips']} trips, "
+              f"{stf.stats['breaker_probes']} probes)")
+    if n_faulted and not section["fired"]:
+        _fail(report, section,
+              f"{fork}: no scheduled fault ever fired — the walk "
+              "exercised nothing")
+
+    # cache coherence: fault-free re-run over the SAME caches (ambient
+    # CSTPU_FAULTS masked by an empty plan) must be all-fast.  The
+    # degraded mark is cleared the way an operator would after ambient
+    # crash chaos — the claim under test is cache state, not the ladder
+    from consensus_specs_tpu.stf import verify as stf_verify
+
+    stf.reset_stats()
+    stf_verify.reset_degraded()
+    s2 = pre.copy()
+    with faults.inject(faults.FaultPlan([])):
+        for i, sb in enumerate(blocks):
+            stf.apply_signed_blocks(spec, s2, [sb], True)
+            if bytes(s2.hash_tree_root()) != roots[i]:
+                _fail(report, section,
+                      f"{fork}: re-run root diverged at block {i}")
+    section["rerun_stats"] = {
+        "fast_blocks": stf.stats["fast_blocks"],
+        "replayed_blocks": stf.stats["replayed_blocks"],
+        "replay_reasons": dict(stf.stats["replay_reasons"]),
+    }
+    if stf.stats["replayed_blocks"] != 0:
+        _fail(report, section,
+              f"{fork}: fault-free re-run replayed "
+              f"{stf.stats['replayed_blocks']} blocks — a fault stranded "
+              f"poisoned cache state: {stf.stats['replay_reasons']}")
+    return section
+
+
+def _ambient():
+    """No-op context: the walk's clean epochs run under whatever ambient
+    plan (CSTPU_FAULTS) is armed — soak under operator chaos is a
+    supported mode."""
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def _fail(report: dict, section: dict, message: str) -> None:
+    """Dump the post-mortem (SOAK.json + flight-recorder timeline), then
+    raise — a failed soak carries its own flight data."""
+    from . import recorder
+
+    report["failure"] = message
+    _finalize(report, section)
+    _write(report)
+    recorder.disable()
+    raise SoakFailure(f"{message} (post-mortem: {report['out_path']})")
+
+
+def _finalize(report: dict, *sections: dict) -> None:
+    from . import recorder, snapshot
+
+    for section in sections:
+        if section is not None and section not in report["forks"]:
+            report["forks"].append(section)
+    report["snapshot"] = snapshot()
+    report["timeline"] = recorder.timeline()
+
+
+def _write(report: dict) -> None:
+    path = report["out_path"]
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    os.replace(tmp, path)
+
+
+def run_soak(profile: str = "bounded", seed: int = 90001,
+             out_path: Optional[str] = None) -> Dict:
+    """Run the soak-endurance walk and write the ``SOAK.json`` artifact.
+    Returns the report dict; raises ``SoakFailure`` on any broken
+    endurance assertion (the artifact is written first, either way)."""
+    from consensus_specs_tpu.crypto import bls
+
+    from . import recorder
+
+    if profile not in PROFILES:
+        raise ValueError(f"unknown soak profile {profile!r} "
+                         f"(one of {sorted(PROFILES)})")
+    cfg = PROFILES[profile]
+    out_path = out_path or os.environ.get(
+        "CSTPU_SOAK_OUT", os.path.join(_repo_root(), "SOAK.json"))
+    report: Dict = {"profile": profile, "seed": seed, "config": dict(cfg),
+                    "out_path": out_path, "forks": [], "failure": None}
+
+    bls.use_fastest()
+    prev_bls = bls.bls_active
+    bls.bls_active = True
+    was_recording = recorder.enabled()
+    prev_cap = recorder.stats()["cap"]
+    recorder.enable(cap=cfg["ring_cap"])
+    recorder.reset()
+    try:
+        for fork in cfg["forks"]:
+            report["forks"].append(
+                _soak_fork(fork, cfg["epochs"], seed, report))
+        _finalize(report)
+        _write(report)
+    finally:
+        bls.bls_active = prev_bls
+        # restore the PRE-RUN bound (an operator-configured ambient
+        # recorder must not come back shrunk to the default)
+        recorder.enable(cap=prev_cap)
+        if not was_recording:
+            recorder.disable()
+    return report
+
+
+if __name__ == "__main__":  # pragma: no cover - operator entry point
+    import sys
+
+    run_soak(profile=sys.argv[1] if len(sys.argv) > 1 else "bounded")
+    print("soak green: SOAK.json written")

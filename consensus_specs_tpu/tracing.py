@@ -1,126 +1,44 @@
-"""Tracing + metrics layer (SURVEY §5: the wall-time observability the
+"""Tracing + metrics facade (SURVEY §5: the wall-time observability the
 reference lacks; essential here because performance is a deliverable).
 
-Three cooperating pieces:
+As of ISSUE 9 the implementation lives in
+``consensus_specs_tpu.telemetry.metrics`` — this module is the stable
+legacy surface every existing callsite (and tests/test_tracing.py) keeps
+using, byte-compatible:
 
 * **Spans** — nested wall-time measurements.  ``span(name)`` is a
   context manager; ``instrument_spec(spec)`` wraps every ``process_*``
   and ``state_transition`` function of a compiled spec module so a whole
   transition self-profiles per phase.  Disabled (default) the wrapper is
-  a single attribute check.
+  a single attribute check.  New underneath: mutation is lock-guarded
+  (the native pool / ``parallel/`` paths increment concurrently), span
+  nesting is per-thread, and ``instrument_spec`` is re-entrant after
+  spec rebuilds (identity-marked wrappers, not copyable flags).
 * **Counters** — monotonically increasing named counters
   (``count(name)``), e.g. BLS verifications, cache hits.
 * **XLA profiler** — ``xla_trace(dir)`` wraps ``jax.profiler.trace`` for
   device-level traces viewable in TensorBoard/XProf.
 
-Snapshot everything with ``report()``; reset with ``reset()``.
+Snapshot everything with ``report()``; reset with ``reset()``.  The
+report also rides the telemetry bus as the ``"tracing"`` provider
+(``telemetry.snapshot()``), next to every other stats producer.
 """
 from __future__ import annotations
 
-import contextlib
-import time
-from collections import defaultdict
-from typing import Dict
+from consensus_specs_tpu.telemetry.metrics import (  # noqa: F401
+    _INSTRUMENT_PREFIXES,
+    count,
+    disable,
+    enable,
+    enabled,
+    instrument_spec,
+    report,
+    reset,
+    span,
+    xla_trace,
+)
 
-_enabled = False
-_spans: Dict[str, list] = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
-_counters: Dict[str, int] = defaultdict(int)
-_stack: list = []
-
-
-def enable() -> None:
-    global _enabled
-    _enabled = True
-
-
-def disable() -> None:
-    global _enabled
-    _enabled = False
-
-
-def reset() -> None:
-    _spans.clear()
-    _counters.clear()
-    _stack.clear()
-
-
-def enabled() -> bool:
-    return _enabled
-
-
-@contextlib.contextmanager
-def span(name: str):
-    """Nested wall-time span; keys are '/'-joined paths."""
-    if not _enabled:
-        yield
-        return
-    _stack.append(name)
-    key = "/".join(_stack)
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        rec = _spans[key]
-        rec[0] += 1
-        rec[1] += dt
-        _stack.pop()
-
-
-def count(name: str, n: int = 1) -> None:
-    if _enabled:
-        _counters[name] += n
-
-
-def report() -> dict:
-    """{'spans': {path: {'count', 'total_s'}}, 'counters': {...}}"""
-    return {
-        "spans": {
-            k: {"count": v[0], "total_s": round(v[1], 6)}
-            for k, v in sorted(_spans.items())
-        },
-        "counters": dict(sorted(_counters.items())),
-    }
-
-
-@contextlib.contextmanager
-def xla_trace(log_dir: str):
-    """Device-level XLA profiler trace (TensorBoard/XProf format)."""
-    import jax
-
-    with jax.profiler.trace(log_dir):
-        yield
-
-
-# --- spec instrumentation ----------------------------------------------------
-
-_INSTRUMENT_PREFIXES = ("process_", "state_transition", "verify_block_signature")
-
-
-def _wrap(name: str, fn):
-    def traced(*args, **kw):
-        if not _enabled:
-            return fn(*args, **kw)
-        with span(name):
-            return fn(*args, **kw)
-
-    traced.__name__ = getattr(fn, "__name__", name)
-    traced.__wrapped__ = fn
-    return traced
-
-
-def instrument_spec(spec, prefixes=_INSTRUMENT_PREFIXES) -> int:
-    """Wrap a compiled spec module's transition functions with spans.
-    Idempotent; returns the number of functions (newly) instrumented."""
-    g = spec.__dict__
-    n = 0
-    for name, fn in list(g.items()):
-        if not callable(fn) or not name.startswith(tuple(prefixes)):
-            continue
-        if getattr(fn, "_tracing_instrumented", False):
-            continue
-        wrapped = _wrap(name, fn)
-        wrapped._tracing_instrumented = True
-        g[name] = wrapped
-        n += 1
-    return n
+__all__ = [
+    "count", "disable", "enable", "enabled", "instrument_spec", "report",
+    "reset", "span", "xla_trace",
+]

@@ -1,0 +1,84 @@
+"""OB01 flight-recorder discipline: the ring is written only through
+``telemetry.record``, and commit-class events in fault-probed modules
+are never recorded inside a still-open block transaction (a rolled-back
+block must not log a commit that never happened)."""
+from analysis import analyze_text
+
+
+def ob01(path, src):
+    return [f for f in analyze_text(path, src) if f.code == "OB01"]
+
+
+_HEADER = ("from consensus_specs_tpu import faults, telemetry\n"
+           "from consensus_specs_tpu.stf import staging\n"
+           "from consensus_specs_tpu.telemetry import recorder\n"
+           "_SITE = faults.site('stf.x.probe')\n")
+
+
+def test_ob01_flags_direct_ring_append():
+    src = _HEADER + ("def leak(event):\n"
+                     "    recorder._EVENTS.append(event)\n")
+    found = ob01("consensus_specs_tpu/stf/x.py", src)
+    assert [f.line for f in found] == [6]
+    assert "telemetry.record" in found[0].message
+
+
+def test_ob01_ring_reads_and_invalidations_are_legal():
+    src = _HEADER + ("def peek():\n"
+                     "    recorder._EVENTS.clear()\n"
+                     "    return list(recorder._EVENTS)\n")
+    assert ob01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ob01_flags_commit_event_inside_open_transaction():
+    src = _HEADER + ("def apply_one(spec, state, sb):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "        telemetry.record('cache_commit', n=1)\n")
+    found = ob01("consensus_specs_tpu/stf/x.py", src)
+    assert [f.line for f in found] == [8]
+    assert "never happened" in found[0].message
+
+
+def test_ob01_commit_event_after_the_with_block_is_clean():
+    src = _HEADER + ("def apply_one(spec, state, sb):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "    telemetry.record('block_fast', slot=1)\n")
+    assert ob01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ob01_noncommit_events_inside_transaction_are_legal():
+    # progress/diagnostic events may fire mid-block: only commit-class
+    # kinds assert settlement
+    src = _HEADER + ("def apply_one(spec, state, sb):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "        telemetry.record('phase_start', phase='ops')\n")
+    assert ob01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ob01_uninstrumented_modules_skip_the_transaction_check():
+    src = ("from consensus_specs_tpu import telemetry\n"
+           "from consensus_specs_tpu.stf import staging\n"
+           "def apply_one():\n"
+           "    with staging.block_transaction():\n"
+           "        telemetry.record('cache_commit')\n")
+    assert ob01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ob01_recorder_module_itself_is_exempt():
+    src = ("import collections\n"
+           "_EVENTS = collections.deque(maxlen=4)\n"
+           "def record(kind):\n"
+           "    _EVENTS.append({'kind': kind})\n")
+    assert ob01("consensus_specs_tpu/telemetry/recorder.py", src) == []
+
+
+def test_ob01_record_via_recorder_module_alias_is_also_judged():
+    src = _HEADER + ("def apply_one(spec, state, sb):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "        recorder.record('memo_commit')\n")
+    found = ob01("consensus_specs_tpu/stf/x.py", src)
+    assert [f.line for f in found] == [8]

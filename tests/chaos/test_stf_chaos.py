@@ -37,6 +37,7 @@ import pytest
 
 from consensus_specs_tpu import faults, stf
 from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.telemetry import recorder
 from consensus_specs_tpu.stf import attestations as stf_attestations
 from consensus_specs_tpu.stf import engine as stf_engine
 from consensus_specs_tpu.stf import verify as stf_verify
@@ -309,13 +310,22 @@ def test_chaos_exception_parity(tamper, fault):
 def test_breaker_demote_probe_recover(monkeypatch):
     """Three consecutive injected fast-path errors trip the breaker; the
     next blocks replay literally WITHOUT attempting the fast path; the
-    probe block re-attempts, succeeds, and closes the breaker."""
+    probe block re-attempts, succeeds, and closes the breaker.  The
+    flight-recorder dump of the same walk (ISSUE 9) must carry the
+    post-mortem: the replay events NAME the injected fault site, and the
+    breaker transitions appear in demote -> probe -> recover order."""
     monkeypatch.setattr(stf_engine, "BREAKER_PROBE_INTERVAL", 3)
     spec, pre, blocks, roots = _corpus("phase0")
     _fresh_engine_env()
-    plan = faults.FaultPlan(
-        [F("stf.engine.operations", nth=n) for n in (1, 2, 3)])
-    _engine_replay(spec, pre, blocks, roots, plan)
+    recorder.reset()
+    recorder.enable()
+    try:
+        plan = faults.FaultPlan(
+            [F("stf.engine.operations", nth=n) for n in (1, 2, 3)])
+        _engine_replay(spec, pre, blocks, roots, plan)
+        dumped = recorder.dump("chaos: breaker demote/probe/recover")
+    finally:
+        recorder.disable()
     st = stf.stats
     assert st["breaker_trips"] == 1
     assert st["breaker_state"] == "closed"  # recovered by the probe
@@ -326,16 +336,47 @@ def test_breaker_demote_probe_recover(monkeypatch):
     assert st["replayed_blocks"] == 5
     assert st["replay_reasons"] == {"InjectedFault": 3, "breaker_open": 2}
 
+    events = dumped["events"]
+    # the timeline names the injected fault site on every faulted block
+    injected = [e for e in events if e["kind"] == "block_replayed"
+                and e["reason"] == "InjectedFault"]
+    assert len(injected) == 3
+    assert all("stf.engine.operations" in e["detail"] for e in injected)
+    # breaker transition sequence, in order: demote -> probe -> recover
+    transitions = [e["kind"] for e in events
+                   if e["kind"].startswith("breaker_")]
+    assert transitions == ["breaker_open", "breaker_probe", "breaker_close"]
+    # the skipped blocks sit between the open and the probe
+    i_open = next(i for i, e in enumerate(events)
+                  if e["kind"] == "breaker_open")
+    i_probe = next(i for i, e in enumerate(events)
+                   if e["kind"] == "breaker_probe")
+    skipped = [e for e in events[i_open:i_probe]
+               if e["kind"] == "block_replayed"
+               and e["reason"] == "breaker_open"]
+    assert len(skipped) == 2
+    # the dump is a full post-mortem: snapshot riding along
+    assert dumped["snapshot"]["providers"]["stf.engine"]["breaker_trips"] == 1
+
 
 def test_breaker_failed_probe_stays_open(monkeypatch):
     """A probe that fails keeps the breaker open and restarts the skip
-    countdown; the following probe recovers."""
+    countdown; the following probe recovers.  The flight recorder's
+    transition sequence (ISSUE 9) must show the failed probe between the
+    demote and the recovery, with the failing probe block naming the
+    injected site."""
     monkeypatch.setattr(stf_engine, "BREAKER_PROBE_INTERVAL", 3)
     spec, pre, blocks, roots = _corpus("phase0")
     _fresh_engine_env()
-    plan = faults.FaultPlan(
-        [F("stf.engine.operations", nth=n) for n in (1, 2, 3, 4)])
-    _engine_replay(spec, pre, blocks, roots, plan)
+    recorder.reset()
+    recorder.enable()
+    try:
+        plan = faults.FaultPlan(
+            [F("stf.engine.operations", nth=n) for n in (1, 2, 3, 4)])
+        _engine_replay(spec, pre, blocks, roots, plan)
+        dumped = recorder.dump("chaos: failed probe stays open")
+    finally:
+        recorder.disable()
     st = stf.stats
     # blocks 1-3 error, 4-5 skip, 6 probes and errors (hit 4), 7-8 skip,
     # 9 probes clean, 10 fast
@@ -345,6 +386,20 @@ def test_breaker_failed_probe_stays_open(monkeypatch):
     assert st["fast_path_errors"] == 4
     assert st["breaker_state"] == "closed"
     assert st["fast_blocks"] == 2
+
+    events = dumped["events"]
+    transitions = [e["kind"] for e in events
+                   if e["kind"].startswith("breaker_")]
+    assert transitions == ["breaker_open", "breaker_probe",
+                           "breaker_probe_failed", "breaker_probe",
+                           "breaker_close"]
+    # the failed probe's replay event names the injected site (hit 4)
+    i_failed = next(i for i, e in enumerate(events)
+                    if e["kind"] == "breaker_probe_failed")
+    failed_replay = next(e for e in events[i_failed:]
+                         if e["kind"] == "block_replayed")
+    assert failed_replay["reason"] == "InjectedFault"
+    assert "stf.engine.operations" in failed_replay["detail"]
 
 
 def test_breaker_state_persists_across_calls(monkeypatch):

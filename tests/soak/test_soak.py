@@ -1,0 +1,55 @@
+"""Soak-endurance run (ISSUE 9, `make soak`): long seeded faulted walks
+with breaker-recovery, root-parity, cache-coherence, and memory-flatness
+assertions, emitting the SOAK.json timeline artifact.
+
+Both profiles are slow-marked so tier-1 (`-m 'not slow'`) never pays
+them; `make soak` runs this directory without the marker filter.  The
+deep profile additionally needs CSTPU_SOAK_DEEP=1 (`make soak-deep`)."""
+import json
+import os
+
+import pytest
+
+from consensus_specs_tpu.telemetry import soak
+
+
+def _check_report(report, expected_forks):
+    assert report["failure"] is None
+    assert [s["fork"] for s in report["forks"]] == list(expected_forks)
+    for section in report["forks"]:
+        assert section["walk_stats"]["breaker_state"] == "closed"
+        assert section["walk_stats"]["breaker_trips"] >= 1  # epoch 0 trip
+        assert section["rerun_stats"]["replayed_blocks"] == 0
+        assert section["rerun_stats"]["fast_blocks"] == section["blocks"]
+        assert section["fired"], "no scheduled fault fired"
+        for sample in section["cache_samples"]:
+            for entry in sample["sizes"]:
+                if entry["cap"]:
+                    assert entry["size"] <= entry["cap"], entry
+    # the artifact carries the post-mortem surfaces
+    assert report["snapshot"]["providers"]["stf.engine"]
+    kinds = [e["kind"] for e in report["timeline"]]
+    assert "breaker_open" in kinds and "breaker_close" in kinds
+    assert kinds.index("breaker_open") < kinds.index("breaker_close")
+
+
+@pytest.mark.slow
+def test_soak_bounded():
+    # default out path: the repo-root SOAK.json artifact (CSTPU_SOAK_OUT
+    # overrides), the same convention as BENCH_DETAILS.json
+    report = soak.run_soak("bounded")
+    _check_report(report, ("phase0", "altair"))
+    with open(report["out_path"]) as f:
+        on_disk = json.load(f)
+    assert on_disk["profile"] == "bounded"
+    assert on_disk["failure"] is None
+    assert on_disk["timeline"], "artifact carries no timeline"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("CSTPU_SOAK_DEEP") != "1",
+                    reason="deep endurance profile: CSTPU_SOAK_DEEP=1 "
+                           "(make soak-deep)")
+def test_soak_deep():
+    report = soak.run_soak("deep")
+    _check_report(report, ("phase0", "altair"))

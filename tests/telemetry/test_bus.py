@@ -1,0 +1,115 @@
+"""Telemetry bus (ISSUE 9): provider registration, snapshot schema
+stability, error isolation, and the production providers' presence."""
+import json
+import threading
+
+import pytest
+
+from consensus_specs_tpu import telemetry
+from consensus_specs_tpu.telemetry import registry
+
+
+@pytest.fixture
+def scratch_provider():
+    names = []
+
+    def add(name, fn, **kw):
+        registry.register_provider(name, fn, **kw)
+        names.append(name)
+
+    yield add
+    for name in names:
+        registry.unregister_provider(name)
+
+
+def test_register_snapshot_unregister(scratch_provider):
+    scratch_provider("test.alpha", lambda: {"x": 1})
+    snap = telemetry.snapshot()
+    assert snap["schema"] == 1
+    assert snap["providers"]["test.alpha"] == {"x": 1}
+    registry.unregister_provider("test.alpha")
+    assert "test.alpha" not in telemetry.snapshot()["providers"]
+
+
+def test_duplicate_provider_rejected(scratch_provider):
+    scratch_provider("test.dup", lambda: {})
+    with pytest.raises(ValueError, match="duplicate"):
+        registry.register_provider("test.dup", lambda: {})
+    # explicit replace is the sanctioned override (module re-import path)
+    registry.register_provider("test.dup", lambda: {"v": 2}, replace=True)
+    assert telemetry.snapshot()["providers"]["test.dup"] == {"v": 2}
+
+
+def test_failing_provider_is_isolated(scratch_provider):
+    def boom():
+        raise RuntimeError("sick subsystem")
+
+    scratch_provider("test.boom", boom)
+    scratch_provider("test.ok", lambda: {"fine": True})
+    providers = telemetry.snapshot()["providers"]
+    assert "sick subsystem" in providers["test.boom"]["error"]
+    assert providers["test.ok"] == {"fine": True}
+
+
+def test_snapshot_is_a_copy(scratch_provider):
+    live = {"n": 0}
+    scratch_provider("test.live", lambda: live)
+    snap = telemetry.snapshot()["providers"]["test.live"]
+    snap["n"] = 99
+    assert live["n"] == 0  # deep copy: consumers can't write back
+
+
+def test_production_providers_register_at_import():
+    # importing the engines registers their providers; the bus then
+    # carries every stats surface the ISSUE names, JSON-serializable
+    import consensus_specs_tpu.forkchoice.engine  # noqa: F401
+    import consensus_specs_tpu.stf  # noqa: F401
+
+    snap = telemetry.snapshot()
+    names = set(snap["providers"])
+    assert {"tracing", "native.bls", "faults", "flight_recorder",
+            "stf.engine", "stf.verify", "stf.plan_cache", "stf.columns",
+            "stf.sync", "forkchoice.engine"} <= names
+    json.dumps(snap)  # schema-stable == JSON-able, whole tree
+    # stable key sets across consecutive snapshots (schema stability)
+    assert set(telemetry.snapshot()["providers"]) == names
+
+
+def test_engine_provider_reflects_counters():
+    from consensus_specs_tpu import stf
+
+    stf.reset_stats()
+    stf.stats["fast_blocks"] += 3
+    try:
+        engine_tree = telemetry.snapshot()["providers"]["stf.engine"]
+        assert engine_tree["fast_blocks"] == 3
+        assert engine_tree["breaker"]["open"] is False
+        assert engine_tree["breaker_state"] == "closed"
+    finally:
+        stf.reset_stats()
+
+
+def test_concurrent_registration_and_snapshot(scratch_provider):
+    # registration is lock-guarded: hammering both sides must neither
+    # deadlock nor corrupt the registry
+    stop = threading.Event()
+    errors = []
+
+    def snapper():
+        while not stop.is_set():
+            try:
+                telemetry.snapshot()
+            except Exception as exc:  # pragma: no cover - failure capture
+                errors.append(exc)
+
+    t = threading.Thread(target=snapper)
+    t.start()
+    try:
+        for i in range(50):
+            registry.register_provider(f"test.c{i}", lambda: {}, replace=True)
+    finally:
+        stop.set()
+        t.join()
+        for i in range(50):
+            registry.unregister_provider(f"test.c{i}")
+    assert errors == []

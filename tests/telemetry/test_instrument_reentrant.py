@@ -1,0 +1,90 @@
+"""Re-entrant spec instrumentation (ISSUE 9 satellite): a spec rebuild
+rebinds ``process_*`` module globals (the builder's kernel substitution,
+bench's ``__wrapped__`` unwrap idiom), which silently dropped the tracing
+wrappers; and a copied boolean flag (``functools.wraps`` copies
+``__dict__``) made re-instrumentation SKIP exactly the functions that
+needed re-wrapping.  ``instrument_spec`` now identity-marks its wrappers
+and re-wraps anything that is not literally one of its own."""
+import functools
+
+import pytest
+
+from consensus_specs_tpu import tracing
+from consensus_specs_tpu.specs.builder import build_spec
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tracing.reset()
+    tracing.disable()
+    yield
+    tracing.reset()
+    tracing.disable()
+
+
+@pytest.fixture(scope="module")
+def spec():
+    # dedicated module name: instrumentation mutates spec globals and
+    # must never leak into the shared cached builds other tests use
+    return build_spec("phase0", "minimal", name="reentrant_phase0")
+
+
+def _run_epoch(spec):
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+    from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    next_epoch(spec, state)
+
+
+def test_reinstrument_after_reset_and_rebuild_produces_spans(spec):
+    assert tracing.instrument_spec(spec) > 10
+    assert tracing.instrument_spec(spec) == 0  # idempotent
+
+    # "rebuild": rebind a few transition globals to fresh unwrapped
+    # functions, the way the builder's substitution pass and bench's
+    # __wrapped__ idiom do — the old wrappers are silently gone
+    dropped = ["process_epoch", "process_slot", "process_justification_and_finalization"]
+    for name in dropped:
+        spec.__dict__[name] = spec.__dict__[name].__wrapped__
+    tracing.reset()
+
+    n = tracing.instrument_spec(spec)
+    assert n == len(dropped)  # exactly the dropped functions re-wrap
+
+    tracing.enable()
+    _run_epoch(spec)
+    spans = tracing.report()["spans"]
+    assert any(k.endswith("process_epoch") for k in spans)
+    assert any("process_epoch/" in k for k in spans)
+
+
+def test_copied_flag_cannot_fake_instrumentation(spec):
+    tracing.instrument_spec(spec)
+    wrapper = spec.__dict__["process_epoch"]
+
+    # a substitution that functools.wraps the OLD wrapper copies its
+    # __dict__ (including any marker) onto a brand-new function; the old
+    # boolean-flag scheme then skipped it forever
+    @functools.wraps(wrapper)
+    def substituted(state):
+        return wrapper.__wrapped__(state)
+
+    spec.__dict__["process_epoch"] = substituted
+    assert tracing.instrument_spec(spec) == 1  # identity check re-wraps
+
+    tracing.enable()
+    _run_epoch(spec)
+    assert any(k.endswith("process_epoch")
+               for k in tracing.report()["spans"])
+
+
+def test_instrumented_spec_still_transitions_correctly(spec):
+    # behavior preservation after a wrap -> unwrap -> re-wrap cycle
+    tracing.instrument_spec(spec)
+    _run_epoch(spec)  # disabled: wrappers must be pass-through

@@ -1,0 +1,90 @@
+"""Thread-safety of the tracing/metrics core (ISSUE 9 satellite): the
+bare-defaultdict counter increment and the SHARED span-nesting stack
+raced under the native thread pool and the parallel/ paths — mutation is
+now lock-guarded and span nesting is per-thread.  The nesting test fails
+deterministically against the pre-fix shared-stack implementation
+(cross-thread key contamination like ``outer3/outer2/inner`` and wildly
+wrong counts — verified); the counter tests pin the lock around the
+load-modify-store window, whose loss under the GIL is real but timing
+dependent."""
+import threading
+
+import pytest
+
+from consensus_specs_tpu import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tracing.reset()
+    tracing.disable()
+    yield
+    tracing.reset()
+    tracing.disable()
+
+
+def test_concurrent_counter_increments_are_exact():
+    tracing.enable()
+    n_threads, n_incr = 8, 20_000
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()
+        for _ in range(n_incr):
+            tracing.count("race.shared")
+            tracing.count("race.shared", 2)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tracing.report()["counters"]["race.shared"] == n_threads * n_incr * 3
+
+
+def test_concurrent_spans_keep_per_thread_nesting():
+    tracing.enable()
+    n_threads, n_spans = 4, 2_000
+    barrier = threading.Barrier(n_threads)
+
+    def worker(tid):
+        barrier.wait()
+        for _ in range(n_spans):
+            with tracing.span(f"outer{tid}"):
+                with tracing.span("inner"):
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = tracing.report()["spans"]
+    for tid in range(n_threads):
+        # a shared nesting stack would cross-contaminate the key paths
+        # (outer0/outer1/inner etc.); per-thread stacks keep them exact
+        assert spans[f"outer{tid}"]["count"] == n_spans
+        assert spans[f"outer{tid}/inner"]["count"] == n_spans
+    assert not any("outer0/outer" in k for k in spans)
+
+
+def test_concurrent_span_and_counter_mix():
+    tracing.enable()
+    n_threads = 6
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()
+        for _ in range(3_000):
+            with tracing.span("mix"):
+                tracing.count("mix.c")
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep = tracing.report()
+    assert rep["spans"]["mix"]["count"] == n_threads * 3_000
+    assert rep["counters"]["mix.c"] == n_threads * 3_000

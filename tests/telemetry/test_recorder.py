@@ -1,0 +1,140 @@
+"""Flight recorder (ISSUE 9): ring bounds + drop accounting, ordering,
+dump payloads, engine event integration, and the disabled-path overhead
+contract."""
+import json
+import time
+
+import pytest
+
+from consensus_specs_tpu.telemetry import recorder
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    was = recorder.enabled()
+    recorder.reset()
+    yield
+    recorder.reset()
+    recorder.disable() if not was else recorder.enable()
+
+
+def test_disabled_records_nothing():
+    recorder.disable()
+    recorder.record("ghost", x=1)
+    assert recorder.timeline() == []
+    assert recorder.stats()["total"] == 0
+
+
+def test_events_are_ordered_and_structured():
+    recorder.enable()
+    recorder.record("alpha", a=1)
+    recorder.record("beta", b="two")
+    events = recorder.timeline()
+    assert [e["kind"] for e in events] == ["alpha", "beta"]
+    assert events[0]["seq"] < events[1]["seq"]
+    assert events[0]["t"] <= events[1]["t"]
+    assert events[0]["a"] == 1 and events[1]["b"] == "two"
+
+
+def test_ring_bound_and_drop_accounting():
+    recorder.enable(cap=8)
+    try:
+        for i in range(20):
+            recorder.record("e", i=i)
+        events = recorder.timeline()
+        assert len(events) == 8
+        assert [e["i"] for e in events] == list(range(12, 20))  # last-N
+        st = recorder.stats()
+        assert st["total"] == 20 and st["dropped"] == 12 and st["cap"] == 8
+    finally:
+        recorder.enable(cap=recorder.DEFAULT_CAP)
+
+
+def test_timeline_returns_copies():
+    recorder.enable()
+    recorder.record("x", n=1)
+    recorder.timeline()[0]["n"] = 99
+    assert recorder.timeline()[0]["n"] == 1
+
+
+def test_dump_writes_post_mortem_json(tmp_path):
+    recorder.enable()
+    recorder.record("breaker_open", consecutive_errors=3)
+    path = tmp_path / "dump.json"
+    payload = recorder.dump("unit-test failure", path=str(path))
+    on_disk = json.loads(path.read_text())
+    assert on_disk["reason"] == "unit-test failure"
+    assert on_disk["events"][-1]["kind"] == "breaker_open"
+    assert on_disk["snapshot"]["schema"] == 1
+    assert payload["recorder"]["events"] == 1
+
+
+def test_engine_emits_block_events():
+    # a real minimal-spec block through the stf engine lands a block_fast
+    # event carrying per-block phase deltas and plan-cache movement
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.specs.builder import build_spec
+    from consensus_specs_tpu.stf import attestations as stf_attestations
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.block import (
+        build_empty_block_for_next_slot,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+    from consensus_specs_tpu.testing.helpers.state import (
+        state_transition_and_sign_block,
+    )
+
+    spec = build_spec("phase0", "minimal", name="recorder_phase0")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    stf.reset_stats()
+    stf_attestations.reset_caches()
+    walk = state.copy()
+    signed = state_transition_and_sign_block(
+        spec, walk, build_empty_block_for_next_slot(spec, walk))
+
+    recorder.enable()
+    s = state.copy()
+    stf.apply_signed_blocks(spec, s, [signed], True)
+    kinds = [e["kind"] for e in recorder.timeline()]
+    assert "block_fast" in kinds
+    assert "cache_commit" in kinds
+    # the commit event precedes the block_fast event: settlement first
+    assert kinds.index("cache_commit") < kinds.index("block_fast")
+    fast = next(e for e in recorder.timeline() if e["kind"] == "block_fast")
+    assert fast["slot"] == int(signed.message.slot)
+    for key in ("slot_roots_s", "sig_verify_s", "plan_hits", "plan_misses"):
+        assert key in fast
+
+
+# -- disabled-path overhead (ISSUE 9 acceptance) ------------------------------
+
+
+def _per_call(fn, n=200_000):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def test_disabled_path_adds_no_measurable_cost():
+    """The acceptance microbench: with the recorder off, record() is a
+    global load + truth check — bounded here at 5µs/call (a ~50x margin
+    over its measured cost on the 1 vCPU host, so scheduler noise cannot
+    flake the gate while a real regression — locking, dict building —
+    still trips it)."""
+    from consensus_specs_tpu import tracing
+
+    recorder.disable()
+    tracing.disable()
+    assert _per_call(lambda: recorder.record("off")) < 5e-6
+    assert _per_call(lambda: tracing.count("off")) < 5e-6
+
+    def _span():
+        with tracing.span("off"):
+            pass
+
+    assert _per_call(_span, n=50_000) < 10e-6

@@ -96,3 +96,70 @@ def test_fc_trend_not_comparable_is_silent():
     assert bench.check_forkchoice_trend(_FC_ROW, {"error": "x"}) is None
     other = dict(_FC_ROW, metric="forkchoice_batch_ingest_other")
     assert bench.check_forkchoice_trend(dict(_FC_ROW, value=1.0), other) is None
+
+
+# -- counter-invariant gate (ISSUE 9) -----------------------------------------
+
+_TEL = {"plan_hits": 1952, "plan_misses": 2144, "plan_hit_ratio": 0.476,
+        "memo_hits": 1952, "memo_hit_ratio": 0.465,
+        "h2c_hits": 31, "h2c_misses": 4128, "h2c_hit_ratio": 0.007,
+        "column_hits": 0, "column_misses": 0,
+        "replayed_blocks": 0, "breaker_state": "closed",
+        "breaker_trips": 0, "native_degraded": 0}
+
+
+def _e2e_row(**tel_overrides):
+    return {"metric": "mainnet_epoch_e2e_bls_on_400000", "value": 3.4,
+            "unit": "s", "telemetry": dict(_TEL, **tel_overrides)}
+
+
+def test_counters_healthy_row_passes():
+    assert bench.check_counter_invariants(_e2e_row()) is None
+    assert bench.check_counter_invariants(_e2e_row(), _e2e_row()) is None
+
+
+def test_counters_replayed_blocks_block():
+    msg = bench.check_counter_invariants(_e2e_row(replayed_blocks=2))
+    assert msg is not None and "replayed 2 blocks" in msg
+
+
+def test_counters_open_breaker_and_degradation_block():
+    msg = bench.check_counter_invariants(_e2e_row(breaker_state="open"))
+    assert msg is not None and "breaker open" in msg
+    msg = bench.check_counter_invariants(_e2e_row(native_degraded=1))
+    assert msg is not None and "degraded" in msg
+
+
+def test_counters_hit_rate_floor_breach_blocks():
+    # the exit-4 path the driver sees: a keying regression zeroes the
+    # plan hit ratio while wall-time may still look fine
+    msg = bench.check_counter_invariants(_e2e_row(plan_hit_ratio=0.1))
+    assert msg is not None and "plan_hit_ratio" in msg and "floor" in msg
+    msg = bench.check_counter_invariants(_e2e_row(memo_hit_ratio=0.2))
+    assert msg is not None and "memo_hit_ratio" in msg
+    # exactly at the floor passes
+    assert bench.check_counter_invariants(
+        _e2e_row(plan_hit_ratio=0.25, memo_hit_ratio=0.25)) is None
+
+
+def test_counters_h2c_drift_vs_previous():
+    prev = _e2e_row(h2c_hit_ratio=0.4)
+    assert bench.check_counter_invariants(
+        _e2e_row(h2c_hit_ratio=0.3), prev) is None  # within 0.15 drift
+    msg = bench.check_counter_invariants(
+        _e2e_row(h2c_hit_ratio=0.2), prev)
+    assert msg is not None and "h2c_hit_ratio" in msg
+    # no previous telemetry -> no absolute h2c floor (corpus-dependent)
+    assert bench.check_counter_invariants(
+        _e2e_row(h2c_hit_ratio=0.0)) is None
+
+
+def test_counters_not_comparable_is_silent():
+    # pre-telemetry rows, errored rows, skipped rows: never block
+    assert bench.check_counter_invariants(None) is None
+    assert bench.check_counter_invariants({"error": "x"}) is None
+    assert bench.check_counter_invariants(
+        {"metric": "m", "value": 1.0}) is None  # PR-8-era row, no telemetry
+    row = _e2e_row()
+    del row["telemetry"]["plan_hit_ratio"]  # ratio absent (zero total)
+    assert bench.check_counter_invariants(row) is None

@@ -11,6 +11,7 @@ from . import (  # noqa: F401
     hygiene,
     jit_purity,
     key_coverage,
+    observability,
     rollback,
     sharding_contract,
 )

@@ -136,6 +136,24 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         instance_attrs=frozenset({"_root", "_dirty_chunks"}),
         invalidators=frozenset({"_invalidate"}),
     ),
+    # telemetry-owned structures (ISSUE 9): the provider registry and the
+    # flight-recorder ring are mutated only through their owner module's
+    # API (register_provider / record) — a direct poke from a producer
+    # would bypass the lock and the ring bound
+    CacheSpec(
+        name="telemetry provider registry",
+        owner=("telemetry",),
+        module="consensus_specs_tpu.telemetry.registry",
+        module_globals=frozenset({"_PROVIDERS"}),
+        invalidators=frozenset({"reset", "unregister_provider"}),
+    ),
+    CacheSpec(
+        name="flight-recorder ring",
+        owner=("telemetry",),
+        module="consensus_specs_tpu.telemetry.recorder",
+        module_globals=frozenset({"_EVENTS"}),
+        invalidators=frozenset({"reset"}),
+    ),
 )
 
 

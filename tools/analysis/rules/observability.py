@@ -1,0 +1,130 @@
+"""OB01 — flight-recorder discipline in instrumented modules.
+
+The flight recorder (``consensus_specs_tpu/telemetry/recorder.py``) is a
+post-mortem instrument: its timeline is only evidence if every event is
+true.  Two ways a producer can quietly break that:
+
+* **bypassing the bounded API** — appending to (or splicing into) the
+  ring deque directly (``recorder._EVENTS.append(...)``) skips the lock,
+  the sequence numbering, and the drop accounting; a module that does it
+  from another thread can corrupt the ring the way CC01's cache pokes
+  corrupt a memo.  Reads (``timeline``/``stats``) and invalidations
+  (``clear``/``pop``) stay legal — removal can only lose history, never
+  fake it.
+
+* **logging a commit that never happened** — in a faults-instrumented
+  module (one binding ``_SITE = faults.site(...)`` probes), a
+  commit-class event (``cache_commit``, ``block_fast``,
+  ``mirror_flush``, ``memo_commit``) recorded INSIDE a still-open
+  ``staging.block_transaction()`` block precedes the transaction's
+  settlement: an injected fault after the record rolls the block back,
+  and the timeline then *asserts* a commit the caches never saw — the
+  exact lie a post-mortem reader would act on.  The fix mirrors the
+  cache discipline EF01 enforces: move the record after the ``with``
+  block (the engine's shape) or defer it through ``staging.defer`` so it
+  runs only at settlement.
+
+Like EF01, the rule scopes the transactional check to modules that
+register fault probes — that is where an injected failure can separate
+the event from the effect it claims.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, register
+from ..symbols import name_matches
+
+_RING_APPENDERS = {"append", "appendleft", "extend", "extendleft", "insert"}
+_COMMIT_KINDS = {"cache_commit", "block_fast", "mirror_flush", "memo_commit"}
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@register
+class FlightRecorderDisciplineRule(Rule):
+    """Direct ring mutation outside telemetry/, or a commit-class record
+    inside an open block transaction in a fault-probed module."""
+
+    code = "OB01"
+    summary = "flight-recorder append bypasses the API or logs an unsettled commit"
+
+    def check(self, ctx):
+        if ctx.tree is None or ctx.in_dir("telemetry", "specs", "tests"):
+            return
+        sym = ctx.symbols
+        yield from self._direct_ring_writes(ctx, sym)
+        if self._is_instrumented(sym):
+            yield from self._premature_commit_events(ctx, sym)
+
+    # -- check 1: the ring is written only through record() ------------------
+
+    def _direct_ring_writes(self, ctx, sym):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RING_APPENDERS):
+                continue
+            recv = node.func.value
+            if (isinstance(recv, ast.Attribute) and recv.attr == "_EVENTS"
+                    and self._is_recorder(sym.resolve(recv.value))):
+                yield (node.lineno,
+                       f"direct ._EVENTS.{node.func.attr}() on the flight-"
+                       "recorder ring: bypasses the lock, the sequence "
+                       "numbering, and the bound — emit through "
+                       "telemetry.record(kind, ...)")
+
+    @staticmethod
+    def _is_recorder(resolved) -> bool:
+        return bool(resolved) and resolved.lstrip(".").endswith(
+            "telemetry.recorder")
+
+    # -- check 2: commit-class events settle with the transaction ------------
+
+    @staticmethod
+    def _is_instrumented(sym) -> bool:
+        return any(
+            name_matches(dotted, {"site"}) and "faults" in (dotted or "")
+            for dotted in sym.scope_info(None).origins.values())
+
+    def _premature_commit_events(self, ctx, sym):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(self._is_block_transaction(sym, item.context_expr)
+                       for item in node.items):
+                continue
+            for stmt in node.body:
+                for call in ast.walk(stmt):
+                    kind = self._commit_record_kind(sym, call)
+                    if kind is not None:
+                        yield (call.lineno,
+                               f"'{kind}' event recorded inside an open "
+                               "block_transaction: a fault before "
+                               "settlement rolls the block back and the "
+                               "timeline asserts a commit that never "
+                               "happened — move it after the with block "
+                               "or staging.defer it")
+
+    @staticmethod
+    def _is_block_transaction(sym, expr) -> bool:
+        return (isinstance(expr, ast.Call)
+                and name_matches(sym.resolve(expr.func),
+                                 {"block_transaction"})
+                and "staging" in (sym.resolve(expr.func) or ""))
+
+    @staticmethod
+    def _commit_record_kind(sym, node):
+        """The commit-class kind string of a ``record(...)`` call, else
+        None.  Only literal kinds are judged — a computed kind is opaque
+        and flagging it would be guessing."""
+        if not (isinstance(node, ast.Call) and node.args):
+            return None
+        dotted = sym.resolve(node.func)
+        if not (name_matches(dotted, {"record"})
+                and "telemetry" in (dotted or "")):
+            return None
+        kind = node.args[0]
+        if (isinstance(kind, ast.Constant) and isinstance(kind.value, str)
+                and kind.value in _COMMIT_KINDS):
+            return kind.value
+        return None
