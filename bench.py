@@ -346,6 +346,9 @@ def bench_epoch_e2e_bls(results):
     # resolution / state application / participation mirror flush
     phases.update({k: round(engine_stats[k], 3) for k in
                    ("resolve_s", "apply_s", "mirror_flush_s")})
+    # overlapped pipeline (ISSUE 10): native seconds hidden behind host
+    # work — sig_verify_s reports only the non-overlapped remainder
+    phases["overlap_s"] = telemetry_summary.get("overlap_s", 0.0)
 
     results["epoch_e2e_bls"] = {
         "metric": f"mainnet_epoch_e2e_bls_on_{N_VALIDATORS}",
@@ -466,6 +469,15 @@ def _telemetry_summary():
         "breaker_trips": eng.get("breaker_trips", 0),
         "native_degraded": ver.get("native_degraded", 0),
     }
+    # overlapped-pipeline effectiveness (ISSUE 10): overlap_s is native
+    # seconds hidden behind host work; the ratio is gated by the trend
+    # gate's counter invariants like the cache hit ratios
+    pipe = p.get("stf.pipeline", {})
+    summary["overlap_s"] = round(pipe.get("overlap_s", 0.0), 3)
+    summary["overlap_ratio"] = pipe.get("overlap_ratio")
+    summary["pipeline_dispatched"] = pipe.get("dispatched", 0)
+    summary["pipeline_drains"] = pipe.get("drains", 0)
+    summary["speculative_hits"] = ver.get("speculative_hits", 0)
     native = p.get("native.bls", {})
     if native.get("loaded"):
         h2c = native["h2c"]
@@ -561,6 +573,8 @@ def bench_epoch_e2e_bls_altair(results):
                    ("hash_to_g2_s", "msm_s", "miller_s", "marshal_s")})
     phases.update({k: round(engine_stats[k], 3) for k in
                    ("resolve_s", "apply_s", "mirror_flush_s")})
+    # overlapped pipeline (ISSUE 10): same surfacing as the phase0 row
+    phases["overlap_s"] = telemetry_summary.get("overlap_s", 0.0)
 
     results["epoch_e2e_bls_altair"] = {
         "metric": f"altair_mainnet_epoch_e2e_bls_on_{N_VALIDATORS}",
@@ -1142,18 +1156,18 @@ def bench_scale_probe(results):
     }
 
 
-def bench_e2e_scale_probe(results):
-    """Validator-count axis of the e2e headline (ISSUE 8): the SAME
+def bench_e2e_scale_probe(results, n=1 << 20, row_key="epoch_e2e_scale_1m"):
+    """Validator-count axis of the e2e headline (ISSUE 8/10): the SAME
     BLS-on engine-vs-literal A/B as ``bench_epoch_e2e_bls``, at 2^20
-    validators — byte-identical post-state roots and zero silent
-    fallbacks asserted at this size too, so the 400k headline's
-    correctness story is measured to hold as validator count scales.
-    Run via BENCH_SCALE_PROBE=1 (the row is preserved across later bench
-    runs that skip the probe, like ``epoch_scale_1m``)."""
+    (and, ISSUE 10, 2^21 — millions-of-users scale) validators —
+    byte-identical post-state roots and zero silent fallbacks asserted
+    at these sizes too, so the 400k headline's correctness story is
+    measured to hold as validator count scales.  Run via
+    BENCH_SCALE_PROBE=1 (the rows are preserved across later bench runs
+    that skip the probe, like ``epoch_scale_1m``)."""
     from consensus_specs_tpu.crypto import bls
     from consensus_specs_tpu.specs.builder import get_spec
 
-    n = 1 << 20
     spec = get_spec("phase0", "mainnet")
     bls.use_fastest()
 
@@ -1184,7 +1198,8 @@ def bench_e2e_scale_probe(results):
     phases = {k: round(engine_stats[k], 3) for k in
               ("sig_verify_s", "attestation_apply_s", "resolve_s", "apply_s",
                "mirror_flush_s", "slot_roots_s", "other_s")}
-    results["epoch_e2e_scale_1m"] = {
+    phases["overlap_s"] = telemetry_summary.get("overlap_s", 0.0)
+    results[row_key] = {
         "metric": f"mainnet_epoch_e2e_bls_on_{n}",
         "value": round(t_e2e, 3),
         "unit": "s",
@@ -1354,7 +1369,8 @@ def check_forkchoice_trend(current, previous, threshold: float = 0.15):
 
 
 def check_counter_invariants(current, previous=None, plan_floor=0.25,
-                             memo_floor=0.25, h2c_drift=0.15):
+                             memo_floor=0.25, h2c_drift=0.15,
+                             overlap_floor=0.25):
     """Counter-invariant half of the trend gate (ISSUE 9): the headline's
     wall-time can hold while its *behavior* silently rots — blocks
     replaying, the breaker open, a cache key change zeroing a hit ratio.
@@ -1366,13 +1382,19 @@ def check_counter_invariants(current, previous=None, plan_floor=0.25,
     * the plan-cache or verified-triple hit ratio under its floor (the
       corpus re-carries every aggregate once, so ~0.45+ is structural —
       a floor breach means the keying broke, not the workload);
+    * the pipeline overlap ratio under ``overlap_floor`` on a row whose
+      pipeline actually dispatched batches (ISSUE 10: the overlap is the
+      headline's mechanism — a collapse means blocks stopped
+      overlapping, e.g. the speculation window silently draining every
+      block — and wall-clock noise could hide it);
     * the h2c hit ratio dropping more than ``h2c_drift`` absolute vs the
       previous BENCH_DETAILS row (no absolute floor: memo dedup keeps
       repeat messages out of the hasher, so its healthy value is
       corpus-dependent).
 
     None when within budget or not comparable (a pre-telemetry row, an
-    errored row, a QUICK run that skipped the row)."""
+    errored row, a QUICK run that skipped the row, a pipeline-off
+    run)."""
     if not isinstance(current, dict) or "error" in current:
         return None
     tel = current.get("telemetry")
@@ -1393,6 +1415,12 @@ def check_counter_invariants(current, previous=None, plan_floor=0.25,
         if ratio is not None and ratio < floor:
             return (f"counter invariant: {metric} {key} {ratio:.3f} under "
                     f"the {floor:.2f} floor — hit-rate collapse")
+    if tel.get("pipeline_dispatched"):
+        overlap = tel.get("overlap_ratio")
+        if overlap is not None and overlap < overlap_floor:
+            return (f"counter invariant: {metric} overlap_ratio "
+                    f"{overlap:.3f} under the {overlap_floor:.2f} floor — "
+                    f"the pipeline stopped overlapping")
     prev_tel = previous.get("telemetry") if isinstance(previous, dict) else None
     if isinstance(prev_tel, dict):
         cur_h2c, prev_h2c = tel.get("h2c_hit_ratio"), prev_tel.get("h2c_hit_ratio")
@@ -1459,6 +1487,13 @@ def main():
             bench_e2e_scale_probe(results)
         except Exception as exc:
             results["epoch_e2e_scale_1m"] = {"error": repr(exc)[:300]}
+        try:
+            # millions-of-users point (ISSUE 10): 2^21 validators, same
+            # A/B parity + no-silent-fallback asserts as every size
+            bench_e2e_scale_probe(results, n=1 << 21,
+                                  row_key="epoch_e2e_scale_2m")
+        except Exception as exc:
+            results["epoch_e2e_scale_2m"] = {"error": repr(exc)[:300]}
 
     try:
         results["_load_context"] = {
@@ -1487,7 +1522,8 @@ def main():
         except (OSError, ValueError):
             prev_details = {}
     # rows produced only by opt-in probes survive runs that skip them
-    for preserved in ("epoch_scale_1m", "epoch_e2e_scale_1m"):
+    for preserved in ("epoch_scale_1m", "epoch_e2e_scale_1m",
+                      "epoch_e2e_scale_2m"):
         if preserved not in results and prev_details.get(preserved):
             results[preserved] = prev_details[preserved]
     with open(details_path, "w") as f:
@@ -1552,10 +1588,16 @@ def main():
                 results.get("forkchoice_batch_ingest"),
                 prev_details.get("forkchoice_batch_ingest"))
             regressions.append(fc_regression)
-            # counter invariants (ISSUE 9): behavioral drift in the e2e
-            # rows' embedded telemetry refuses the headline like a slowdown
-            for row_key in ("epoch_e2e_bls", "epoch_e2e_bls_altair"):
+            # counter invariants (ISSUE 9/10): behavioral drift in the
+            # e2e rows' embedded telemetry refuses the headline like a
+            # slowdown; the validator-scale rows (1M/2M) are gated the
+            # same way, and their wall time rides the perf trend too
+            for row_key in ("epoch_e2e_bls", "epoch_e2e_bls_altair",
+                            "epoch_e2e_scale_1m", "epoch_e2e_scale_2m"):
                 regressions.append(check_counter_invariants(
+                    results.get(row_key), prev_details.get(row_key)))
+            for row_key in ("epoch_e2e_scale_1m", "epoch_e2e_scale_2m"):
+                regressions.append(check_perf_trend(
                     results.get(row_key), prev_details.get(row_key)))
         regressions = [r for r in regressions if r]
         if regressions:

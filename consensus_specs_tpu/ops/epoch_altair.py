@@ -79,11 +79,20 @@ def _flag_deltas_device(spec, state, cols, eligible, in_leak,
 
     prev_epoch = int(spec.get_previous_epoch(state))
     flags_dev = columns.device_column(state, current=False)
+    # registry-derived kernel inputs ride the root-keyed device-buffer
+    # store (ISSUE 10): uploaded once per registry VERSION, not re-staged
+    # per jit call — the registry half of the residency arc
+    reg_root = bytes(state.validators.hash_tree_root())
     rewards, penalties = _ensure_jit()(
         flags_dev,
-        jnp.asarray(active_mask(cols, prev_epoch)),
-        jnp.asarray(cols["slashed"]),
-        jnp.asarray(np.asarray(cols["effective_balance"], dtype=np.int64)),
+        columns.device_buffer(
+            (reg_root, "active", prev_epoch),
+            lambda: active_mask(cols, prev_epoch)),
+        columns.device_buffer((reg_root, "slashed"),
+                              lambda: cols["slashed"]),
+        columns.device_buffer(
+            (reg_root, "eff_i64"),
+            lambda: np.asarray(cols["effective_balance"], dtype=np.int64)),
         jnp.asarray(eligible),
         jnp.asarray([int(w) for w in spec.PARTICIPATION_FLAG_WEIGHTS],
                     dtype=jnp.int64),
@@ -243,11 +252,18 @@ def rewards_and_penalties(spec, state) -> None:
             inact_pen[i] = numerator // quotient
     deltas.append((np.zeros_like(eff), inact_pen))
 
-    balances = bulk.packed_uint64_to_numpy(state.balances)
+    # the balance column rides the resident store (ISSUE 10): the read is
+    # a dict probe after any earlier phase touched it, and the flush
+    # stages the written array on the identity fast path so the NEXT
+    # phase (slashings, effective-balance hysteresis, the resident-merkle
+    # upload) skips the tree walk too
+    from consensus_specs_tpu.stf import columns as stf_columns
+
+    balances = stf_columns.balance_column(state)
     for rewards, penalties in deltas:
         balances = balances + rewards
         balances = np.where(penalties > balances, 0, balances - penalties)
-    bulk.set_packed_uint64_from_numpy(state.balances, balances)
+    stf_columns.flush_balances(state, balances)
 
 
 def justification_and_finalization(spec, state) -> None:

@@ -302,8 +302,25 @@ def _next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length() if n > 1 else 1
 
 
-def attestation_deltas(inp: DeltaInputs):
-    """Compute (rewards, penalties) int64 arrays from DeltaInputs."""
+def delta_device_cache(spec, state) -> tuple:
+    """The device-residency key half for one epoch-kernel call: registry
+    root + previous epoch — everything the registry-derived kernel
+    inputs (padded effective balance, eligibility mask) are pure in.
+    State-ful callers pass it to ``attestation_deltas`` /
+    ``fused_epoch_balance_update`` so those uploads happen once per
+    registry VERSION (stf/columns.device_buffer) instead of per call."""
+    return (bytes(state.validators.hash_tree_root()),
+            int(spec.get_previous_epoch(state)))
+
+
+def attestation_deltas(inp: DeltaInputs, device_cache: tuple = None):
+    """Compute (rewards, penalties) int64 arrays from DeltaInputs.
+
+    With ``device_cache`` (from ``delta_device_cache``) the registry-
+    derived inputs — effective balance and the eligibility mask — are
+    served as resident device buffers keyed by registry root, retiring
+    the per-call re-staging ROADMAP item 3 named; the per-epoch inputs
+    (participation, inclusion) still upload per call, as they must."""
     n = inp.effective_balance.shape[0]
     n_pad = _next_pow2(n)
 
@@ -316,9 +333,24 @@ def attestation_deltas(inp: DeltaInputs):
 
     dev = _kernel_device()
     put = (lambda a: jax.device_put(a, dev)) if dev is not None else jnp.asarray
+    if device_cache is not None:
+        from consensus_specs_tpu.stf import columns
+
+        # backend identity is bound by device_buffer itself (it appends
+        # str(device) to every key) — callers key only their derivation
+        root, prev_epoch = device_cache
+        eff_dev = columns.device_buffer(
+            (root, "eff_pad", n_pad),
+            lambda: pad(inp.effective_balance), device=dev)
+        elig_dev = columns.device_buffer(
+            (root, "eligible_pad", prev_epoch, n_pad),
+            lambda: pad(inp.eligible.astype(bool)), device=dev)
+    else:
+        eff_dev = put(pad(inp.effective_balance))
+        elig_dev = put(pad(inp.eligible.astype(bool)))
     rewards, penalties = _jit_kernel(
-        put(pad(inp.effective_balance)),
-        put(pad(inp.eligible.astype(bool))),
+        eff_dev,
+        elig_dev,
         put(pad(inp.source_part.astype(bool))),
         put(pad(inp.target_part.astype(bool))),
         put(pad(inp.head_part.astype(bool))),
@@ -327,13 +359,15 @@ def attestation_deltas(inp: DeltaInputs):
         put(scalars),
     )
     # host-sync: staged view — the one pull-back of the epoch kernel's
-    # outputs; ROADMAP item 3 (device-resident columns) retires it
+    # outputs (the input side is resident now; the output side goes
+    # device-resident with the fused merkle path)
     return np.asarray(rewards)[:n], np.asarray(penalties)[:n]
 
 
 def attestation_deltas_for_state(spec, state):
     """End-to-end: state -> (rewards, penalties) numpy arrays."""
-    return attestation_deltas(extract_delta_inputs(spec, state))
+    return attestation_deltas(extract_delta_inputs(spec, state),
+                              device_cache=delta_device_cache(spec, state))
 
 
 # ---------------------------------------------------------------------------
@@ -341,6 +375,80 @@ def attestation_deltas_for_state(spec, state):
 # semantics-preserving substitutions; each keeps the sequential original
 # reachable via __wrapped__, differential tests in tests/spec/phase0/)
 # ---------------------------------------------------------------------------
+
+
+# -- phase0 matching-attestation scans (ISSUE 10) -----------------------------
+
+# one shared pass per (pendings version, roots version, slot, epoch)
+# computing the matching-target AND matching-head sublists together —
+# the spec's two per-pending listcomps re-walk every pending's ``a.data``
+# view chain per call (and its sundry LRU keys on the FULL state root).
+# Both key halves are memoized subtree roots, so a probe is cheap after
+# any state-root computation; FIFO-bounded like every geometry memo.
+_MATCHING_SCAN_CACHE: dict = {}
+_MATCHING_SCAN_MAX = 4
+
+
+def _matching_scan(spec, state, epoch: int) -> dict:
+    prev_epoch = int(spec.get_previous_epoch(state))
+    cur_epoch = int(spec.get_current_epoch(state))
+    # get_matching_source_attestations' own precondition, verbatim
+    assert int(epoch) in (prev_epoch, cur_epoch)
+    atts = (state.current_epoch_attestations if int(epoch) == cur_epoch
+            else state.previous_epoch_attestations)
+    key = (bytes(atts.hash_tree_root()),
+           bytes(state.block_roots.hash_tree_root()),
+           int(state.slot), int(epoch))
+    hit = _MATCHING_SCAN_CACHE.get(key)
+    if hit is not None:
+        return hit
+    # expected target root evaluated at the FIRST pending (the spec's
+    # listcomp evaluates get_block_root per item, so first-use raises
+    # identically and an empty list never evaluates it); head roots
+    # memoized per slot with the same first-use raise point
+    expected_target = None
+    head_roots: dict = {}
+    target, head = [], []
+    for a in atts:
+        data = a.data
+        if expected_target is None:
+            expected_target = bytes(
+                spec.get_block_root(state, spec.Epoch(int(epoch))))
+        if bytes(data.target.root) != expected_target:
+            continue
+        target.append(a)
+        slot = int(data.slot)
+        head_root = head_roots.get(slot)
+        if head_root is None:
+            head_root = head_roots[slot] = bytes(
+                spec.get_block_root_at_slot(state, data.slot))
+        if bytes(data.beacon_block_root) == head_root:
+            head.append(a)
+    from consensus_specs_tpu.stf import staging
+
+    if len(_MATCHING_SCAN_CACHE) >= _MATCHING_SCAN_MAX:
+        _MATCHING_SCAN_CACHE.pop(next(iter(_MATCHING_SCAN_CACHE)))
+    value = {"target": target, "head": head}
+    _MATCHING_SCAN_CACHE[key] = value
+    staging.note_insert(_MATCHING_SCAN_CACHE, key)
+    return value
+
+
+def matching_target_attestations(spec, state, epoch) -> list:
+    """``get_matching_target_attestations`` off the shared scan — same
+    elements, same order, same assert/raise points."""
+    return _matching_scan(spec, state, int(epoch))["target"]
+
+
+def matching_head_attestations(spec, state, epoch) -> list:
+    """``get_matching_head_attestations`` off the shared scan."""
+    return _matching_scan(spec, state, int(epoch))["head"]
+
+
+def reset_caches() -> None:
+    """Drop the matching-scan memo (cold-start control; the registry
+    column cache is root-keyed and self-invalidating, so it stays)."""
+    _MATCHING_SCAN_CACHE.clear()
 
 
 def participation_mask(spec, state, attestations, n: int) -> np.ndarray:
@@ -378,11 +486,12 @@ def active_validator_indices(spec, state, epoch) -> list:
 
 def effective_balance_updates(spec, state) -> None:
     """Hysteresis update; only validators whose effective balance actually
-    moves touch the tree (typically a handful per epoch)."""
-    from consensus_specs_tpu.ssz import bulk
+    moves touch the tree (typically a handful per epoch).  The balance
+    read is a resident-column probe (the rewards phase just flushed it)."""
+    from consensus_specs_tpu.stf import columns as stf_columns
 
     cols = registry_columns(state)
-    bal = bulk.packed_uint64_to_numpy(state.balances)
+    bal = stf_columns.balance_column(state)
     eff = cols["effective_balance"]
     ebi = int(spec.EFFECTIVE_BALANCE_INCREMENT)
     hyst = ebi // int(spec.HYSTERESIS_QUOTIENT)
@@ -395,8 +504,10 @@ def effective_balance_updates(spec, state) -> None:
 
 
 def slashings_sweep(spec, state, multiplier: int) -> None:
-    """process_slashings with the fork's proportional multiplier."""
-    from consensus_specs_tpu.ssz import bulk
+    """process_slashings with the fork's proportional multiplier.  Reads
+    the resident balance column; the sweep only copies and flushes when a
+    validator is actually due (usually never)."""
+    from consensus_specs_tpu.stf import columns as stf_columns
 
     epoch = int(spec.get_current_epoch(state))
     total = int(spec.get_total_active_balance(state))
@@ -410,13 +521,13 @@ def slashings_sweep(spec, state, multiplier: int) -> None:
     # exact python big-int arithmetic on the (few) affected validators —
     # penalty_numerator can exceed int64 in small-preset edge states
     increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    bal = bulk.packed_uint64_to_numpy(state.balances)
+    bal = stf_columns.staged_balances(state)
     for i in np.nonzero(mask)[0]:
         eff_i = int(cols["effective_balance"][i])
         penalty = eff_i // increment * adjusted // total * increment
         b = int(bal[i])
         bal[i] = 0 if penalty > b else b - penalty
-    bulk.set_packed_uint64_from_numpy(state.balances, bal)
+    stf_columns.flush_balances(state, bal)
 
 
 def registry_updates(spec, state) -> None:

@@ -224,10 +224,14 @@ def _fused_epoch_balances(balances, eff, eligible, source_part, target_part,
 _jit_fused = jax.jit(_fused_epoch_balances)
 
 
-def fused_epoch_balance_update(inp, balances: np.ndarray, device):
+def fused_epoch_balance_update(inp, balances: np.ndarray, device,
+                               device_cache: tuple = None):
     """DeltaInputs + current balances -> (new balances [n] int64 numpy,
     padded-subtree root bytes).  One device program; the root reduction
-    reads the kernel's output vector in place."""
+    reads the kernel's output vector in place.  ``device_cache`` (from
+    ``epoch_jax.delta_device_cache``) serves the registry-derived inputs
+    as resident device buffers — uploaded once per registry version
+    (stf/columns.device_buffer), not per epoch call."""
     n = balances.shape[0]
     n_pad = max(4, 1 << (n - 1).bit_length() if n > 1 else 1)
 
@@ -241,10 +245,26 @@ def fused_epoch_balance_update(inp, balances: np.ndarray, device):
     scalars = delta_scalars(inp)
 
     put = lambda a: jax.device_put(a, device)  # noqa: E731
+    if device_cache is not None:
+        from consensus_specs_tpu.stf import columns
+
+        # backend identity bound by device_buffer (appends str(device));
+        # these keys deliberately match attestation_deltas' so the two
+        # paths share uploads on the same backend
+        root, prev_epoch = device_cache
+        eff_dev = columns.device_buffer(
+            (root, "eff_pad", n_pad),
+            lambda: pad(inp.effective_balance), device=device)
+        elig_dev = columns.device_buffer(
+            (root, "eligible_pad", prev_epoch, n_pad),
+            lambda: pad(inp.eligible.astype(bool)), device=device)
+    else:
+        eff_dev = put(pad(inp.effective_balance))
+        elig_dev = put(pad(inp.eligible.astype(bool)))
     new_bal, root_words = _jit_fused(
         put(pad(balances.astype(np.int64))),
-        put(pad(inp.effective_balance)),
-        put(pad(inp.eligible.astype(bool))),
+        eff_dev,
+        elig_dev,
         put(pad(inp.source_part.astype(bool))),
         put(pad(inp.target_part.astype(bool))),
         put(pad(inp.head_part.astype(bool))),

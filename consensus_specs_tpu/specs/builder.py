@@ -707,7 +707,6 @@ def _install_phase0_epoch_kernel(g: Dict[str, Any]) -> None:
     sanctioned-substitution pattern of reference setup.py:65-68).
     Differential test: tests/spec/phase0/test_epoch_kernel.py."""
     from consensus_specs_tpu.ops import epoch_jax, merkle_resident
-    from consensus_specs_tpu.ssz import bulk
 
     proxy = _LiveSpecProxy(g)
     Gwei = g["Gwei"]
@@ -726,27 +725,36 @@ def _install_phase0_epoch_kernel(g: Dict[str, Any]) -> None:
     g["get_attestation_deltas"] = get_attestation_deltas
 
     def process_rewards_and_penalties(state):
+        from consensus_specs_tpu.stf import columns as stf_columns
+
         if g["get_current_epoch"](state) == g["GENESIS_EPOCH"]:
             return
         inp = epoch_jax.extract_delta_inputs(proxy, state)
-        balances = bulk.packed_uint64_to_numpy(state.balances)
+        # balance read + write ride the resident column store (ISSUE 10):
+        # the read is a dict probe when any earlier consumer touched this
+        # version, and the flush stages the written array so the rest of
+        # the epoch transition (slashings, hysteresis, resident upload)
+        # never re-walks the subtree
+        balances = stf_columns.balance_column(state)
         device = (merkle_resident.resident_device()
                   if len(balances) >= merkle_resident.RESIDENT_MIN else None)
+        cache_key = epoch_jax.delta_device_cache(proxy, state)
         if device is not None:
             # residency composes: deltas kernel + balance update + merkle
             # reduction in ONE device program; the device-computed subtree
             # root is memoized into the fresh backing so the next state
             # root never hashes the balances subtree on host
             new_balances, padded_root = merkle_resident.fused_epoch_balance_update(
-                inp, balances, device)
-            bulk.set_packed_uint64_from_numpy(state.balances, new_balances)
+                inp, balances, device, device_cache=cache_key)
+            stf_columns.flush_balances(state, new_balances)
             merkle_resident.memoize_packed_u64_contents_root(
                 state.balances, padded_root)
             return
-        rewards, penalties = epoch_jax.attestation_deltas(inp)
+        rewards, penalties = epoch_jax.attestation_deltas(
+            inp, device_cache=cache_key)
         increased = balances + rewards
         new_balances = np.where(penalties > increased, 0, increased - penalties)
-        bulk.set_packed_uint64_from_numpy(state.balances, new_balances)
+        stf_columns.flush_balances(state, new_balances)
 
     process_rewards_and_penalties.__doc__ = orig_rap.__doc__
     process_rewards_and_penalties.__wrapped__ = orig_rap
@@ -755,6 +763,19 @@ def _install_phase0_epoch_kernel(g: Dict[str, Any]) -> None:
     _swap(g, "get_attesting_balance",
           lambda state, attestations: g["Gwei"](
               epoch_jax.attesting_balance(proxy, state, attestations)))
+
+    # the epoch's pending scans ride ONE shared memoized pass (target +
+    # head computed together, both key halves memoized subtree roots)
+    # instead of two per-pending listcomps LRU'd on the full state root;
+    # downstream attester resolution already rides the plan-cache path
+    # (epoch_jax.attesting_indices).  Differential:
+    # tests/spec/phase0/test_epoch_kernel.py::test_matching_scans
+    _swap(g, "get_matching_target_attestations",
+          lambda state, epoch: epoch_jax.matching_target_attestations(
+              proxy, state, epoch))
+    _swap(g, "get_matching_head_attestations",
+          lambda state, epoch: epoch_jax.matching_head_attestations(
+              proxy, state, epoch))
 
 
 # RLock: building fork F recursively resolves its predecessor via get_spec

@@ -17,10 +17,17 @@ Layers (see docs/architecture.md, "The block path"):
   net per-validator deltas;
 * ``slot_roots``   — spec-identical ``process_slots`` with dirty bulk
   subtrees routed through the resident merkle path;
+* ``columns``      — root-keyed resident validator-state columns
+  (participation, balances, registry-derived device buffers) serving
+  dict probes where the tree hands out chunk walks;
+* ``pipeline``     — cross-block overlapped verification: block N's
+  native pairing batch runs on a dispatch worker while block N+1's
+  host phases execute (``CSTPU_PIPELINE=0`` opts out);
 * ``engine``       — the optimistic fast path + exact-spec replay
   fallback that makes failure behavior literally the spec's
   (fork families: phase0, and altair/bellatrix with the execution
-  payload run literally inside the snapshot region).
+  payload run literally inside the snapshot region), plus the
+  speculation window's LIFO drain orchestration.
 """
 from .attestations import FastPathViolation
 from .engine import apply_signed_blocks, reset_stats, stats

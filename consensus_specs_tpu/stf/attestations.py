@@ -252,6 +252,8 @@ def reset_caches() -> None:
     bench cold-start control and test isolation."""
     from . import columns, sync
 
+    from consensus_specs_tpu.ops import epoch_jax
+
     _ACTIVE_CACHE.clear()
     _CTX_CACHE.clear()
     _CTX_LOOKUP.clear()
@@ -262,6 +264,7 @@ def reset_caches() -> None:
     reset_stats()
     sync.reset_caches()
     columns.reset_caches()
+    epoch_jax.reset_caches()  # matching-scan memo: same cold-start control
     try:
         from consensus_specs_tpu.crypto.bls import native
 

@@ -26,6 +26,22 @@ poisoned as the sequential path would have.  Valid blocks therefore land
 byte-identical post-states, and invalid blocks are indistinguishable from
 the spec path (pinned by
 tests/spec/phase0/sanity/test_stf_engine_differential.py).
+
+Cross-block overlapped pipeline (ISSUE 10): with ``CSTPU_PIPELINE`` on
+(the default), a multi-block call overlaps block N's native signature
+batch with the next block(s)' host phases — the batch is dispatched
+through ``stf/pipeline.py`` and its verdict awaited only after
+``pipeline.window_depth()`` successors' host work (default 2; the extra
+block of slack absorbs per-block jitter).  The rollback
+contract makes the speculation safe by construction: each block's cache
+transaction stays open (and its verified-triple commit deferred) until
+its verdict lands, so a failed verdict, a breaker trip, native
+degradation, or any fault in the window drains the pipeline — the
+successor's inserts and state writes unwind first (LIFO), the failing
+block restores its own backing snapshot, and the literal replay raises
+the spec's exception with the existing bisection naming the original
+entry.  Results are byte-identical pipeline ON or OFF (the ON/OFF
+exception-parity battery in both differential suites pins it).
 """
 from __future__ import annotations
 
@@ -36,7 +52,7 @@ import time
 from consensus_specs_tpu import faults, telemetry, tracing
 from consensus_specs_tpu.telemetry import recorder
 
-from . import columns, slot_roots, staging, sync, verify
+from . import columns, pipeline, slot_roots, staging, sync, verify
 from .attestations import (
     FastPathViolation,
     affine_rows,
@@ -103,11 +119,12 @@ stats = {
 
 
 def reset_stats() -> None:
-    """Zero ALL engine counters — the per-block phase/fallback dict here
-    and the signature-settlement counters in stf/verify.py (one call, so
-    bench rows can't accidentally report cumulative halves) — and re-arm
-    the circuit breaker (counters and live state reset together, so a
-    bench leg can't inherit the previous leg's open breaker)."""
+    """Zero ALL engine counters — the per-block phase/fallback dict here,
+    the signature-settlement counters in stf/verify.py, and the pipeline's
+    overlap accounting (one call, so bench rows can't accidentally report
+    cumulative halves) — and re-arm the circuit breaker (counters and
+    live state reset together, so a bench leg can't inherit the previous
+    leg's open breaker)."""
     for k in stats:
         if isinstance(stats[k], float):
             stats[k] = 0.0
@@ -118,6 +135,7 @@ def reset_stats() -> None:
     _breaker.update(consecutive_errors=0, open=False, since_skipped=0)
     stats["breaker_state"] = "closed"
     verify.reset_stats()
+    pipeline.reset_stats()
 
 
 def _count_reason(reason: str) -> None:
@@ -188,10 +206,33 @@ def apply_signed_blocks(spec, state, signed_blocks, validate_result: bool = True
     """Apply ``signed_blocks`` to ``state`` in place, semantically
     identical to ``for sb in signed_blocks: spec.state_transition(state,
     sb, validate_result)`` — same post-states on success, same exception
-    and partial state on the first invalid block."""
+    and partial state on the first invalid block.
+
+    With the overlapped pipeline enabled (``CSTPU_PIPELINE`` != 0, the
+    default) and no cache transaction already open (a re-entrant call
+    joins the caller's block and must stay synchronous), blocks run
+    through the speculative cross-block path; otherwise the serial
+    one-block-at-a-time path.  Both land byte-identical results."""
+    if pipeline.enabled() and staging.current() is None:
+        return _apply_pipelined(spec, state, signed_blocks, validate_result)
     for signed_block in signed_blocks:
         _apply_one(spec, state, signed_block, validate_result)
     return state
+
+
+def _replay_breaker_open(spec, state, signed_block, validate_result: bool,
+                         rec: bool) -> None:
+    """The open-breaker skip: accounting + literal replay, shared by the
+    serial and pipelined paths so their stats can never drift."""
+    stats["replayed_blocks"] += 1
+    stats["breaker_skipped"] += 1
+    _count_reason("breaker_open")
+    tracing.count("stf.replayed_block")
+    if rec:
+        recorder.record("block_replayed",
+                        slot=int(signed_block.message.slot),
+                        reason="breaker_open")
+    spec.state_transition(state, signed_block, validate_result)
 
 
 def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
@@ -199,15 +240,7 @@ def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
     # computation (slot reads, stats deltas) is paid only while recording
     rec = recorder.enabled()
     if not _breaker_allows_attempt():
-        stats["replayed_blocks"] += 1
-        stats["breaker_skipped"] += 1
-        _count_reason("breaker_open")
-        tracing.count("stf.replayed_block")
-        if rec:
-            recorder.record("block_replayed",
-                            slot=int(signed_block.message.slot),
-                            reason="breaker_open")
-        spec.state_transition(state, signed_block, validate_result)
+        _replay_breaker_open(spec, state, signed_block, validate_result, rec)
         return
     pre_backing = state.get_backing()
     snap = _block_snapshot() if rec else None
@@ -232,19 +265,8 @@ def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
                             slot=int(signed_block.message.slot),
                             **_block_delta(snap))
     except Exception as exc:
-        if not isinstance(exc, FastPathViolation):
-            stats["fast_path_errors"] += 1
-            _breaker_note_error()
-        _count_reason(type(exc).__name__)
-        stats["replayed_blocks"] += 1
-        tracing.count("stf.replayed_block")
-        if rec:
-            recorder.record("block_replayed",
-                            slot=int(signed_block.message.slot),
-                            reason=type(exc).__name__,
-                            detail=str(exc)[:160])
         state.set_backing(pre_backing)
-        spec.state_transition(state, signed_block, validate_result)
+        _replay_literal(spec, state, signed_block, validate_result, exc, rec)
 
 
 # phase attribution captured per block by the flight recorder (deltas of
@@ -296,7 +318,18 @@ def _block_delta(snap: dict) -> dict:
     return out
 
 
-def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
+def _collect_block(spec, state, signed_block, validate_result: bool,
+                   spec_keys) -> tuple:
+    """One block's host phases: slot advancement, header/RANDAO/eth1,
+    operations with the vectorized attestation path, sync aggregate —
+    every state mutation of the fast path, with the block's signature
+    checks collected (not settled) as materialized batch entries.
+    Returns ``(entries, keys, t_host_done)``; both settlement styles
+    (serial ``_fast_transition``, pipelined ``_begin_block``) build on
+    it.  ``spec_keys`` is the pending predecessor's dispatched key set —
+    triples it is already verifying are skipped speculatively
+    (verify.note_speculative_hit; safe because any predecessor failure
+    drains this block too)."""
     from consensus_specs_tpu.crypto import bls
 
     block = signed_block.message
@@ -312,6 +345,9 @@ def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
     def collect(members_id, count, flat, message, signature):
         key = verify.triple_key(members_id, message, signature)
         if verify.is_verified(key):
+            return
+        if spec_keys is not None and key in spec_keys:
+            verify.note_speculative_hit()
             return
         entries.append((count, flat(), message, signature))
         keys.append(key)
@@ -345,18 +381,279 @@ def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
             spec, state, block.body.sync_aggregate, collect, bls_on)
     t4s = time.perf_counter()
     stats["sync_apply_s"] += t4s - t4
+    stats["sig_verify_s"] += t2 - t1
+    stats["other_s"] += (t3 - t2) + non_attestation_ops
+    return entries, keys, t4s
 
+
+def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
+    """Serial settlement (pipeline OFF / re-entrant calls): host phases,
+    then the one synchronous multi-pairing, then the post-state root."""
+    entries, keys, t4s = _collect_block(
+        spec, state, signed_block, validate_result, None)
     bad = verify.settle(entries, keys)
     if bad is not None:
         raise FastPathViolation(f"invalid signature (batch entry {bad})")
     t5 = time.perf_counter()
     if validate_result:
         computed = _SITE_STATE_ROOT(bytes(slot_roots.state_root(spec, state)))
-        if bytes(block.state_root) != computed:
+        if bytes(signed_block.message.state_root) != computed:
             raise FastPathViolation("state root mismatch")
     t6 = time.perf_counter()
-    stats["sig_verify_s"] += (t2 - t1) + (t5 - t4s)
-    stats["other_s"] += (t3 - t2) + non_attestation_ops + (t6 - t5)
+    stats["sig_verify_s"] += t5 - t4s
+    stats["other_s"] += t6 - t5
+
+
+# -- cross-block overlapped pipeline ------------------------------------------
+
+
+class _Speculation:
+    """One block whose host phases are applied and whose signature batch
+    is in flight: everything needed to settle it (commit + memo keys) or
+    unwind it (open transaction + backing snapshot + literal replay)."""
+
+    __slots__ = ("signed_block", "slot", "index", "pre_backing", "txn",
+                 "handle", "keys_set", "rec_delta")
+
+    def __init__(self, signed_block, pre_backing, txn, handle, keys_set):
+        self.signed_block = signed_block
+        self.slot = int(signed_block.message.slot)
+        self.index = -1  # position in the call's block list (set by the loop)
+        self.pre_backing = pre_backing
+        self.txn = txn
+        self.handle = handle
+        self.keys_set = keys_set
+        self.rec_delta = None
+
+
+def _begin_block(spec, state, signed_block, validate_result: bool,
+                 spec_keys, rec: bool) -> _Speculation:
+    """Apply one block's host phases under a fresh (open) cache
+    transaction and dispatch its signature batch; the post-state root is
+    checked here (its inputs are complete — only the verdict is
+    outstanding).  On any exception the partial work is fully unwound —
+    own batch discarded, transaction rolled back, backing restored —
+    before the exception propagates into the caller's replay handling."""
+    pre_backing = state.get_backing()
+    snap = _block_snapshot() if rec else None
+    txn = staging.begin_block()
+    handle = None
+    try:
+        entries, keys, t4s = _collect_block(
+            spec, state, signed_block, validate_result, spec_keys)
+        if entries:
+            handle = pipeline.dispatch(entries)
+            # the memo commit stays deferred through the block's own
+            # transaction: it runs only at commit_block, after the
+            # verdict — speculated verification never leaks into a
+            # rolled-back block (EF01/OB01 discipline)
+            verify.stage_commit(keys)
+        t5 = time.perf_counter()
+        stats["sig_verify_s"] += t5 - t4s
+        if validate_result:
+            computed = _SITE_STATE_ROOT(
+                bytes(slot_roots.state_root(spec, state)))
+            if bytes(signed_block.message.state_root) != computed:
+                raise FastPathViolation("state root mismatch")
+            stats["other_s"] += time.perf_counter() - t5
+    except BaseException:
+        pipeline.discard(handle)
+        staging.rollback_block(txn)
+        state.set_backing(pre_backing)
+        raise
+    finally:
+        staging.deactivate(txn)
+    pend = _Speculation(signed_block, pre_backing, txn, handle,
+                        frozenset(keys) if keys else frozenset())
+    if rec:
+        # host-phase attribution captured NOW (the block's own work);
+        # the settlement await is added at finish so the recorded block
+        # never charges the successor's host phases to this block
+        pend.rec_delta = _block_delta(snap)
+    return pend
+
+
+def _finish_speculation(pend: _Speculation, rec: bool):
+    """Await ``pend``'s verdict and settle its transaction.  Returns None
+    on success (fast-block bookkeeping done) or the exception that must
+    drive the literal replay — the CALLER unwinds state, successor first
+    (LIFO), because blocks may already be speculated on top."""
+    a0 = pipeline.stats["await_s"]
+    try:
+        bad = (pipeline.wait(pend.handle)
+               if pend.handle is not None else None)
+    except Exception as exc:
+        return exc
+    finally:
+        awaited = pipeline.stats["await_s"] - a0
+        stats["sig_verify_s"] += awaited
+        if pend.rec_delta is not None:
+            pend.rec_delta["sig_verify_s"] = round(
+                pend.rec_delta["sig_verify_s"] + awaited, 6)
+    if bad is not None:
+        return FastPathViolation(f"invalid signature (batch entry {bad})")
+    try:
+        # the commit itself is a probed seam (same as the serial path): a
+        # torn commit rolls the staged entries back and the block replays
+        _SITE_CACHE_COMMIT()
+        staging.commit_block(pend.txn)
+    except Exception as exc:
+        return exc
+    stats["fast_blocks"] += 1
+    _breaker_note_success()
+    tracing.count("stf.fast_block")
+    if rec and pend.rec_delta is not None:
+        recorder.record("block_fast", slot=pend.slot, **pend.rec_delta)
+    return None
+
+
+def _account_failure(exc: BaseException) -> None:
+    """The serial except-branch bookkeeping, shared with the pipeline."""
+    if not isinstance(exc, FastPathViolation):
+        stats["fast_path_errors"] += 1
+        _breaker_note_error()
+    _count_reason(type(exc).__name__)
+    stats["replayed_blocks"] += 1
+    tracing.count("stf.replayed_block")
+
+
+def _replay_literal(spec, state, signed_block, validate_result: bool,
+                    exc: BaseException, rec: bool) -> None:
+    """Account a fast-path failure and replay the block through the
+    literal spec (raising the spec's own exception, or succeeding)."""
+    _account_failure(exc)
+    if rec:
+        recorder.record("block_replayed",
+                        slot=int(signed_block.message.slot),
+                        reason=type(exc).__name__,
+                        detail=str(exc)[:160])
+    spec.state_transition(state, signed_block, validate_result)
+
+
+def _unwind_pending(state, pend: _Speculation) -> None:
+    """Roll a failed pending block back: any still-unconsumed batch
+    drained and discarded (a drain-seam fault can leave one), the open
+    transaction popped, and the backing restored to its pre-block
+    snapshot (also erasing any successor host mutations stacked on top —
+    the caller unwound the successor's own transaction first)."""
+    pipeline.discard(pend.handle)
+    staging.rollback_block(pend.txn)
+    state.set_backing(pend.pre_backing)
+
+
+def _apply_pipelined(spec, state, signed_blocks, validate_result: bool):
+    """The overlapped engine loop: begin block i (host phases + async
+    dispatch), then settle the window down to ``pipeline.window_depth()``
+    outstanding verdicts — so speculated blocks' native pairings run
+    concurrently with up to ``depth`` later blocks' host work (the extra
+    slack absorbs per-block jitter a one-deep window leaks as await
+    time).  Any failure drains LIFO — newer speculations unwound first,
+    then the failing block restores its snapshot and replays literally —
+    and the loop resumes at the block after the failure, so recovery
+    re-runs everything whose host phases rode the dead state.  The
+    pipeline always drains before returning (no verdict outlives a
+    call)."""
+    blocks = list(signed_blocks)
+    window = []  # oldest-first _Speculations with verdicts outstanding
+    depth = pipeline.window_depth()
+
+    def settle(target_len: int, drain_reason, rec: bool):
+        """Settle the window (oldest first) down to ``target_len``.
+        Returns None when every settled block committed, else the index
+        to resume the main loop at (the failed block replayed literally
+        — raising the spec's exception unless the replay recovered —
+        and every NEWER speculation unwound LIFO first, its host phases
+        having ridden a state that no longer exists)."""
+        if drain_reason is not None and window:
+            pipeline.note_drain(drain_reason)
+            if rec:
+                recorder.record("pipeline_drain", reason=drain_reason,
+                                slot=window[0].slot)
+        while len(window) > target_len:
+            pend = window[0]
+            fail = _finish_speculation(pend, rec)
+            if fail is None:
+                window.pop(0)
+                continue
+            if drain_reason is None:
+                pipeline.note_drain("verdict_failed")
+                if rec:
+                    recorder.record("pipeline_drain",
+                                    reason="verdict_failed",
+                                    slot=pend.slot)
+            for newer in reversed(window[1:]):
+                pipeline.discard(newer.handle)
+                staging.rollback_block(newer.txn)
+            del window[:]
+            _unwind_pending(state, pend)
+            _replay_literal(spec, state, pend.signed_block,
+                            validate_result, fail, rec)
+            return pend.index + 1
+        return None
+
+    i = 0
+
+    def settle_then_replay(reason: str, exc, rec: bool):
+        """The shared ineligible/failed-block shape: settle the whole
+        window (drain-tagged when one was open), then — unless a window
+        failure rewound the loop — replay the current block literally.
+        Returns the next loop index."""
+        resume = settle(0, reason if window else None, rec)
+        if resume is not None:
+            return resume
+        _replay_literal(spec, state, blocks[i], validate_result, exc, rec)
+        return i + 1
+
+    while True:
+        if i >= len(blocks):
+            if not window:
+                break
+            resume = settle(0, None, recorder.enabled())
+            if resume is not None:
+                i = resume
+            continue
+        signed_block = blocks[i]
+        rec = recorder.enabled()
+        if not _breaker_allows_attempt():
+            resume = settle(0, "breaker_open" if window else None, rec)
+            if resume is not None:
+                i = resume
+                continue
+            _replay_breaker_open(spec, state, signed_block, validate_result,
+                                 rec)
+            i += 1
+            continue
+        try:
+            ready = _fast_path_ready(spec)
+        except Exception as exc_gate:
+            # the availability gate is a probed seam: a dying gate must
+            # resolve like any fast-path error (serial-path parity)
+            i = settle_then_replay("gate_failed", exc_gate, rec)
+            continue
+        if not ready:
+            i = settle_then_replay(
+                "fast_path_unready",
+                FastPathViolation(
+                    "fast path covers phase0/altair/bellatrix + native BLS"),
+                rec)
+            continue
+        spec_keys = (frozenset().union(*(p.keys_set for p in window))
+                     if window else None)
+        try:
+            cur = _begin_block(spec, state, signed_block, validate_result,
+                               spec_keys, rec)
+        except Exception as exc_begin:
+            # the partial current block is already unwound; settle its
+            # predecessors first (sequential order), then replay it
+            i = settle_then_replay("begin_failed", exc_begin, rec)
+            continue
+        cur.index = i
+        window.append(cur)
+        i += 1
+        resume = settle(depth, None, rec)
+        if resume is not None:
+            i = resume
+    return state
 
 
 def _proposer_entry(spec, state, signed_block, collect) -> None:

@@ -50,11 +50,14 @@ def _maybe_resident_balances_root(state) -> None:
     if device is None:
         return
     try:
-        from consensus_specs_tpu.ssz import bulk
+        from . import columns
 
         resident = merkle_resident.ResidentPackedU64List(
             type(balances).LENGTH, device=device)
-        resident.upload(bulk.packed_uint64_to_numpy(balances).astype("u8"))
+        # resident-column read (ISSUE 10): after the epoch transition's
+        # flush this is the identity fast path — no tree walk before the
+        # device upload
+        resident.upload(columns.balance_column(state).astype("u8"))
         merkle_resident.memoize_packed_u64_contents_root(
             balances, resident.contents_subtree_root())
         tracing.count("stf.resident_slot_root")

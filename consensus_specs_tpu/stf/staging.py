@@ -21,10 +21,23 @@ a tested path; this module makes it impossible:
   then failed.
 
 The engine opens one transaction per block (``block_transaction`` in
-``_apply_one``); with no transaction active (literal replays, direct
-helper use, tests poking the memos), ``note_insert`` is a no-op and
-``defer`` runs the commit immediately — the memos behave exactly as
+the synchronous path); with no transaction active (literal replays,
+direct helper use, tests poking the memos), ``note_insert`` is a no-op
+and ``defer`` runs the commit immediately — the memos behave exactly as
 before PR 5.
+
+**Overlapped pipeline (ISSUE 10):** the cross-block pipeline keeps block
+N's transaction OPEN (verdict outstanding) while block N+1's host phases
+run under their own transaction.  The explicit split API —
+``begin_block`` / ``deactivate`` / ``commit_block`` / ``rollback_block``
+— supports that: ``begin_block`` makes a fresh transaction current,
+``deactivate`` detaches it (it stays open, later inserts route to the
+successor's transaction), and settlement happens through
+``commit_block``/``rollback_block`` on the detached handle.
+``commit_block`` runs the deferred queue with NO transaction current, so
+a deferred commit's own cache inserts can never leak into the
+*successor's* undo log.  ``block_transaction`` is the same machinery as
+a context manager.
 """
 from __future__ import annotations
 
@@ -106,6 +119,50 @@ def defer(fn, *args) -> None:
         fn(*args)
 
 
+def begin_block() -> CacheTransaction:
+    """Open a fresh block transaction and make it current.  The caller
+    owns settlement: ``deactivate`` when the block's host phases are done
+    (the transaction stays open for the pipeline's speculation window),
+    then ``commit_block`` or ``rollback_block``.  Must not be called with
+    a transaction already current (the engine guards; re-entrant callers
+    use ``block_transaction``)."""
+    global _TXN
+    assert _TXN is None, "begin_block with a transaction already current"
+    txn = _TXN = CacheTransaction()
+    return txn
+
+
+def deactivate(txn: CacheTransaction) -> None:
+    """Detach ``txn`` from the current slot (it stays open — its undo log
+    and deferred queue settle later via commit_block/rollback_block)."""
+    global _TXN
+    if _TXN is txn:
+        _TXN = None
+
+
+def commit_block(txn: CacheTransaction) -> None:
+    """Settle a (possibly detached) block transaction.  Runs with NO
+    transaction current: a deferred commit's own inserts apply
+    immediately instead of leaking into whatever successor transaction
+    happens to be current (the pipeline's overlap window)."""
+    global _TXN
+    outer = _TXN
+    _TXN = None
+    try:
+        txn.commit()
+    finally:
+        _TXN = outer if outer is not txn else None
+
+
+def rollback_block(txn: CacheTransaction) -> None:
+    """Roll back a (possibly detached) block transaction; pops exactly
+    the entries that block inserted, drops its deferred queue."""
+    global _TXN
+    txn.rollback()
+    if _TXN is txn:
+        _TXN = None
+
+
 @contextlib.contextmanager
 def block_transaction():
     """One block's cache transaction: commit on clean exit, roll back on
@@ -115,13 +172,13 @@ def block_transaction():
     if _TXN is not None:
         yield _TXN
         return
-    txn = _TXN = CacheTransaction()
+    txn = begin_block()
     try:
         yield txn
     except BaseException:
-        txn.rollback()
+        rollback_block(txn)
         raise
     else:
-        txn.commit()
+        commit_block(txn)
     finally:
-        _TXN = None
+        deactivate(txn)

@@ -62,6 +62,7 @@ stats = {
     "batches": 0,
     "entries": 0,
     "memo_hits": 0,
+    "speculative_hits": 0,
     "bisections": 0,
     "memo_evictions": 0,
     "native_degraded": 0,
@@ -138,6 +139,18 @@ def is_verified(key: bytes) -> bool:
     return False
 
 
+def note_speculative_hit() -> None:
+    """Count a dedup hit against a PENDING (dispatched, unverdicted)
+    batch's key set — the pipeline's speculative twin of a memo hit.
+    Sound because the consuming block only survives if the providing
+    block's batch verifies and commits: any failure drains the pipeline
+    and replays both blocks literally (stf/engine.py).  Counted into
+    ``memo_hits`` so the dedup ratio keeps one meaning pipeline ON or
+    OFF, and separately so the overlap story is attributable."""
+    stats["memo_hits"] += 1
+    stats["speculative_hits"] += 1
+
+
 def _verify_batch(entries: Sequence[SigEntry], seed: bytes = None) -> bool:
     """One RLC multi-pairing over ``entries`` (True iff every item holds).
 
@@ -199,7 +212,13 @@ def first_invalid(entries: Sequence[SigEntry], seed: bytes = None) -> Optional[i
     (crypto/bls/__init__.py:_first_invalid): O(log n) sub-batch
     multi-pairings, always landing on the leftmost failure so the engine's
     spec replay trips on the same signature the sequential path would
-    have."""
+    have.
+
+    Threading: with the overlapped pipeline ON this runs on the single
+    ``stf/pipeline.py`` dispatch thread — entries are materialized (pure
+    data), and the batch/entry/bisection/timing counters it touches have
+    that thread as their only writer (memo hits/evictions stay
+    host-side), so the stats dict needs no lock."""
     stats["batches"] += 1
     stats["entries"] += len(entries)
     if _verify_batch(entries, seed=seed):
@@ -237,6 +256,17 @@ def settle(entries: List[SigEntry], keys: List[bytes],
         return bad
     staging.defer(_commit_keys, keys)
     return None
+
+
+def stage_commit(keys: List[bytes]) -> None:
+    """Stage a batch's triple keys for settlement WITHOUT settling the
+    batch — the overlapped pipeline's half of ``settle``: the engine
+    dispatches the multi-pairing asynchronously (stf/pipeline.py) and
+    stages the commit through the block's open cache transaction, so the
+    keys join the memo only at ``commit_block`` — after the verdict — and
+    a rolled-back speculation drops them with its transaction."""
+    if keys:
+        staging.defer(_commit_keys, keys)
 
 
 def _commit_keys(keys: Sequence[bytes]) -> None:

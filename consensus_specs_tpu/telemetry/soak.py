@@ -107,6 +107,12 @@ def _build_corpus(fork: str, epochs: int):
     return out["corpus"]
 
 
+def _pipeline_inflight_cap() -> int:
+    from consensus_specs_tpu.stf import pipeline
+
+    return pipeline.window_depth() + 1
+
+
 def bounded_cache_sizes() -> List[dict]:
     """(name, size, cap) of every bounded structure the telemetry bus
     reports — the memory-flatness sample."""
@@ -125,6 +131,18 @@ def bounded_cache_sizes() -> List[dict]:
          "cap": verify.get("memo_cap", 0)},
         {"name": "stf.columns.store", "size": columns.get("size", 0),
          "cap": columns.get("cap", 0)},
+        # ISSUE 10 residency stores + the pipeline's in-flight queue:
+        # bounded like everything else, flatness-asserted per epoch
+        {"name": "stf.columns.balances",
+         "size": columns.get("balance_size", 0),
+         "cap": columns.get("balance_cap", 0)},
+        {"name": "stf.columns.device_buffers",
+         "size": columns.get("device_size", 0),
+         "cap": columns.get("device_cap", 0)},
+        {"name": "stf.pipeline.inflight",
+         "size": providers.get("stf.pipeline", {}).get("depth", 0),
+         # the engine's bound: window_depth + the current block's dispatch
+         "cap": _pipeline_inflight_cap()},
         {"name": "stf.sync.rows_memo",
          "size": sync.get("rows_memo_size", 0), "cap": sync.get("cap", 0)},
         {"name": "flight_recorder.ring", "size": ring.get("events", 0),

@@ -109,3 +109,45 @@ def test_ef01_staging_routed_helper_is_clean_across_files():
              "consensus_specs_tpu/stf/user.py": user}
     proj = build_project(files)
     assert ef01("consensus_specs_tpu/stf/user.py", user, project=proj) == []
+
+
+def test_ef01_speculated_memo_commit_outside_defer_stays_red():
+    """ISSUE 10: the overlapped pipeline's verdict path must commit a
+    speculated batch's triples THROUGH the block transaction
+    (staging.defer -> commit_block), never directly — a direct insert at
+    the drain seam would land keys for a block that may still roll back.
+    The pipeline-shaped fixture below (probe at the drain, insert after
+    the verdict) is exactly that bug, and EF01 keeps it gate-red."""
+    src = ("from consensus_specs_tpu import faults\n"
+           "from consensus_specs_tpu.stf import staging\n"
+           "_SITE_DRAIN = faults.site('stf.x.drain')\n"
+           "_VERIFIED_MEMO = {}\n"
+           "def finish_speculation(handle, keys):\n"
+           "    _SITE_DRAIN()\n"
+           "    bad = handle.result()\n"
+           "    if bad is None:\n"
+           "        for k in keys:\n"
+           "            _VERIFIED_MEMO[k] = True\n"
+           "    return bad\n")
+    found = ef01("consensus_specs_tpu/stf/x.py", src)
+    assert [f.line for f in found] == [10]
+    assert "strand" in found[0].message
+
+
+def test_ef01_speculated_commit_through_defer_is_sanctioned():
+    """The shipping shape: the drain path stages the commit with
+    staging.defer and the deferred function inserts at settlement —
+    clean, exactly like verify.stage_commit -> _commit_keys."""
+    src = ("from consensus_specs_tpu import faults\n"
+           "from consensus_specs_tpu.stf import staging\n"
+           "_SITE_DRAIN = faults.site('stf.x.drain')\n"
+           "_VERIFIED_MEMO = {}\n"
+           "def _commit(keys):\n"
+           "    _SITE_DRAIN()\n"
+           "    for k in keys:\n"
+           "        _VERIFIED_MEMO[k] = True\n"
+           "def finish_speculation(handle, keys):\n"
+           "    _SITE_DRAIN()\n"
+           "    if handle.result() is None:\n"
+           "        staging.defer(_commit, keys)\n")
+    assert ef01("consensus_specs_tpu/stf/x.py", src) == []

@@ -190,6 +190,12 @@ _PHASE0_CASES = [
     [F("stf.verify.native_call", nth=2)],
     [F("stf.verify.msm", nth=2)],
     [F("stf.verify.memo_commit", nth=1)],
+    # the overlapped pipeline's own seams (ISSUE 10): a dying dispatch
+    # must fail into the block's own rollback; a dying drain must
+    # resolve like a failed verdict — pending block unwound and
+    # replayed, its in-flight batch discarded, caches coherent
+    [F("stf.pipeline.dispatch", nth=2)],
+    [F("stf.pipeline.drain", nth=3)],
     # corrupted member coordinates force the batch down the bisection
     # walk, where the second fault lands mid-bisection
     [F("stf.attestations.affine_rows", nth=1, kind="corrupt"),
@@ -202,6 +208,7 @@ _ALTAIR_CASES = [
     [F("stf.sync.rows_memo", nth=1, kind="corrupt")],
     [F("stf.sync.rewards", nth=2)],
     [F("stf.engine.state_root", nth=1)],
+    [F("stf.pipeline.drain", nth=1)],
 ]
 
 _EXTRA_SITES = {"stf.verify.native_call", "stf.engine.operations",
@@ -222,6 +229,110 @@ def test_chaos_site_phase0(case):
     "case", _ALTAIR_CASES, ids=[repr(c[-1]) for c in _ALTAIR_CASES])
 def test_chaos_site_altair(case):
     _run_case("altair", case)
+
+
+# -- faults mid-speculation: the whole walk in ONE pipelined call -------------
+
+# the per-site cases above apply one block per call, so the pipeline
+# drains between blocks and cross-block speculation never opens.  These
+# cases replay the whole corpus in a single ``apply_signed_blocks`` call
+# — block N's batch genuinely in flight while block N+1's host phases
+# run — and fire faults inside that window: the drain must leave every
+# cache coherent (clean re-run all-fast) and the final root must match
+# the literal oracle.
+
+_SPECULATION_CASES = [
+    # successor host-phase death while the predecessor's verdict is
+    # outstanding (drain settles the predecessor first)
+    [F("stf.engine.operations", nth=3)],
+    # a failed VERDICT with a successor already speculated on top: the
+    # corrupted coordinates fail the batch, the drain unwinds successor
+    # then predecessor (LIFO) and the replay re-proves the block
+    [F("stf.attestations.affine_rows", nth=2, kind="corrupt")],
+    # the pipeline's own seams, mid-window
+    [F("stf.pipeline.dispatch", nth=3)],
+    [F("stf.pipeline.drain", nth=2)],
+    # a torn commit at settlement, successor already begun
+    [F("stf.engine.cache_commit", nth=2)],
+    # native death inside an overlapped batch: degradation ladder drains
+    # the pipeline and gates later blocks to the literal replay
+    [F("stf.verify.native_call", nth=2, kind="crash")],
+]
+
+
+def _run_case_speculative(fork, case_faults):
+    """One-call pipelined walk under faults: final-root parity with the
+    literal oracle, plan actually fired, then cache coherence — a clean
+    one-call re-run over the SAME caches is all-fast with the same root."""
+    spec, pre, blocks, roots = _corpus(fork)
+    _fresh_engine_env()
+    plan = faults.FaultPlan(case_faults)
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        s = pre.copy()
+        with faults.inject(plan):
+            stf.apply_signed_blocks(spec, s, blocks, True)
+        assert bytes(s.hash_tree_root()) == roots[-1], \
+            "one-call pipelined walk diverged from the literal oracle"
+        assert plan.fired, f"schedule never fired: {case_faults}"
+        # coherence: same caches/memo, fresh counters + cleared breaker
+        # and degradation — the fast path must carry every block
+        stf.reset_stats()
+        stf_verify.reset_degraded()
+        s2 = pre.copy()
+        stf.apply_signed_blocks(spec, s2, blocks, True)
+        assert bytes(s2.hash_tree_root()) == roots[-1]
+        assert stf.stats["replayed_blocks"] == 0, \
+            f"poisoned cache after speculation faults: {stf.stats['replay_reasons']}"
+        assert stf.stats["fast_blocks"] == len(blocks)
+    finally:
+        bls.bls_active = prev
+
+
+@pytest.mark.parametrize(
+    "case", _SPECULATION_CASES, ids=[repr(c[-1]) for c in _SPECULATION_CASES])
+def test_chaos_mid_speculation_phase0(case):
+    _run_case_speculative("phase0", case)
+
+
+@pytest.mark.parametrize(
+    "case", _SPECULATION_CASES[:3],
+    ids=[repr(c[-1]) for c in _SPECULATION_CASES[:3]])
+def test_chaos_mid_speculation_altair(case):
+    _run_case_speculative("altair", case)
+
+
+def test_speculation_drain_events_recorded():
+    """A mid-speculation verdict failure must leave a ``pipeline_drain``
+    event in the flight recorder naming the drain reason, and the drain
+    counter on the stf.pipeline telemetry provider must move."""
+    from consensus_specs_tpu import telemetry
+
+    spec, pre, blocks, roots = _corpus("phase0")
+    _fresh_engine_env()
+    plan = faults.FaultPlan(
+        [F("stf.attestations.affine_rows", nth=2, kind="corrupt")])
+    drains_before = telemetry.snapshot()["providers"]["stf.pipeline"]["drains"]
+    recorder.reset()
+    recorder.enable()
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        s = pre.copy()
+        with faults.inject(plan):
+            stf.apply_signed_blocks(spec, s, blocks, True)
+        dumped = recorder.dump("chaos: speculation drain")
+    finally:
+        bls.bls_active = prev
+        recorder.disable()
+    assert bytes(s.hash_tree_root()) == roots[-1]
+    drain_events = [e for e in dumped["events"]
+                    if e["kind"] == "pipeline_drain"]
+    assert drain_events, "no pipeline_drain event recorded"
+    assert drain_events[0]["reason"] == "verdict_failed"
+    assert (telemetry.snapshot()["providers"]["stf.pipeline"]["drains"]
+            > drains_before)
 
 
 # -- seeded random schedules --------------------------------------------------

@@ -286,3 +286,24 @@ def test_wrong_length_sync_bits_unrepresentable(spec, state):
     sa = spec.SyncAggregate(sync_committee_bits=[])
     assert len(sa.sync_committee_bits) == size
     yield None
+
+
+# -- exception parity, pipeline ON vs OFF (ISSUE 10) --------------------------
+
+from ...phase0.sanity.test_stf_engine_differential import (  # noqa: E402
+    _PIPELINE_BATTERY,
+    _pipeline_exception_battery,
+)
+
+
+@pytest.mark.parametrize("pipeline_mode", ["0", "1"],
+                         ids=["pipeline-off", "pipeline-on"])
+@pytest.mark.parametrize("scenario", _PIPELINE_BATTERY)
+def test_exception_parity_pipeline_battery_altair(scenario, pipeline_mode,
+                                                  monkeypatch, recwarn):
+    """The ON/OFF exception-parity battery over the ALTAIR corpus: the
+    speculated invalid block rides sync-aggregate-bearing predecessors,
+    so the drain unwinds participation mirror flushes and sync seat
+    memos too (same shared harness as the phase0 suite)."""
+    _pipeline_exception_battery("altair", scenario, pipeline_mode,
+                                monkeypatch)

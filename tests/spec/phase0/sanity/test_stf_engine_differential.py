@@ -23,6 +23,8 @@ Three layers of pinning:
   replay makes this exact, including the bisection-located signature
   failures).
 """
+import contextlib
+
 import pytest
 
 from consensus_specs_tpu import stf
@@ -206,6 +208,90 @@ def test_stf_invalid_blocks_fail_identically(spec, state):
     for sb in cases:
         _exception_parity(spec, state, sb)
     yield None
+
+
+# -- exception parity, pipeline ON vs OFF (ISSUE 10) --------------------------
+
+# the overlapped pipeline speculates block N+1 while block N's verdict
+# is outstanding; this battery pins that a genuinely-invalid block —
+# speculated or not, breaker open or not, native degraded or not —
+# still raises the literal spec's exact exception with the state
+# byte-identically poisoned, with the pipeline ON and OFF.
+
+_PIPELINE_BATTERY = ["tampered-sig-speculated", "breaker-trip-mid-pipeline",
+                     "degradation-drain"]
+
+
+def _pipeline_exception_battery(fork, scenario, pipeline_mode, monkeypatch):
+    from consensus_specs_tpu import faults
+    from consensus_specs_tpu.crypto import bls
+    from tests.chaos.test_stf_chaos import _corpus, _fresh_engine_env
+
+    spec, pre, blocks, _roots = _corpus(fork)
+    monkeypatch.setenv("CSTPU_PIPELINE", pipeline_mode)
+
+    # tamper an aggregate signature on a block that carries attestations
+    # and has predecessors to speculate across
+    tamper_idx = next(i for i, sb in enumerate(blocks)
+                      if i >= 2 and len(sb.message.body.attestations))
+    bad = blocks[tamper_idx].copy()
+    bad.message.body.attestations[0].signature = \
+        spec.BLSSignature(b"\x33" * 96)
+    walk = list(blocks[:tamper_idx]) + [bad]
+
+    plan_faults = []
+    if scenario == "breaker-trip-mid-pipeline":
+        plan_faults = [faults.Fault("stf.engine.operations", nth=n)
+                       for n in (1, 2, 3)]
+    elif scenario == "degradation-drain":
+        plan_faults = [faults.Fault("stf.verify.native_call", nth=1,
+                                    kind="crash")]
+
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        # oracle: the sequential literal spec over the same walk
+        s_spec = pre.copy()
+        for sb in walk[:-1]:
+            spec.state_transition(s_spec, sb, True)
+        exc_spec = _capture_exc(spec.state_transition, s_spec, walk[-1], True)
+
+        _fresh_engine_env()
+        s_eng = pre.copy()
+        ctx = (faults.inject(faults.FaultPlan(plan_faults))
+               if plan_faults else contextlib.nullcontext())
+        with ctx:
+            # ONE call: the tampered block IS speculated (pipeline ON)
+            exc_eng = _capture_exc(
+                stf.apply_signed_blocks, spec, s_eng, walk, True)
+    finally:
+        bls.bls_active = prev
+        from consensus_specs_tpu.stf import verify as stf_verify
+
+        stf_verify.reset_degraded()  # don't leak degradation to later tests
+
+    assert exc_spec is not None, "scenario was supposed to be invalid"
+    assert type(exc_spec) is type(exc_eng), (exc_spec, exc_eng)
+    assert str(exc_spec) == str(exc_eng), (exc_spec, exc_eng)
+    assert bytes(s_spec.hash_tree_root()) == bytes(s_eng.hash_tree_root()), \
+        "poisoned post-states diverged"
+
+
+def _capture_exc(fn, *args):
+    try:
+        fn(*args)
+    except Exception as e:  # noqa: B001 - parity harness captures anything
+        return e
+    return None
+
+
+@pytest.mark.parametrize("pipeline_mode", ["0", "1"],
+                         ids=["pipeline-off", "pipeline-on"])
+@pytest.mark.parametrize("scenario", _PIPELINE_BATTERY)
+def test_exception_parity_pipeline_battery(scenario, pipeline_mode,
+                                           monkeypatch, recwarn):
+    _pipeline_exception_battery("phase0", scenario, pipeline_mode,
+                                monkeypatch)
 
 
 # -- per-slot roots (stf/slot_roots vs spec.process_slots) --------------------

@@ -97,3 +97,30 @@ def test_substituted_rewards_genesis_epoch_noop(spec, state):
     spec.process_rewards_and_penalties(state)
     assert state.hash_tree_root() == root_before
     yield from ()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_matching_scans(spec, state):
+    """The shared-pass get_matching_{target,head}_attestations twins
+    (ISSUE 10): same elements, same order, same assert points as the
+    sequential originals, served off one memoized scan."""
+    import pytest
+
+    prepare_state_with_attestations(spec, state)
+    for epoch in (spec.get_previous_epoch(state),
+                  spec.get_current_epoch(state)):
+        for name in ("get_matching_target_attestations",
+                     "get_matching_head_attestations"):
+            ours = getattr(spec, name)(state, epoch)
+            seq = getattr(spec, name).__wrapped__(state, epoch)
+            assert [bytes(a.hash_tree_root()) for a in ours] == \
+                [bytes(a.hash_tree_root()) for a in seq], (name, int(epoch))
+        # repeat call serves the same scan (memoized, content-addressed)
+        again = spec.get_matching_target_attestations(state, epoch)
+        assert again is spec.get_matching_target_attestations(state, epoch)
+    # the source precondition is preserved verbatim
+    with pytest.raises(AssertionError):
+        spec.get_matching_target_attestations(
+            state, spec.get_current_epoch(state) + 1)
+    yield from ()

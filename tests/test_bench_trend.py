@@ -163,3 +163,55 @@ def test_counters_not_comparable_is_silent():
     row = _e2e_row()
     del row["telemetry"]["plan_hit_ratio"]  # ratio absent (zero total)
     assert bench.check_counter_invariants(row) is None
+
+
+# -- overlap-ratio floor + scale rows (ISSUE 10) ------------------------------
+
+
+def test_counters_overlap_floor_breach_blocks():
+    # a pipelined row whose overlap collapsed (e.g. every block silently
+    # drained the speculation window) refuses the headline even when
+    # wall-clock noise hides the slowdown
+    row = _e2e_row(pipeline_dispatched=32, overlap_ratio=0.1,
+                   overlap_s=0.05)
+    msg = bench.check_counter_invariants(row)
+    assert msg is not None and "overlap_ratio" in msg and "floor" in msg
+    # at the floor passes
+    assert bench.check_counter_invariants(
+        _e2e_row(pipeline_dispatched=32, overlap_ratio=0.25)) is None
+
+
+def test_counters_overlap_floor_skips_pipeline_off_rows():
+    # CSTPU_PIPELINE=0 runs (and pre-pipeline rows) dispatch nothing:
+    # no overlap requirement applies
+    assert bench.check_counter_invariants(
+        _e2e_row(pipeline_dispatched=0, overlap_ratio=None)) is None
+    assert bench.check_counter_invariants(
+        _e2e_row(pipeline_dispatched=0, overlap_ratio=0.0)) is None
+    # dispatched but ratio unavailable (no worker time recorded): silent
+    assert bench.check_counter_invariants(
+        _e2e_row(pipeline_dispatched=32, overlap_ratio=None)) is None
+
+
+def _scale_row(n, value, **tel_overrides):
+    return {"metric": f"mainnet_epoch_e2e_bls_on_{n}", "value": value,
+            "unit": "s", "telemetry": dict(_TEL, **tel_overrides)}
+
+
+def test_scale_rows_gate_counters_and_trend():
+    # the 1M/2M rows ride the SAME counter-invariant gate as the 400k
+    # rows (bench.main wires them through check_counter_invariants)...
+    two_m = _scale_row(1 << 21, 14.0, replayed_blocks=1)
+    msg = bench.check_counter_invariants(two_m)
+    assert msg is not None and "replayed 1 blocks" in msg
+    assert bench.check_counter_invariants(_scale_row(1 << 21, 14.0)) is None
+    # ...and their wall time rides check_perf_trend vs the previous
+    # BENCH_DETAILS row (preserved rows compare equal and pass)
+    prev = _scale_row(1 << 21, 10.0)
+    assert bench.check_perf_trend(_scale_row(1 << 21, 11.4), prev) is None
+    msg = bench.check_perf_trend(_scale_row(1 << 21, 11.6), prev)
+    assert msg is not None and "perf-trend regression" in msg
+    assert bench.check_perf_trend(prev, prev) is None
+    # a 1M row never compares against a 2M row (metric mismatch)
+    assert bench.check_perf_trend(
+        _scale_row(1 << 20, 99.0), _scale_row(1 << 21, 10.0)) is None

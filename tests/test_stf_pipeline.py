@@ -1,0 +1,208 @@
+"""Cross-block overlapped verification pipeline (ISSUE 10): unit
+contract of ``stf/pipeline.py`` + the engine's speculative path.
+
+The differential/chaos suites own the correctness story (byte parity,
+drain coherence, exception parity ON/OFF); this module pins the
+pipeline-specific mechanics: the env gate, byte-identical results and
+identical memo content pipeline ON vs OFF, the overlap accounting
+identity, speculative dedup actually engaging across the window, and
+the always-drained invariant (no verdict outlives a call).
+"""
+from consensus_specs_tpu import stf
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.stf import pipeline
+from consensus_specs_tpu.stf import verify as stf_verify
+
+from .chaos.test_stf_chaos import _corpus, _fresh_engine_env
+
+# -- re-carry corpus ----------------------------------------------------------
+
+# the helper-built chaos corpus includes each aggregate exactly once; a
+# live node's blocks re-carry the previous slots' aggregates (the bench
+# corpus models that), and the speculative-dedup test needs it: block
+# N+1 must probe keys block N has in flight.  Build the smallest such
+# chain: two consecutive blocks carrying the SAME valid aggregates
+# (process_attestation accepts duplicates within the inclusion window).
+
+_RECARRY = {}
+
+
+def _recarry_corpus():
+    if not _RECARRY:
+        from consensus_specs_tpu.testing.context import (
+            spec_state_test,
+            with_phases,
+        )
+        from consensus_specs_tpu.testing.helpers.attestations import (
+            _get_valid_attestation_at_slot,
+        )
+        from consensus_specs_tpu.testing.helpers.block import (
+            build_empty_block_for_next_slot,
+        )
+        from consensus_specs_tpu.testing.helpers.state import (
+            next_epoch,
+            state_transition_and_sign_block,
+        )
+
+        @with_phases(["phase0"])
+        @spec_state_test
+        def build(spec, state):
+            next_epoch(spec, state)
+            pre = state.copy()
+            walk = state.copy()
+            b0 = build_empty_block_for_next_slot(spec, walk)
+            signed = [state_transition_and_sign_block(spec, walk, b0)]
+            atts = list(_get_valid_attestation_at_slot(
+                walk, spec, int(walk.slot)))
+            for _ in range(2):  # both blocks carry the same aggregates
+                blk = build_empty_block_for_next_slot(spec, walk)
+                for a in atts:
+                    blk.body.attestations.append(a)
+                signed.append(
+                    state_transition_and_sign_block(spec, walk, blk))
+            _RECARRY["phase0"] = (spec, pre, signed,
+                                  bytes(walk.hash_tree_root()))
+            yield None
+
+        build(phase="phase0")  # DEFAULT_BLS_ACTIVE: signatures are real
+    return _RECARRY["phase0"]
+
+
+def _one_call_walk(fork="phase0"):
+    spec, pre, blocks, roots = _corpus(fork)
+    _fresh_engine_env()
+    s = pre.copy()
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        stf.apply_signed_blocks(spec, s, blocks, True)
+    finally:
+        bls.bls_active = prev
+    assert bytes(s.hash_tree_root()) == roots[-1]
+    return blocks
+
+
+def test_enabled_env_gate(monkeypatch):
+    monkeypatch.delenv("CSTPU_PIPELINE", raising=False)
+    assert pipeline.enabled()
+    monkeypatch.setenv("CSTPU_PIPELINE", "0")
+    assert not pipeline.enabled()
+    monkeypatch.setenv("CSTPU_PIPELINE", "1")
+    assert pipeline.enabled()
+
+
+def test_on_off_byte_identical_and_same_memo(monkeypatch):
+    """The same walk pipeline ON and OFF: identical post-state roots,
+    identical verified-triple memo content, identical settled-entry
+    counts — speculation changes WHEN work happens, never what."""
+    spec, pre, blocks, roots = _corpus("phase0")
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        results = {}
+        for mode in ("0", "1"):
+            monkeypatch.setenv("CSTPU_PIPELINE", mode)
+            _fresh_engine_env()
+            s = pre.copy()
+            stf.apply_signed_blocks(spec, s, blocks, True)
+            results[mode] = (
+                bytes(s.hash_tree_root()),
+                frozenset(stf_verify._VERIFIED_MEMO),
+                stf_verify.stats["entries"],
+                stf_verify.stats["memo_hits"],
+                stf.stats["fast_blocks"],
+            )
+    finally:
+        bls.bls_active = prev
+    assert results["0"] == results["1"]
+    assert results["1"][0] == roots[-1]
+    assert results["1"][4] == len(blocks)
+
+
+def test_overlap_accounting_identity():
+    """Every dispatched batch is drained, worker time splits exactly into
+    overlapped + awaited seconds, and nothing stays in flight after the
+    call returns."""
+    blocks = _one_call_walk()
+    st = pipeline.stats
+    assert st["dispatched"] == len(blocks)
+    assert st["drained"] == st["dispatched"]
+    assert st["cancelled"] == 0 and st["drains"] == 0
+    assert len(pipeline._INFLIGHT) == 0
+    assert st["worker_s"] > 0
+    # identity: worker_s = overlap_s + awaited-worker overlap residue;
+    # overlap can never exceed what the worker actually spent
+    assert 0.0 <= st["overlap_s"] <= st["worker_s"] + 1e-9
+    assert st["overlap_s"] + st["await_s"] >= st["worker_s"] - 1e-6
+    snap = pipeline._telemetry_provider()
+    assert snap["depth"] == 0
+    assert snap["overlap_ratio"] == round(st["overlap_s"] / st["worker_s"], 3)
+
+
+def test_speculative_dedup_engages_across_window(monkeypatch):
+    """A successor re-carrying the pending predecessor's aggregates hits
+    the in-flight key set (not yet committed to the memo): speculative
+    hits move pipeline ON, total dedup and results match the serial
+    path's byte for byte."""
+    spec, pre, blocks, final_root = _recarry_corpus()
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        results = {}
+        for mode in ("0", "1"):
+            monkeypatch.setenv("CSTPU_PIPELINE", mode)
+            _fresh_engine_env()
+            s = pre.copy()
+            stf.apply_signed_blocks(spec, s, blocks, True)
+            results[mode] = (bytes(s.hash_tree_root()),
+                             stf_verify.stats["memo_hits"],
+                             stf_verify.stats["entries"],
+                             stf.stats["fast_blocks"])
+            if mode == "1":
+                assert stf_verify.stats["speculative_hits"] > 0, \
+                    "re-carried aggregates never hit the in-flight key set"
+            else:
+                assert stf_verify.stats["speculative_hits"] == 0
+    finally:
+        bls.bls_active = prev
+    assert results["0"] == results["1"]
+    assert results["1"][0] == final_root
+
+
+def test_depth_bounded_by_window():
+    _one_call_walk()
+    assert 1 <= pipeline.stats["depth_max"] <= pipeline.window_depth() + 1
+
+
+def test_window_depth_env_gate(monkeypatch):
+    monkeypatch.delenv("CSTPU_PIPELINE_DEPTH", raising=False)
+    assert pipeline.window_depth() == 2
+    monkeypatch.setenv("CSTPU_PIPELINE_DEPTH", "1")
+    assert pipeline.window_depth() == 1
+    monkeypatch.setenv("CSTPU_PIPELINE_DEPTH", "0")
+    assert pipeline.window_depth() == 1  # clamped
+    monkeypatch.setenv("CSTPU_PIPELINE_DEPTH", "junk")
+    assert pipeline.window_depth() == 2
+
+
+def test_depth_one_window_still_byte_identical(monkeypatch):
+    """The minimal window (depth 1) is the same contract, less slack."""
+    spec, pre, blocks, roots = _corpus("phase0")
+    monkeypatch.setenv("CSTPU_PIPELINE_DEPTH", "1")
+    _fresh_engine_env()
+    s = pre.copy()
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        stf.apply_signed_blocks(spec, s, blocks, True)
+    finally:
+        bls.bls_active = prev
+    assert bytes(s.hash_tree_root()) == roots[-1]
+    assert stf.stats["fast_blocks"] == len(blocks)
+
+
+def test_serial_path_untouched_by_pipeline_counters(monkeypatch):
+    monkeypatch.setenv("CSTPU_PIPELINE", "0")
+    _one_call_walk()
+    assert pipeline.stats["dispatched"] == 0
+    assert stf_verify.stats["speculative_hits"] == 0

@@ -86,8 +86,13 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         name="resident column store",
         owner=("stf", "columns.py"),
         module="consensus_specs_tpu.stf.columns",
-        module_globals=frozenset({"_COLUMN_STORE"}),
-        producers=frozenset({"participation_column", "device_column"}),
+        # ISSUE 10 extends the store with the balance column (root-keyed
+        # + identity-pending fast path) and the generic device-buffer
+        # store serving registry/balance-derived kernel inputs
+        module_globals=frozenset({"_COLUMN_STORE", "_BALANCE_STORE",
+                                  "_BALANCE_PENDING", "_DEVICE_BUFFERS"}),
+        producers=frozenset({"participation_column", "device_column",
+                             "balance_column", "device_buffer"}),
         invalidators=frozenset({"reset_caches"}),
     ),
     CacheSpec(
@@ -96,6 +101,19 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         module="consensus_specs_tpu.stf.verify",
         module_globals=frozenset({"_VERIFIED_MEMO"}),
         invalidators=frozenset({"reset_memo"}),
+    ),
+    # the overlapped pipeline's bounded in-flight queue (ISSUE 10): only
+    # dispatch/wait/discard in the owner may move handles through it — a
+    # producer reaching in would break the depth bound and the
+    # drained-before-return invariant
+    CacheSpec(
+        name="pipeline in-flight queue",
+        owner=("stf", "pipeline.py"),
+        module="consensus_specs_tpu.stf.pipeline",
+        module_globals=frozenset({"_INFLIGHT"}),
+        # NO invalidators: nothing outside the owner may ever touch the
+        # queue (reset_stats does not drain it, so it must not pardon)
+        invalidators=frozenset(),
     ),
     CacheSpec(
         name="sync-committee seat memo",
@@ -109,8 +127,10 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         name="registry-columns cache",
         owner=("ops", "epoch_jax.py"),
         module="consensus_specs_tpu.ops.epoch_jax",
-        module_globals=frozenset({"_COLS_CACHE"}),
-        producers=frozenset({"registry_columns"}),
+        module_globals=frozenset({"_COLS_CACHE", "_MATCHING_SCAN_CACHE"}),
+        producers=frozenset({"registry_columns",
+                             "matching_target_attestations",
+                             "matching_head_attestations"}),
         invalidators=frozenset({"reset_caches"}),
     ),
     CacheSpec(

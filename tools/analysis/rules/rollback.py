@@ -46,16 +46,24 @@ PROTECTED_REGION: Dict[str, FrozenSet[str]] = {
         "_header", "_randao_collect", "_operations",
         "_attestations", "_attestations_inner",
         "_attestations_inner_altair",
+        # the overlapped pipeline (ISSUE 10): _collect_block is the
+        # factored host-phase body of _fast_transition; _begin_block
+        # snapshots the backing before it and restores it on failure;
+        # _unwind_pending restores a failed speculation's snapshot
+        # (successor rolled back first); _apply_pipelined is the loop
+        # that owns their ordering
+        "_apply_pipelined", "_begin_block", "_collect_block",
+        "_unwind_pending",
     }),
     "slot_roots.py": frozenset({"process_slots", "_process_slot"}),
     # sync.py's writers run only from _fast_transition, inside the
     # snapshot region (altair-lineage sync-aggregate rewards)
     "sync.py": frozenset({"process_sync_aggregate", "_apply_rewards"}),
-    # columns.py's only state writer is the staged-view flush (ISSUE 8):
+    # columns.py's state writers are the staged-view flushes (ISSUE 8/10):
     # called from _attestations_inner_altair (snapshot region) and the
-    # altair epoch phases (inside process_slots' epoch boundary, also
+    # epoch phases (inside process_slots' epoch boundary, also
     # snapshot-protected); the read-side helpers never write
-    "columns.py": frozenset({"flush"}),
+    "columns.py": frozenset({"flush", "flush_balances"}),
 }
 
 
