@@ -47,6 +47,12 @@ soak:
 soak-deep:
 	CSTPU_SOAK_DEEP=1 python -m pytest tests/soak -q
 
+# phase-attribution regression doctor (ISSUE 11): diff the two newest
+# bench snapshots (BENCH_DETAILS.json vs BENCH_DETAILS_PREV.json, or the
+# newest differing git version) and print ranked per-phase attribution
+doctor:
+	python tools/perf_doctor.py
+
 lint:
 	python tools/lint.py
 
@@ -77,4 +83,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE
+.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep doctor limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE

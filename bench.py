@@ -330,7 +330,7 @@ def bench_epoch_e2e_bls(results):
     # thread pool's per-run jitter would otherwise swing the recorded
     # headline by ~10%.  Root parity and no-silent-fallback are asserted
     # on EVERY pass, not just the winner.
-    t_e2e, engine_stats, verify_stats, telemetry_summary = \
+    t_e2e, engine_stats, verify_stats, telemetry_summary, phase_hists = \
         _best_cold_engine_pass(spec, state, signed_blocks, spec_post)
     bls.bls_active = False
 
@@ -371,6 +371,9 @@ def bench_epoch_e2e_bls(results):
         # counter-invariant telemetry (ISSUE 9): the trend gate reads
         # this subtree, so behavioral drift fails as loudly as a slowdown
         "telemetry": telemetry_summary,
+        # per-phase latency distributions (ISSUE 11): p50/p99 from the
+        # winning cold pass — tail regressions diff run over run
+        "phase_histograms": phase_hists,
         **phases,
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
@@ -385,17 +388,21 @@ def _best_cold_engine_pass(spec, state, signed_blocks, spec_post, passes=2):
     native decompression cache, committee geometry, resident columns all
     reset) with root parity + no-silent-fallback asserted per pass.
     Returns (seconds, engine-stats snapshot, verify-stats snapshot,
-    telemetry summary) of the winning pass so the reported phase
-    breakdown matches the reported value.
+    telemetry summary, phase-histogram summary) of the winning pass so
+    the reported phase breakdown matches the reported value.
 
     The flight recorder runs ENABLED through the measured passes (the
     headline is reported with telemetry on — ISSUE 9 acceptance); on a
     parity/fallback assertion failure the last-N timeline dumps to
-    TELEMETRY_FAIL.json so the broken run carries its own post-mortem."""
+    TELEMETRY_FAIL.json so the broken run carries its own post-mortem.
+    With ``CSTPU_TIMELINE=1`` armed (ISSUE 11) each pass starts with a
+    fresh timeline ring and the LAST pass's causal trace is exported as
+    Chrome trace-event JSON (``CSTPU_TIMELINE_OUT``, default
+    TRACE_E2E.json) — a Perfetto load shows the pipeline overlap."""
     from consensus_specs_tpu import stf
     from consensus_specs_tpu.stf import attestations as stf_attestations
     from consensus_specs_tpu.stf import verify as stf_verify
-    from consensus_specs_tpu.telemetry import recorder
+    from consensus_specs_tpu.telemetry import recorder, timeline
 
     was_recording = recorder.enabled()
     if not was_recording:
@@ -410,6 +417,8 @@ def _best_cold_engine_pass(spec, state, signed_blocks, spec_post, passes=2):
             stf.reset_stats()
             stf_verify.reset_memo()  # cold dedup memo: engine warms it itself
             stf_attestations.reset_caches()
+            if timeline.enabled():
+                timeline.reset()  # one pass per trace: no cross-pass flows
             s = state.copy()
             t, _ = _timed(stf.apply_signed_blocks, spec, s, signed_blocks, True)
             try:
@@ -429,11 +438,38 @@ def _best_cold_engine_pass(spec, state, signed_blocks, spec_post, passes=2):
                         {**stf.stats,
                          "replay_reasons": dict(stf.stats["replay_reasons"])},
                         dict(stf_verify.stats),
-                        _telemetry_summary())
+                        _telemetry_summary(),
+                        _histogram_summary())
+        if timeline.enabled():
+            # per-row default path so a full run keeps EVERY row's trace
+            # (the explicit env override is single-path: last row wins)
+            out = os.environ.get("CSTPU_TIMELINE_OUT") or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                f"TRACE_E2E_{spec.fork}_{len(state.validators)}.json")
+            timeline.dump_chrome_trace(out)
     finally:
         if not was_recording:
             recorder.disable()
     return best
+
+
+def _histogram_summary():
+    """Per-phase latency distribution of the pass that just finished
+    (ISSUE 11): p50/p99 + count per phase, compact enough to live in the
+    details row next to the sum-based phase breakdown — a tail
+    regression (p99 doubling while the sum moves by noise) becomes
+    diffable run over run, and perf_doctor reads exactly this key."""
+    from consensus_specs_tpu.telemetry import histogram
+
+    out = {}
+    for name, snap in histogram.snapshot().items():
+        out[name] = {
+            "count": snap["count"],
+            "p50_ms": round(snap["p50_s"] * 1e3, 3),
+            "p99_ms": round(snap["p99_s"] * 1e3, 3),
+            "max_ms": round(snap["max_s"] * 1e3, 3),
+        }
+    return out
 
 
 def _ratio(hits, misses):
@@ -556,7 +592,7 @@ def bench_epoch_e2e_bls_altair(results):
 
     # min-of-two fully-cold engine passes: same scheduling-noise control
     # and per-pass parity asserts as the phase0 row
-    t_e2e, engine_stats, verify_stats, telemetry_summary = \
+    t_e2e, engine_stats, verify_stats, telemetry_summary, phase_hists = \
         _best_cold_engine_pass(spec, state, signed_blocks, spec_post)
     bls.bls_active = False
 
@@ -600,6 +636,7 @@ def bench_epoch_e2e_bls_altair(results):
         "native_degraded": verify_stats["native_degraded"],
         # same counter-invariant telemetry subtree as the phase0 row
         "telemetry": telemetry_summary,
+        "phase_histograms": phase_hists,
         **phases,
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
@@ -1190,7 +1227,7 @@ def bench_e2e_scale_probe(results, n=1 << 20, row_key="epoch_e2e_scale_1m"):
     # same min-of-two fully-cold methodology + per-pass asserts as the
     # 400k rows (and the same helper), so scaling_vs_400k divides
     # like-measured quantities
-    t_e2e, engine_stats, _verify_stats, telemetry_summary = \
+    t_e2e, engine_stats, _verify_stats, telemetry_summary, phase_hists = \
         _best_cold_engine_pass(spec, state, signed_blocks, spec_post)
     bls.bls_active = False
 
@@ -1210,6 +1247,7 @@ def bench_e2e_scale_probe(results, n=1 << 20, row_key="epoch_e2e_scale_1m"):
         "engine_spec_root_parity": True,
         "replay_reasons": engine_stats["replay_reasons"],
         "telemetry": telemetry_summary,
+        "phase_histograms": phase_hists,
         **phases,
         "state_build_s": round(t_build_state, 3),
         "block_build_s": round(t_build_blocks, 3),
@@ -1310,13 +1348,55 @@ def newest_bench_snapshot(repo: str):
     return best
 
 
-def check_perf_trend(current: dict, previous, threshold: float = 0.15):
+def _perf_doctor():
+    """The phase-attribution doctor (tools/perf_doctor.py), imported
+    lazily with the tools dir on sys.path; None when unimportable — a
+    refusal must never depend on the doctor being loadable."""
+    try:
+        import perf_doctor
+        return perf_doctor
+    except Exception:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        try:
+            import perf_doctor
+            return perf_doctor
+        except Exception:
+            # ANY import failure (missing file, syntax error mid-edit):
+            # the gate's refusal must never depend on the doctor loading
+            return None
+
+
+def _doctor_attribution(current_details, previous_details):
+    """perf_doctor's one-line attribution for a regressed row pair, or
+    None when the rows aren't comparable (pre-ISSUE-11 snapshots, errored
+    rows) or the doctor can't load."""
+    if not (isinstance(current_details, dict)
+            and isinstance(previous_details, dict)):
+        return None
+    doctor = _perf_doctor()
+    if doctor is None:
+        return None
+    try:
+        return doctor.attribution_line(current_details, previous_details)
+    except Exception:  # attribution must never break the gate itself
+        return None
+
+
+def check_perf_trend(current: dict, previous, threshold: float = 0.15,
+                     previous_details=None):
     """Regression message when ``current`` (this run's headline row) is
     more than ``threshold`` slower than ``previous`` (the newest prior
     snapshot's parsed row); None when within budget or not comparable
     (different metric — e.g. a BENCH_VALIDATORS override — or a missing /
     unparseable snapshot).  Headline rows are seconds, so slower ==
-    larger."""
+    larger.
+
+    ``previous_details`` is the previous BENCH_DETAILS row for the same
+    metric: when given (and the phase subtrees are comparable) the
+    refusal message carries perf_doctor's ranked attribution — the gate
+    names the regressed phase instead of just the regression (ISSUE
+    11)."""
     if not previous or not isinstance(current, dict):
         return None
     if current.get("metric") != previous.get("metric"):
@@ -1327,10 +1407,23 @@ def check_perf_trend(current: dict, previous, threshold: float = 0.15):
         return None
     if prev <= 0 or cur <= prev * (1.0 + threshold):
         return None
-    return (f"perf-trend regression: {current['metric']} "
-            f"{cur:.3f}s vs {prev:.3f}s in the newest previous snapshot "
-            f"(+{(cur / prev - 1.0) * 100.0:.1f}% > "
-            f"{threshold * 100.0:.0f}% budget)")
+    msg = (f"perf-trend regression: {current['metric']} "
+           f"{cur:.3f}s vs {prev:.3f}s in the newest previous snapshot "
+           f"(+{(cur / prev - 1.0) * 100.0:.1f}% > "
+           f"{threshold * 100.0:.0f}% budget)")
+    attribution = _doctor_attribution(current, previous_details)
+    if attribution:
+        # the attribution baseline (the previous DETAILS row, the only
+        # snapshot carrying phases) can differ from the refusal baseline
+        # (the newest committed driver snapshot) — name it, so a drift
+        # that accumulated across uncommitted runs can't silently point
+        # the operator at a near-flat diff
+        try:
+            base = f" [vs the {float(previous_details['value']):.3f}s details row]"
+        except (KeyError, TypeError, ValueError):
+            base = ""
+        msg += f"\n  doctor: {attribution}{base}"
+    return msg
 
 
 def check_forkchoice_trend(current, previous, threshold: float = 0.15):
@@ -1526,6 +1619,12 @@ def main():
                       "epoch_e2e_scale_2m"):
         if preserved not in results and prev_details.get(preserved):
             results[preserved] = prev_details[preserved]
+    if prev_details:
+        # the outgoing details become the standing "previous snapshot":
+        # perf_doctor (and `make doctor`) diff BENCH_DETAILS.json against
+        # this file, so the attribution pair survives the overwrite below
+        with open(os.path.join(repo, "BENCH_DETAILS_PREV.json"), "w") as f:
+            json.dump(prev_details, f, indent=2)
     with open(details_path, "w") as f:
         json.dump(results, f, indent=2)
 
@@ -1579,7 +1678,16 @@ def main():
     # BENCH_SKIP_TREND=1 opts out (e.g. deliberately benchmarking a
     # degraded configuration).
     if os.environ.get("BENCH_SKIP_TREND") != "1":
-        regressions = [check_perf_trend(ns, newest_bench_snapshot(repo))]
+        # the headline's previous DETAILS row (same metric) powers the
+        # perf-doctor attribution inside the refusal message (ISSUE 11)
+        headline_prev_details = next(
+            (row for row in (prev_details.get("epoch_e2e_bls"),
+                             prev_details.get("north_star_epoch"))
+             if isinstance(row, dict)
+             and row.get("metric") == ns.get("metric")), None)
+        regressions = [check_perf_trend(
+            ns, newest_bench_snapshot(repo),
+            previous_details=headline_prev_details)]
         fc_regression = None
         if not QUICK:
             # non-headline gated rows: forkchoice ingest rotted silently
@@ -1598,7 +1706,8 @@ def main():
                     results.get(row_key), prev_details.get(row_key)))
             for row_key in ("epoch_e2e_scale_1m", "epoch_e2e_scale_2m"):
                 regressions.append(check_perf_trend(
-                    results.get(row_key), prev_details.get(row_key)))
+                    results.get(row_key), prev_details.get(row_key),
+                    previous_details=prev_details.get(row_key)))
         regressions = [r for r in regressions if r]
         if regressions:
             fc_row = results.get("forkchoice_batch_ingest")
@@ -1624,6 +1733,20 @@ def main():
                           file=sys.stderr)
             for regression in regressions:
                 print(regression, file=sys.stderr)
+            # exit-4 post-mortem (ISSUE 11): the full ranked
+            # phase-attribution for every comparable e2e row, so the
+            # refusal names WHERE the time went, not just that it did
+            doctor = _perf_doctor()
+            if doctor is not None:
+                for row_key in ("epoch_e2e_bls", "epoch_e2e_bls_altair",
+                                "epoch_e2e_scale_1m", "epoch_e2e_scale_2m"):
+                    try:
+                        diag = doctor.diagnose_row(
+                            results.get(row_key), prev_details.get(row_key))
+                        if diag is not None and diag["regressed"]:
+                            print(doctor.render(diag), file=sys.stderr)
+                    except Exception:
+                        pass  # attribution must never mask the refusal
             print("refusing to print the headline row; set "
                   "BENCH_SKIP_TREND=1 to bypass", file=sys.stderr)
             sys.exit(4)

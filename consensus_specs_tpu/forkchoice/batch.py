@@ -50,6 +50,7 @@ import numpy as np
 
 from consensus_specs_tpu import tracing
 from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.telemetry import timeline
 
 
 class StagedVotes(NamedTuple):
@@ -87,6 +88,15 @@ def ingest_attestations(
     if not attestations:
         return None
 
+    # one batch-level timeline span carrying the ingest volume: the
+    # index/reduce/stage tracing spans below auto-emit as its children
+    # when CSTPU_TIMELINE is armed (ISSUE 11)
+    with timeline.span("fc/ingest", atts=len(attestations)):
+        return _ingest_attestations(spec, store, attestations, is_from_block)
+
+
+def _ingest_attestations(spec, store, attestations, is_from_block):
+    """The ingest body (non-empty batch), under the caller's span."""
     # Validation + committee resolution, deduplicated by AttestationData
     # identity.  The dedup key is the data's immutable backing node:
     # unaggregated gossip shards one committee's data across hundreds of

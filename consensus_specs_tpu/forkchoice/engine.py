@@ -37,7 +37,7 @@ from __future__ import annotations
 import numpy as np
 
 from consensus_specs_tpu import faults, telemetry, tracing
-from consensus_specs_tpu.telemetry import recorder
+from consensus_specs_tpu.telemetry import recorder, timeline
 
 from . import batch
 from .proto_array import ProtoArray
@@ -195,7 +195,12 @@ class ForkChoiceEngine:
         if recorder.enabled():
             recorder.record("fc_on_block",
                             slot=int(signed_block.message.slot))
-        with tracing.span("forkchoice/on_block"):
+        # the tracing span auto-emits a timeline event; the explicit span
+        # adds the slot field so a Perfetto read can line the fork-choice
+        # track up against the stf block flow (ISSUE 11)
+        with timeline.span("fc/on_block",
+                           slot=int(signed_block.message.slot)), \
+                tracing.span("forkchoice/on_block"):
             _SITE_ON_BLOCK()  # pre-mutation: a fault leaves store + proto as-is
             try:
                 self.spec.on_block(self.store, signed_block)
@@ -213,7 +218,8 @@ class ForkChoiceEngine:
         partially-applied vote deltas."""
         stats["on_attestations"] += 1
         stats["attestations_ingested"] += len(attestations)
-        with tracing.span("forkchoice/on_attestations"):
+        with timeline.span("fc/on_attestations", n=len(attestations)), \
+                tracing.span("forkchoice/on_attestations"):
             try:
                 staged = batch.ingest_attestations(
                     self.spec, self.store, attestations, is_from_block)

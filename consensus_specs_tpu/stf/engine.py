@@ -50,7 +50,7 @@ import sys
 import time
 
 from consensus_specs_tpu import faults, telemetry, tracing
-from consensus_specs_tpu.telemetry import recorder
+from consensus_specs_tpu.telemetry import histogram, recorder, timeline
 
 from . import columns, pipeline, slot_roots, staging, sync, verify
 from .attestations import (
@@ -136,6 +136,9 @@ def reset_stats() -> None:
     stats["breaker_state"] = "closed"
     verify.reset_stats()
     pipeline.reset_stats()
+    # per-phase latency distributions reset with the counters they
+    # attribute, so a bench pass's p50/p99 describe exactly that pass
+    histogram.reset()
 
 
 def _count_reason(reason: str) -> None:
@@ -236,9 +239,11 @@ def _replay_breaker_open(spec, state, signed_block, validate_result: bool,
 
 
 def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
-    # flight-recorder gate hoisted once per block: the per-event field
-    # computation (slot reads, stats deltas) is paid only while recording
+    # flight-recorder + timeline gates hoisted once per block: per-event
+    # field computation (slot reads, stats deltas, link ids) is paid only
+    # while an observer is armed
     rec = recorder.enabled()
+    link = timeline.next_link() if timeline.enabled() else None
     if not _breaker_allows_attempt():
         _replay_breaker_open(spec, state, signed_block, validate_result, rec)
         return
@@ -251,7 +256,8 @@ def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
             raise FastPathViolation(
                 "fast path covers phase0/altair/bellatrix + native BLS")
         with staging.block_transaction():
-            _fast_transition(spec, state, signed_block, validate_result)
+            _fast_transition(spec, state, signed_block, validate_result,
+                             link=link)
             # the commit itself is a probed seam: a torn commit rolls the
             # staged entries back and the block replays literally
             _SITE_CACHE_COMMIT()
@@ -266,6 +272,9 @@ def _apply_one(spec, state, signed_block, validate_result: bool) -> None:
                             **_block_delta(snap))
     except Exception as exc:
         state.set_backing(pre_backing)
+        # the fast-path spans describe work that just rolled back: mark
+        # the block's flow cancelled before the literal replay re-does it
+        timeline.cancel_link(link)
         _replay_literal(spec, state, signed_block, validate_result, exc, rec)
 
 
@@ -319,7 +328,7 @@ def _block_delta(snap: dict) -> dict:
 
 
 def _collect_block(spec, state, signed_block, validate_result: bool,
-                   spec_keys) -> tuple:
+                   spec_keys, link=None) -> tuple:
     """One block's host phases: slot advancement, header/RANDAO/eth1,
     operations with the vectorized attestation path, sync aggregate —
     every state mutation of the fast path, with the block's signature
@@ -329,15 +338,20 @@ def _collect_block(spec, state, signed_block, validate_result: bool,
     it.  ``spec_keys`` is the pending predecessor's dispatched key set —
     triples it is already verifying are skipped speculatively
     (verify.note_speculative_hit; safe because any predecessor failure
-    drains this block too)."""
+    drains this block too).  ``link`` is the block's timeline causality
+    id: every host-phase span carries it, so the Chrome-trace export can
+    chain this block's flow across threads (None with the timeline
+    off)."""
     from consensus_specs_tpu.crypto import bls
 
     block = signed_block.message
     altair_lineage = spec.fork != "phase0"
     t0 = time.perf_counter()
-    slot_roots.process_slots(spec, state, block.slot)
+    with timeline.span("host/slot_roots", link=link, slot=int(block.slot)):
+        slot_roots.process_slots(spec, state, block.slot)
     t1 = time.perf_counter()
     stats["slot_roots_s"] += t1 - t0
+    histogram.observe("slot_roots", t1 - t0)
 
     bls_on = bls.bls_active
     entries, keys = [], []
@@ -360,43 +374,51 @@ def _collect_block(spec, state, signed_block, validate_result: bool,
     # altair.py:405-410, bellatrix.py:242-249): header/RANDAO/attestations/
     # sync aggregate run the vectorized or collect-don't-verify variants
     # below; the remaining operations are the spec's own functions
-    _header(spec, state, block)
-    if spec.fork == "bellatrix" and spec.is_execution_enabled(state, block.body):
-        # [New in Bellatrix] — literal, inside the snapshot-protected
-        # region: payload checks raise straight into the replay contract
-        spec.process_execution_payload(
-            state, block.body.execution_payload, spec.EXECUTION_ENGINE)
-    _randao_collect(spec, state, block.body, collect, bls_on)
-    spec.process_eth1_data(state, block.body)
-    t3 = time.perf_counter()
-    # _attestations times itself into attestation_apply_s; the remaining
-    # operations (slashings, deposits, exits) belong to other_s so a
-    # regression in e.g. process_deposit localizes honestly
-    apply_before = stats["attestation_apply_s"]
-    _operations(spec, state, block.body, collect, bls_on, altair_lineage)
-    t4 = time.perf_counter()
+    with timeline.span("host/operations", link=link):
+        _header(spec, state, block)
+        if spec.fork == "bellatrix" and spec.is_execution_enabled(state, block.body):
+            # [New in Bellatrix] — literal, inside the snapshot-protected
+            # region: payload checks raise straight into the replay contract
+            spec.process_execution_payload(
+                state, block.body.execution_payload, spec.EXECUTION_ENGINE)
+        _randao_collect(spec, state, block.body, collect, bls_on)
+        spec.process_eth1_data(state, block.body)
+        t3 = time.perf_counter()
+        # _attestations times itself into attestation_apply_s; the remaining
+        # operations (slashings, deposits, exits) belong to other_s so a
+        # regression in e.g. process_deposit localizes honestly
+        apply_before = stats["attestation_apply_s"]
+        _operations(spec, state, block.body, collect, bls_on, altair_lineage)
+        t4 = time.perf_counter()
     non_attestation_ops = (t4 - t3) - (stats["attestation_apply_s"] - apply_before)
     if altair_lineage:
-        sync.process_sync_aggregate(
-            spec, state, block.body.sync_aggregate, collect, bls_on)
+        with timeline.span("host/sync_aggregate", link=link):
+            sync.process_sync_aggregate(
+                spec, state, block.body.sync_aggregate, collect, bls_on)
     t4s = time.perf_counter()
     stats["sync_apply_s"] += t4s - t4
+    if altair_lineage:
+        histogram.observe("sync_apply", t4s - t4)
     stats["sig_verify_s"] += t2 - t1
     stats["other_s"] += (t3 - t2) + non_attestation_ops
     return entries, keys, t4s
 
 
-def _fast_transition(spec, state, signed_block, validate_result: bool) -> None:
+def _fast_transition(spec, state, signed_block, validate_result: bool,
+                     link=None) -> None:
     """Serial settlement (pipeline OFF / re-entrant calls): host phases,
     then the one synchronous multi-pairing, then the post-state root."""
     entries, keys, t4s = _collect_block(
-        spec, state, signed_block, validate_result, None)
-    bad = verify.settle(entries, keys)
+        spec, state, signed_block, validate_result, None, link=link)
+    bad = verify.settle(entries, keys, link=link)
     if bad is not None:
         raise FastPathViolation(f"invalid signature (batch entry {bad})")
     t5 = time.perf_counter()
+    histogram.observe("sig_verify", t5 - t4s)
     if validate_result:
-        computed = _SITE_STATE_ROOT(bytes(slot_roots.state_root(spec, state)))
+        with timeline.span("host/state_root", link=link):
+            computed = _SITE_STATE_ROOT(
+                bytes(slot_roots.state_root(spec, state)))
         if bytes(signed_block.message.state_root) != computed:
             raise FastPathViolation("state root mismatch")
     t6 = time.perf_counter()
@@ -413,9 +435,10 @@ class _Speculation:
     unwind it (open transaction + backing snapshot + literal replay)."""
 
     __slots__ = ("signed_block", "slot", "index", "pre_backing", "txn",
-                 "handle", "keys_set", "rec_delta")
+                 "handle", "keys_set", "rec_delta", "link")
 
-    def __init__(self, signed_block, pre_backing, txn, handle, keys_set):
+    def __init__(self, signed_block, pre_backing, txn, handle, keys_set,
+                 link=None):
         self.signed_block = signed_block
         self.slot = int(signed_block.message.slot)
         self.index = -1  # position in the call's block list (set by the loop)
@@ -424,10 +447,11 @@ class _Speculation:
         self.handle = handle
         self.keys_set = keys_set
         self.rec_delta = None
+        self.link = link  # timeline causality id (None with timeline off)
 
 
 def _begin_block(spec, state, signed_block, validate_result: bool,
-                 spec_keys, rec: bool) -> _Speculation:
+                 spec_keys, rec: bool, link=None) -> _Speculation:
     """Apply one block's host phases under a fresh (open) cache
     transaction and dispatch its signature batch; the post-state root is
     checked here (its inputs are complete — only the verdict is
@@ -440,9 +464,9 @@ def _begin_block(spec, state, signed_block, validate_result: bool,
     handle = None
     try:
         entries, keys, t4s = _collect_block(
-            spec, state, signed_block, validate_result, spec_keys)
+            spec, state, signed_block, validate_result, spec_keys, link=link)
         if entries:
-            handle = pipeline.dispatch(entries)
+            handle = pipeline.dispatch(entries, link=link)
             # the memo commit stays deferred through the block's own
             # transaction: it runs only at commit_block, after the
             # verdict — speculated verification never leaks into a
@@ -451,8 +475,9 @@ def _begin_block(spec, state, signed_block, validate_result: bool,
         t5 = time.perf_counter()
         stats["sig_verify_s"] += t5 - t4s
         if validate_result:
-            computed = _SITE_STATE_ROOT(
-                bytes(slot_roots.state_root(spec, state)))
+            with timeline.span("host/state_root", link=link):
+                computed = _SITE_STATE_ROOT(
+                    bytes(slot_roots.state_root(spec, state)))
             if bytes(signed_block.message.state_root) != computed:
                 raise FastPathViolation("state root mismatch")
             stats["other_s"] += time.perf_counter() - t5
@@ -460,11 +485,13 @@ def _begin_block(spec, state, signed_block, validate_result: bool,
         pipeline.discard(handle)
         staging.rollback_block(txn)
         state.set_backing(pre_backing)
+        timeline.cancel_link(link)
         raise
     finally:
         staging.deactivate(txn)
     pend = _Speculation(signed_block, pre_backing, txn, handle,
-                        frozenset(keys) if keys else frozenset())
+                        frozenset(keys) if keys else frozenset(),
+                        link=link)
     if rec:
         # host-phase attribution captured NOW (the block's own work);
         # the settlement await is added at finish so the recorded block
@@ -480,13 +507,22 @@ def _finish_speculation(pend: _Speculation, rec: bool):
     (LIFO), because blocks may already be speculated on top."""
     a0 = pipeline.stats["await_s"]
     try:
-        bad = (pipeline.wait(pend.handle)
-               if pend.handle is not None else None)
+        with timeline.span("host/await_verdict", link=pend.link):
+            bad = (pipeline.wait(pend.handle)
+                   if pend.handle is not None else None)
     except Exception as exc:
         return exc
     finally:
         awaited = pipeline.stats["await_s"] - a0
         stats["sig_verify_s"] += awaited
+        histogram.observe("pipeline_await", awaited)
+        if pend.handle is not None:
+            # the sig_verify DISTRIBUTION keeps one meaning pipeline ON
+            # or OFF: the batch's true wall time on the native backend
+            # (the worker span), not the non-overlapped remainder the
+            # cumulative sig_verify_s counter attributes
+            ws = pend.handle.worker_span
+            histogram.observe("sig_verify", max(0.0, ws[1] - ws[0]))
         if pend.rec_delta is not None:
             pend.rec_delta["sig_verify_s"] = round(
                 pend.rec_delta["sig_verify_s"] + awaited, 6)
@@ -502,6 +538,7 @@ def _finish_speculation(pend: _Speculation, rec: bool):
     stats["fast_blocks"] += 1
     _breaker_note_success()
     tracing.count("stf.fast_block")
+    timeline.instant("commit", link=pend.link, slot=pend.slot)
     if rec and pend.rec_delta is not None:
         recorder.record("block_fast", slot=pend.slot, **pend.rec_delta)
     return None
@@ -566,6 +603,8 @@ def _apply_pipelined(spec, state, signed_blocks, validate_result: bool):
         having ridden a state that no longer exists)."""
         if drain_reason is not None and window:
             pipeline.note_drain(drain_reason)
+            timeline.instant("pipeline_drain", link=window[0].link,
+                             reason=drain_reason)
             if rec:
                 recorder.record("pipeline_drain", reason=drain_reason,
                                 slot=window[0].slot)
@@ -577,6 +616,8 @@ def _apply_pipelined(spec, state, signed_blocks, validate_result: bool):
                 continue
             if drain_reason is None:
                 pipeline.note_drain("verdict_failed")
+                timeline.instant("pipeline_drain", link=pend.link,
+                                 reason="verdict_failed")
                 if rec:
                     recorder.record("pipeline_drain",
                                     reason="verdict_failed",
@@ -584,6 +625,10 @@ def _apply_pipelined(spec, state, signed_blocks, validate_result: bool):
             for newer in reversed(window[1:]):
                 pipeline.discard(newer.handle)
                 staging.rollback_block(newer.txn)
+            # one ring pass marks the WHOLE drained window cancelled —
+            # the failing block included (_unwind_pending no longer
+            # rescans for it)
+            timeline.cancel_links([n.link for n in window])
             del window[:]
             _unwind_pending(state, pend)
             _replay_literal(spec, state, pend.signed_block,
@@ -639,9 +684,10 @@ def _apply_pipelined(spec, state, signed_blocks, validate_result: bool):
             continue
         spec_keys = (frozenset().union(*(p.keys_set for p in window))
                      if window else None)
+        link = timeline.next_link() if timeline.enabled() else None
         try:
             cur = _begin_block(spec, state, signed_block, validate_result,
-                               spec_keys, rec)
+                               spec_keys, rec, link=link)
         except Exception as exc_begin:
             # the partial current block is already unwound; settle its
             # predecessors first (sequential order), then replay it
@@ -752,7 +798,9 @@ def _attestations(spec, state, attestations, collect, bls_on,
         else:
             _attestations_inner(spec, state, attestations, collect, bls_on)
     finally:
-        stats["attestation_apply_s"] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        stats["attestation_apply_s"] += dt
+        histogram.observe("attestation_apply", dt)
 
 
 def _attester_domains(spec, state, resolver) -> dict:
@@ -980,7 +1028,9 @@ def _attestations_inner_altair(spec, state, attestations, collect, bls_on) -> No
         # sequential += would have (increments are non-negative)
         state.balances[proposer_index] = spec.Gwei(
             int(state.balances[proposer_index]) + proposer_reward_total)
-    stats["mirror_flush_s"] += time.perf_counter() - t_apply
+    dt_flush = time.perf_counter() - t_apply
+    stats["mirror_flush_s"] += dt_flush
+    histogram.observe("mirror_flush", dt_flush)
 
 
 # -- telemetry ----------------------------------------------------------------

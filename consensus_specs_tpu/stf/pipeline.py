@@ -50,6 +50,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence
 
 from consensus_specs_tpu import faults, telemetry, tracing
+from consensus_specs_tpu.telemetry import timeline
 
 from . import verify
 
@@ -117,10 +118,12 @@ class SigBatchHandle:
     """One in-flight signature batch: the future plus enough accounting
     to attribute its wall time as overlapped or awaited."""
 
-    __slots__ = ("future", "entries", "t_dispatch", "worker_span", "_done")
+    __slots__ = ("future", "entries", "link", "t_dispatch", "worker_span",
+                 "_done")
 
-    def __init__(self, entries):
+    def __init__(self, entries, link=None):
         self.entries = entries
+        self.link = link  # the block's causality-link id (timeline)
         self.t_dispatch = time.perf_counter()
         self.worker_span = [0.0, 0.0]  # [start, end], written by the worker
         self._done = False
@@ -129,23 +132,31 @@ class SigBatchHandle:
     def _run(self):
         span = self.worker_span
         span[0] = time.perf_counter()
+        # the worker's span carries the dispatching block's link, so the
+        # Chrome-trace export draws the cross-thread edge host phases →
+        # native verify (the PR 10 overlap made visible)
+        sid = timeline.begin("native/verify", link=self.link,
+                             entries=len(self.entries))
         try:
             return verify.first_invalid(self.entries)
         finally:
+            timeline.end(sid)
             span[1] = time.perf_counter()
 
 
-def dispatch(entries: Sequence[verify.SigEntry]) -> SigBatchHandle:
+def dispatch(entries: Sequence[verify.SigEntry],
+             link=None) -> SigBatchHandle:
     """Submit a materialized batch to the dispatch worker.  Entries must
     be fully materialized (affine buffers built) — the worker touches
     pure data plus the native call, never the geometry caches.  The
     sig-batch tracing counts land HERE (host side; ``verify.settle``
     emits them on the serial path), keeping the worker tracing-free and
-    the counters alive pipeline ON or OFF."""
+    the counters alive pipeline ON or OFF.  ``link`` is the dispatching
+    block's timeline causality id (None with the timeline off)."""
     _SITE_DISPATCH()
     tracing.count("stf.sig_batch")
     tracing.count("stf.sig_batch.entries", len(entries))
-    handle = SigBatchHandle(list(entries))
+    handle = SigBatchHandle(list(entries), link=link)
     _INFLIGHT.append(handle)
     stats["dispatched"] += 1
     stats["depth_max"] = max(stats["depth_max"], len(_INFLIGHT))

@@ -30,7 +30,7 @@ import hashlib
 from typing import List, Optional, Sequence, Tuple
 
 from consensus_specs_tpu import faults, telemetry, tracing
-from consensus_specs_tpu.telemetry import recorder
+from consensus_specs_tpu.telemetry import recorder, timeline
 
 from . import staging
 
@@ -237,7 +237,7 @@ def first_invalid(entries: Sequence[SigEntry], seed: bytes = None) -> Optional[i
 
 
 def settle(entries: List[SigEntry], keys: List[bytes],
-           seed: bytes = None) -> Optional[int]:
+           seed: bytes = None, link=None) -> Optional[int]:
     """Settle a block's collected signature checks; None on success, else
     the index (in call order) of the first invalid entry.
 
@@ -246,12 +246,16 @@ def settle(entries: List[SigEntry], keys: List[bytes],
     through the block's cache transaction when one is active, so the
     commit lands only after the WHOLE block settles (including the
     post-state root check), never on the strength of a block that then
-    rolled back."""
+    rolled back.  ``link`` is the block's timeline causality id: the
+    serial path's native multi-pairing gets the same ``native/verify``
+    span the pipelined worker emits, so traces read identically pipeline
+    ON or OFF."""
     if not entries:
         return None
     tracing.count("stf.sig_batch")
     tracing.count("stf.sig_batch.entries", len(entries))
-    bad = first_invalid(entries, seed=seed)
+    with timeline.span("native/verify", link=link, entries=len(entries)):
+        bad = first_invalid(entries, seed=seed)
     if bad is not None:
         return bad
     staging.defer(_commit_keys, keys)

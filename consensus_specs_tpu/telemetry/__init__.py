@@ -16,8 +16,16 @@ observability, grown from ``tracing.py`` into three cooperating layers):
   handler activity) that costs nothing disabled and ``dump()``s a JSON
   post-mortem on failure.
 
-Layer 3, the soak-endurance harness, lives in ``telemetry.soak`` (run
-via ``make soak``) and consumes the other two: long seeded walks under
+* **timeline + histograms** (ISSUE 11) — the causal trace timeline: a
+  bounded ring of begin/end span events with thread identity and
+  explicit causality links (block seq → dispatch → native verify →
+  drain/commit), ``CSTPU_TIMELINE``-gated and exportable as Chrome
+  trace-event JSON (``telemetry.timeline.dump_chrome_trace``); and
+  fixed-bucket log2 latency histograms with p50/p90/p99 per phase
+  (``telemetry.histogram``), both on the bus.
+
+Layer 4, the soak-endurance harness, lives in ``telemetry.soak`` (run
+via ``make soak``) and consumes the others: long seeded walks under
 fault schedules with breaker-recovery/cache-coherence/memory-flatness
 asserts and a ``SOAK.json`` timeline artifact.
 
@@ -31,13 +39,13 @@ from __future__ import annotations
 
 import sys
 
-from . import metrics, recorder, registry
+from . import histogram, metrics, recorder, registry, timeline
 from .recorder import record
 from .registry import register_provider, snapshot
 
 __all__ = [
-    "metrics", "recorder", "record", "register_provider", "registry",
-    "snapshot",
+    "histogram", "metrics", "recorder", "record", "register_provider",
+    "registry", "snapshot", "timeline",
 ]
 
 
@@ -75,3 +83,7 @@ register_provider("tracing", _tracing_provider, replace=True)
 register_provider("native.bls", _native_provider, replace=True)
 register_provider("faults", _faults_provider, replace=True)
 register_provider("flight_recorder", recorder.stats, replace=True)
+# ISSUE 11: the causal-timeline ring's health and the per-phase latency
+# distributions ride the same bus as every other producer
+register_provider("timeline", timeline.stats, replace=True)
+register_provider("histograms", histogram.snapshot, replace=True)

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Dict
 
+from . import timeline
+
 _enabled = False
 _LOCK = threading.Lock()
 _spans: Dict[str, list] = {}  # name -> [count, total_s]
@@ -70,24 +72,37 @@ def _stack() -> list:
 def span(name: str):
     """Nested wall-time span; keys are '/'-joined paths.  Nesting is
     per-thread: concurrent spans from different threads each build their
-    own path, and the aggregate mutation is lock-guarded."""
-    if not _enabled:
+    own path, and the aggregate mutation is lock-guarded.
+
+    Every span site doubles as a causal-timeline emitter (ISSUE 11):
+    with ``CSTPU_TIMELINE`` armed, the same begin/end lands as paired
+    timeline events — existing ``tracing.span`` callsites feed the
+    Chrome-trace export without touching a line of producer code.  The
+    nesting stack builds the same '/'-joined key either way, so a span's
+    exported name is identical whether the metrics layer is on or the
+    timeline alone is.  Both layers disabled, the cost stays two
+    module-global loads and a truth check."""
+    tl = timeline.enabled()
+    if not _enabled and not tl:
         yield
         return
     stack = _stack()
     stack.append(name)
     key = "/".join(stack)
-    t0 = time.perf_counter()
+    sid = timeline.begin(key) if tl else 0
+    t0 = time.perf_counter() if _enabled else 0.0
     try:
         yield
     finally:
-        dt = time.perf_counter() - t0
-        with _LOCK:
-            rec = _spans.get(key)
-            if rec is None:
-                rec = _spans[key] = [0, 0.0]
-            rec[0] += 1
-            rec[1] += dt
+        if _enabled:
+            dt = time.perf_counter() - t0
+            with _LOCK:
+                rec = _spans.get(key)
+                if rec is None:
+                    rec = _spans[key] = [0, 0.0]
+                rec[0] += 1
+                rec[1] += dt
+        timeline.end(sid)
         stack.pop()
 
 

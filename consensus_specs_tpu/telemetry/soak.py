@@ -22,8 +22,13 @@ claim vacuously) and clean, with four endurance assertions:
   entry;
 * **memory flatness** — after every epoch, each bounded cache/ring
   (attestation plans, geometry memos, verified triples, resident
-  columns, sync seats, the flight-recorder ring) is sampled off the
-  telemetry bus and must sit at or under its registered cap.
+  columns, sync seats, the flight-recorder ring, the causal-timeline
+  ring) is sampled off the telemetry bus and must sit at or under its
+  registered cap; AND the process RSS itself is sampled per epoch
+  (ISSUE 11, the ROADMAP item-3 follow-up) — cap checks prove each
+  *known* structure is bounded, the RSS series proves nothing UNKNOWN
+  is growing either.  After a warmup quarter the walk's RSS must stay
+  within a bounded-growth budget of its warmup level.
 
 The run emits ``SOAK.json``: profile, per-epoch cache samples, the
 engine/verify counters, the full telemetry snapshot, and the flight
@@ -63,6 +68,54 @@ _SOAK_KINDS = ("error", "corrupt")
 
 class SoakFailure(AssertionError):
     """An endurance assertion failed; SOAK.json carries the post-mortem."""
+
+
+# RSS flatness budget: after the warmup quarter (caches filling, native
+# pools spinning up), growth to the END of the walk must stay under
+# max(_RSS_BUDGET_MB, _RSS_BUDGET_FRAC * warmup level) — loose enough
+# that allocator noise and page-cache jitter never flake the gate,
+# tight enough that a leaked per-epoch structure (the failure mode cap
+# checks cannot see) trips it within one soak
+_RSS_BUDGET_MB = 128.0
+_RSS_BUDGET_FRAC = 0.25
+
+
+def process_rss_mb() -> Optional[float]:
+    """Current resident-set size of this process in MB — /proc-based on
+    Linux (current residency, the flatness signal), falling back to
+    ru_maxrss (peak; still monotone-growth-detecting) elsewhere; None
+    when neither source works (the flatness assert then skips rather
+    than flaking)."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        return rss_pages * os.sysconf("SC_PAGE_SIZE") / (1024.0 * 1024.0)
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    except Exception:
+        return None
+
+
+def rss_flatness(samples) -> Optional[dict]:
+    """The bounded-growth verdict over a per-epoch RSS series: compares
+    the end of the walk against the post-warmup level (first quarter,
+    minimum one epoch) and returns {baseline_mb, final_mb, growth_mb,
+    budget_mb, flat}; None when the series is too short or unsampled."""
+    series = [s for s in samples if s is not None]
+    if len(series) < 2:
+        return None
+    warmup = max(1, len(series) // 4)
+    baseline = min(series[warmup - 1:warmup + 1])
+    final = series[-1]
+    budget = max(_RSS_BUDGET_MB, _RSS_BUDGET_FRAC * baseline)
+    growth = final - baseline
+    return {"baseline_mb": round(baseline, 1), "final_mb": round(final, 1),
+            "growth_mb": round(growth, 1), "budget_mb": round(budget, 1),
+            "warmup_epochs": warmup, "flat": growth <= budget}
 
 
 def _repo_root() -> str:
@@ -147,6 +200,11 @@ def bounded_cache_sizes() -> List[dict]:
          "size": sync.get("rows_memo_size", 0), "cap": sync.get("cap", 0)},
         {"name": "flight_recorder.ring", "size": ring.get("events", 0),
          "cap": ring.get("cap", 0)},
+        # the causal-timeline ring (ISSUE 11): bounded like the flight
+        # recorder's, flatness-asserted the same way
+        {"name": "timeline.ring",
+         "size": providers.get("timeline", {}).get("events", 0),
+         "cap": providers.get("timeline", {}).get("cap", 0)},
     ]
     for key in ("ctx_size", "ctx_lookup_size", "plan_ctx_lookup_size",
                 "active_size", "proposer_size"):
@@ -221,6 +279,7 @@ def _soak_fork(fork: str, epochs: int, seed: int, report: dict) -> dict:
             section["fired"].extend(
                 [site, hit, kind] for site, hit, kind in plan.fired)
         sample = {"epoch": e, "sizes": bounded_cache_sizes(),
+                  "rss_mb": process_rss_mb(),
                   "breaker_state": stf.stats["breaker_state"]}
         section["cache_samples"].append(sample)
         for entry in sample["sizes"]:
@@ -228,6 +287,19 @@ def _soak_fork(fork: str, epochs: int, seed: int, report: dict) -> dict:
                 _fail(report, section,
                       f"{fork}: {entry['name']} grew past its cap after "
                       f"epoch {e}: {entry['size']} > {entry['cap']}")
+
+    # RSS flatness (ISSUE 11): the per-epoch series must show bounded
+    # growth past warmup — cap checks bound every KNOWN structure, this
+    # catches a leak in anything the bus doesn't know about
+    section["rss_flatness"] = rss_flatness(
+        [s["rss_mb"] for s in section["cache_samples"]])
+    if section["rss_flatness"] is not None \
+            and not section["rss_flatness"]["flat"]:
+        rf = section["rss_flatness"]
+        _fail(report, section,
+              f"{fork}: process RSS grew {rf['growth_mb']} MB past the "
+              f"post-warmup level ({rf['baseline_mb']} MB), over the "
+              f"{rf['budget_mb']} MB flatness budget")
 
     section["walk_stats"] = {
         **{k: stf.stats[k] for k in

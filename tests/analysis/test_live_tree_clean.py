@@ -168,6 +168,18 @@ def test_ef01_mutation_turns_red(gate):
     assert any(f.code == "EF01" for f in found), found
 
 
+def test_ob01_unclosed_span_mutation_turns_red(gate):
+    # a leaky raw timeline.begin next to the pipeline's real probe sites
+    # (ISSUE 11): no finally-end, no escape — the span-leak check fires
+    rel = "consensus_specs_tpu/stf/pipeline.py"
+    found = _mutated(gate, {rel: lambda t: t + (
+        "\n\ndef leaky_probe(entries):\n"
+        "    sid = timeline.begin('probe')\n"
+        "    return verify.first_invalid(entries)\n")})
+    assert any(f.code == "OB01" and "finally" in f.message
+               for f in found), found
+
+
 def test_cc01_cross_file_passthrough_mutation_turns_red(gate):
     # the call-graph-aware half of CC01: a helper in ANOTHER file passes
     # the registry-columns producer's cached dict through; mutating its
@@ -209,5 +221,5 @@ def test_dt01_cross_file_callsite_mutation_turns_red(gate):
 def test_registry_covers_every_mutation_code():
     # every rule family proven red above is a registered plugin
     for code in ("FC01", "DT01", "CC01", "RB01", "JX01", "ST01",
-                 "HD01", "SH01", "EF01"):
+                 "HD01", "SH01", "EF01", "OB01"):
         assert code in REGISTRY, code

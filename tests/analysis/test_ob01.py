@@ -1,7 +1,9 @@
-"""OB01 flight-recorder discipline: the ring is written only through
-``telemetry.record``, and commit-class events in fault-probed modules
-are never recorded inside a still-open block transaction (a rolled-back
-block must not log a commit that never happened)."""
+"""OB01 observability-event discipline: the recorder/timeline rings are
+written only through their APIs, a raw ``timeline.begin`` is closed on
+every exit path (or escapes to an owner), and commit-class events in
+fault-probed modules are never recorded inside a still-open block
+transaction (a rolled-back block must not log a commit that never
+happened)."""
 from analysis import analyze_text
 
 
@@ -11,7 +13,7 @@ def ob01(path, src):
 
 _HEADER = ("from consensus_specs_tpu import faults, telemetry\n"
            "from consensus_specs_tpu.stf import staging\n"
-           "from consensus_specs_tpu.telemetry import recorder\n"
+           "from consensus_specs_tpu.telemetry import recorder, timeline\n"
            "_SITE = faults.site('stf.x.probe')\n")
 
 
@@ -82,3 +84,68 @@ def test_ob01_record_via_recorder_module_alias_is_also_judged():
                      "        recorder.record('memo_commit')\n")
     found = ob01("consensus_specs_tpu/stf/x.py", src)
     assert [f.line for f in found] == [8]
+
+
+# -- ISSUE 11 extension: timeline ring + unclosed-span leak -------------------
+
+
+def test_ob01_flags_direct_timeline_ring_append():
+    src = _HEADER + ("def leak(event):\n"
+                     "    timeline._EVENTS.append(event)\n")
+    found = ob01("consensus_specs_tpu/stf/x.py", src)
+    assert [f.line for f in found] == [6]
+    assert "observability ring" in found[0].message
+
+
+def test_ob01_timeline_ring_reads_and_invalidations_are_legal():
+    src = _HEADER + ("def peek():\n"
+                     "    timeline._EVENTS.clear()\n"
+                     "    return list(timeline._EVENTS)\n")
+    assert ob01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ob01_flags_unclosed_begin():
+    # a begin whose end is NOT in a finally: the exception path leaks
+    src = _HEADER + ("def phase(block):\n"
+                     "    sid = timeline.begin('host/phase')\n"
+                     "    do_work(block)\n"
+                     "    timeline.end(sid)\n")
+    found = ob01("consensus_specs_tpu/stf/x.py", src)
+    assert [f.line for f in found] == [6]
+    assert "finally" in found[0].message
+
+
+def test_ob01_begin_with_finally_end_is_legal():
+    src = _HEADER + ("def phase(block):\n"
+                     "    sid = timeline.begin('host/phase')\n"
+                     "    try:\n"
+                     "        do_work(block)\n"
+                     "    finally:\n"
+                     "        timeline.end(sid)\n")
+    assert ob01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ob01_span_context_manager_is_legal():
+    src = _HEADER + ("def phase(block):\n"
+                     "    with timeline.span('host/phase', link=1):\n"
+                     "        do_work(block)\n")
+    assert ob01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ob01_begin_escaping_to_owner_is_legal():
+    # the engine's _Speculation shape: the id's lifetime belongs to an
+    # owner object (closed at settle/drain, a scope this rule can't see)
+    src = _HEADER + ("def start(self, block):\n"
+                     "    self.sid = timeline.begin('host/phases')\n"
+                     "\n"
+                     "def opened(name):\n"
+                     "    return timeline.begin(name)\n")
+    assert ob01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_ob01_telemetry_module_is_exempt_from_span_check():
+    src = ("from . import timeline\n"
+           "def span_impl(name):\n"
+           "    sid = timeline.begin(name)\n"
+           "    return sid\n")
+    assert ob01("consensus_specs_tpu/telemetry/metrics.py", src) == []

@@ -26,6 +26,14 @@ def _check_report(report, expected_forks):
             for entry in sample["sizes"]:
                 if entry["cap"]:
                     assert entry["size"] <= entry["cap"], entry
+        # RSS endurance tracking (ISSUE 11): every epoch carries a
+        # sample and the walk's flatness verdict is recorded green
+        rss = [s["rss_mb"] for s in section["cache_samples"]]
+        assert all(r is None or r > 0 for r in rss)
+        flat = section["rss_flatness"]
+        if flat is not None:  # None only when RSS was unsampleable
+            assert flat["flat"], flat
+            assert flat["final_mb"] > 0 and flat["budget_mb"] >= 128.0
     # the artifact carries the post-mortem surfaces
     assert report["snapshot"]["providers"]["stf.engine"]
     kinds = [e["kind"] for e in report["timeline"]]

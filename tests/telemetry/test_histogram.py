@@ -1,0 +1,149 @@
+"""Latency histograms (ISSUE 11): log2 bucketing, percentile estimation,
+thread safety under the metrics-lock discipline, bus-snapshot shape, and
+the engine integration (per-phase distributions observed per block)."""
+import math
+import threading
+
+import pytest
+
+from consensus_specs_tpu.telemetry import histogram
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    histogram.reset()
+    yield
+    histogram.reset()
+
+
+def test_bucket_index_covers_the_range():
+    # buckets are [2^(e-1), 2^e): an exact power of two sits at the
+    # lower edge of the bucket it opens
+    assert histogram._bucket_index(0.0) == 0
+    assert histogram._bucket_index(1e-9) == 0          # under the floor
+    assert histogram._bucket_index(2.0 ** -20) == 1
+    assert histogram._bucket_index(0.75) == -histogram._MIN_EXP
+    assert histogram._bucket_index(48.0) == \
+        histogram._MAX_EXP - histogram._MIN_EXP
+    assert histogram._bucket_index(64.0) == histogram.N_BUCKETS - 1
+    assert histogram._bucket_index(1e9) == histogram.N_BUCKETS - 1
+    # monotone: a larger value never lands in a smaller bucket
+    prev = -1
+    for exp in range(-30, 12):
+        idx = histogram._bucket_index(2.0 ** exp * 0.75)
+        assert idx >= prev
+        prev = idx
+
+
+def test_quantiles_are_order_of_magnitude_right():
+    # 90 fast observations + 10 slow ones: p50 in the fast band, p99 in
+    # the slow band, max exact
+    for _ in range(90):
+        histogram.observe("phase", 0.001)
+    for _ in range(10):
+        histogram.observe("phase", 0.512)
+    snap = histogram.snapshot()["phase"]
+    assert snap["count"] == 100
+    assert 0.0005 <= snap["p50_s"] <= 0.002
+    assert 0.256 <= snap["p99_s"] <= 0.512
+    assert snap["max_s"] == 0.512
+    assert snap["p50_s"] <= snap["p90_s"] <= snap["p99_s"] <= snap["max_s"]
+
+
+def test_overflow_bucket_reports_the_tracked_max():
+    histogram.observe("slow", 100.0)
+    histogram.observe("slow", 500.0)
+    snap = histogram.snapshot()["slow"]
+    assert snap["p99_s"] == 500.0  # exact max, not a bucket boundary
+    assert "inf" in snap["buckets"] and snap["buckets"]["inf"] == 2
+
+
+def test_snapshot_shape_and_bus_provider():
+    histogram.observe("x", 0.25)
+    snap = histogram.snapshot()
+    assert set(snap) == {"x"}
+    entry = snap["x"]
+    assert set(entry) == {"count", "total_s", "mean_s", "max_s",
+                          "p50_s", "p90_s", "p99_s", "buckets"}
+    assert entry["total_s"] == 0.25 and entry["count"] == 1
+    # non-zero buckets only, keyed by their (exclusive) upper bound:
+    # 0.25 opens the [0.25, 0.5) bucket
+    assert entry["buckets"] == {"0.5": 1}
+    # the bus serves the same tree under the "histograms" provider
+    from consensus_specs_tpu import telemetry
+
+    bus = telemetry.snapshot()["providers"]["histograms"]
+    assert bus == snap
+
+
+def test_empty_after_reset():
+    histogram.observe("x", 1.0)
+    histogram.reset()
+    assert histogram.snapshot() == {}
+    assert histogram.names() == ()
+
+
+def test_concurrent_observers_lose_nothing():
+    # the metrics-lock discipline: N threads x M observations all land
+    n_threads, per_thread = 8, 2000
+
+    def worker(k):
+        for i in range(per_thread):
+            histogram.observe("conc", (k + 1) * 1e-6 * (i % 7 + 1))
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = histogram.snapshot()["conc"]
+    assert snap["count"] == n_threads * per_thread
+    assert sum(snap["buckets"].values()) == n_threads * per_thread
+
+
+def test_engine_observes_per_phase_distributions():
+    # a real block through the stf engine lands observations in the
+    # per-phase histograms the bench rows report p50/p99 from
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.specs.builder import build_spec
+    from consensus_specs_tpu.stf import attestations as stf_attestations
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.block import (
+        build_empty_block_for_next_slot,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+    from consensus_specs_tpu.testing.helpers.state import (
+        state_transition_and_sign_block,
+    )
+
+    spec = build_spec("phase0", "minimal", name="histogram_phase0")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    stf.reset_stats()  # also resets the histograms (per-pass contract)
+    stf_attestations.reset_caches()
+    walk = state.copy()
+    signed = state_transition_and_sign_block(
+        spec, walk, build_empty_block_for_next_slot(spec, walk))
+    s = state.copy()
+    stf.apply_signed_blocks(spec, s, [signed], True)
+    snap = histogram.snapshot()
+    assert "slot_roots" in snap and snap["slot_roots"]["count"] >= 1
+    assert snap["slot_roots"]["p99_s"] > 0
+    # reset_stats drops the distributions with the counters
+    stf.reset_stats()
+    assert histogram.snapshot() == {}
+
+
+def test_bucket_bounds_are_contiguous():
+    lo0, hi0 = histogram._bucket_bounds(0)
+    assert lo0 == 0.0
+    for i in range(1, histogram.N_BUCKETS):
+        lo, hi = histogram._bucket_bounds(i)
+        _, prev_hi = histogram._bucket_bounds(i - 1)
+        assert lo == prev_hi
+        assert hi > lo
+    assert math.isinf(histogram._bucket_bounds(histogram.N_BUCKETS - 1)[1])

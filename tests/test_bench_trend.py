@@ -193,6 +193,61 @@ def test_counters_overlap_floor_skips_pipeline_off_rows():
         _e2e_row(pipeline_dispatched=32, overlap_ratio=None)) is None
 
 
+# -- perf-doctor attribution in the refusal (ISSUE 11) ------------------------
+
+
+def _details_row(value, **overrides):
+    """A BENCH_DETAILS-shaped headline row (phase subtree included)."""
+    row = {"metric": _ROW["metric"], "value": value, "unit": "s",
+           "sig_verify_s": 0.60, "attestation_apply_s": 0.80,
+           "sync_apply_s": 0.0, "slot_roots_s": 0.57, "other_s": 0.29,
+           "telemetry": {"plan_hit_ratio": 0.49}}
+    row.update(overrides)
+    return row
+
+
+def test_trend_refusal_includes_doctor_attribution():
+    # the exit-4 path names its suspect: the refusal message carries the
+    # perf-doctor line when the previous DETAILS row is comparable
+    cur = _details_row(11.6, attestation_apply_s=1.90)   # +16% vs 10.0
+    msg = bench.check_perf_trend(cur, _ROW,
+                                 previous_details=_details_row(10.0))
+    assert msg is not None and "perf-trend regression" in msg
+    assert "doctor:" in msg
+    assert "attestation_apply_s +1.10 s" in msg
+
+
+def test_trend_refusal_attribution_carries_telemetry_drift():
+    cur = _details_row(
+        11.6, attestation_apply_s=1.90,
+        telemetry={"plan_hit_ratio": 0.22})
+    msg = bench.check_perf_trend(cur, _ROW,
+                                 previous_details=_details_row(10.0))
+    assert msg is not None
+    assert "plan_hit_ratio fell 0.49 -> 0.22" in msg
+
+
+def test_trend_refusal_without_details_stays_plain():
+    # no previous details (first post-ISSUE-11 run) -> the plain refusal
+    msg = bench.check_perf_trend(dict(_ROW, value=11.6), _ROW)
+    assert msg is not None and "doctor:" not in msg
+
+
+def test_trend_refusal_with_uncomparable_details_stays_plain():
+    # errored / phase-free previous rows must never break the gate
+    for prev_details in ({"error": "x"}, {"metric": _ROW["metric"],
+                                          "value": 10.0}, None):
+        msg = bench.check_perf_trend(dict(_ROW, value=11.6), _ROW,
+                                     previous_details=prev_details)
+        assert msg is not None and "doctor:" not in msg
+
+
+def test_within_budget_never_invokes_the_doctor():
+    cur = _details_row(11.4, attestation_apply_s=1.90)  # +14%: in budget
+    assert bench.check_perf_trend(cur, _ROW,
+                                  previous_details=_details_row(10.0)) is None
+
+
 def _scale_row(n, value, **tel_overrides):
     return {"metric": f"mainnet_epoch_e2e_bls_on_{n}", "value": value,
             "unit": "s", "telemetry": dict(_TEL, **tel_overrides)}

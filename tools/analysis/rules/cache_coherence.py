@@ -60,6 +60,11 @@ class CacheSpec:
     instance_attrs: FrozenSet[str] = frozenset()
     producers: FrozenSet[str] = frozenset()
     invalidators: FrozenSet[str] = frozenset()
+    # observational structures (latency histograms) record that work
+    # HAPPENED — a fault-stranded entry is true telemetry of wall-clock
+    # genuinely spent, not a consistency hazard, so EF01's transactional
+    # routing requirement does not apply; CC01 ownership still does
+    observational: bool = False
 
 
 CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
@@ -173,6 +178,25 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         module="consensus_specs_tpu.telemetry.recorder",
         module_globals=frozenset({"_EVENTS"}),
         invalidators=frozenset({"reset"}),
+    ),
+    # ISSUE 11: the causal-timeline ring and the latency-histogram
+    # registry follow the recorder's ownership discipline — events enter
+    # only through begin/end/instant and observations only through
+    # observe(), both lock-guarded in the owner
+    CacheSpec(
+        name="causal-timeline ring",
+        owner=("telemetry",),
+        module="consensus_specs_tpu.telemetry.timeline",
+        module_globals=frozenset({"_EVENTS"}),
+        invalidators=frozenset({"reset"}),
+    ),
+    CacheSpec(
+        name="latency-histogram registry",
+        owner=("telemetry",),
+        module="consensus_specs_tpu.telemetry.histogram",
+        module_globals=frozenset({"_HISTOGRAMS"}),
+        invalidators=frozenset({"reset"}),
+        observational=True,
     ),
 )
 

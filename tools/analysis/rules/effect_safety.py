@@ -68,6 +68,10 @@ class EffectSafetyRule(Rule):
         cache_names: Set[str] = set()
         invalidators: Set[str] = set()
         for spec in self.registry:
+            if spec.observational:
+                # latency histograms etc.: a stranded entry is true
+                # telemetry of work that ran, not a consistency hazard
+                continue
             cache_names |= spec.module_globals
             invalidators |= spec.invalidators
         proj = _project_for(ctx)

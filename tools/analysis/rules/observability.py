@@ -1,16 +1,29 @@
-"""OB01 — flight-recorder discipline in instrumented modules.
+"""OB01 — observability-event discipline in instrumented modules.
 
-The flight recorder (``consensus_specs_tpu/telemetry/recorder.py``) is a
-post-mortem instrument: its timeline is only evidence if every event is
-true.  Two ways a producer can quietly break that:
+The flight recorder (``consensus_specs_tpu/telemetry/recorder.py``) and
+the causal trace timeline (``telemetry/timeline.py``) are post-mortem
+instruments: their event streams are only evidence if every event is
+true.  Three ways a producer can quietly break that:
 
-* **bypassing the bounded API** — appending to (or splicing into) the
-  ring deque directly (``recorder._EVENTS.append(...)``) skips the lock,
-  the sequence numbering, and the drop accounting; a module that does it
-  from another thread can corrupt the ring the way CC01's cache pokes
-  corrupt a memo.  Reads (``timeline``/``stats``) and invalidations
+* **bypassing the bounded API** — appending to (or splicing into) either
+  ring deque directly (``recorder._EVENTS.append(...)``,
+  ``timeline._EVENTS.append(...)``) skips the lock, the sequence
+  numbering, and the drop accounting; a module that does it from another
+  thread can corrupt the ring the way CC01's cache pokes corrupt a memo.
+  Reads (``timeline``/``events``/``stats``) and invalidations
   (``clear``/``pop``) stay legal — removal can only lose history, never
   fake it.
+
+* **an unclosed span** (ISSUE 11) — a raw ``timeline.begin(...)`` whose
+  id is not closed on every exit path leaks a begin event without its
+  end: an exception between the two leaves the Chrome-trace export
+  showing a span that "ran until the dump", and worse, the engine's
+  cancelled-flow marking (``cancel_link``) can then lie about where work
+  stopped.  Legal shapes: ``with timeline.span(...)`` (the context
+  manager closes in a ``finally``), a ``timeline.end(...)`` inside a
+  ``finally`` block of the same function, or handing the id to an owner
+  object / returning it (the lifetime escapes to a scope this rule
+  cannot see — the engine's ``_Speculation`` pattern).
 
 * **logging a commit that never happened** — in a faults-instrumented
   module (one binding ``_SITE = faults.site(...)`` probes), a
@@ -33,7 +46,7 @@ from __future__ import annotations
 import ast
 
 from ..core import Rule, register
-from ..symbols import name_matches
+from ..symbols import name_matches, walk_scope
 
 _RING_APPENDERS = {"append", "appendleft", "extend", "extendleft", "insert"}
 _COMMIT_KINDS = {"cache_commit", "block_fast", "mirror_flush", "memo_commit"}
@@ -42,21 +55,23 @@ _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 @register
 class FlightRecorderDisciplineRule(Rule):
-    """Direct ring mutation outside telemetry/, or a commit-class record
-    inside an open block transaction in a fault-probed module."""
+    """Direct ring mutation outside telemetry/, an unclosed timeline
+    span, or a commit-class record inside an open block transaction in a
+    fault-probed module."""
 
     code = "OB01"
-    summary = "flight-recorder append bypasses the API or logs an unsettled commit"
+    summary = "observability event bypasses its API, leaks a span, or logs an unsettled commit"
 
     def check(self, ctx):
         if ctx.tree is None or ctx.in_dir("telemetry", "specs", "tests"):
             return
         sym = ctx.symbols
         yield from self._direct_ring_writes(ctx, sym)
+        yield from self._unclosed_spans(ctx, sym)
         if self._is_instrumented(sym):
             yield from self._premature_commit_events(ctx, sym)
 
-    # -- check 1: the ring is written only through record() ------------------
+    # -- check 1: the rings are written only through their APIs ---------------
 
     def _direct_ring_writes(self, ctx, sym):
         for node in ast.walk(ctx.tree):
@@ -66,17 +81,74 @@ class FlightRecorderDisciplineRule(Rule):
                 continue
             recv = node.func.value
             if (isinstance(recv, ast.Attribute) and recv.attr == "_EVENTS"
-                    and self._is_recorder(sym.resolve(recv.value))):
+                    and self._is_ring_owner(sym.resolve(recv.value))):
                 yield (node.lineno,
-                       f"direct ._EVENTS.{node.func.attr}() on the flight-"
-                       "recorder ring: bypasses the lock, the sequence "
-                       "numbering, and the bound — emit through "
-                       "telemetry.record(kind, ...)")
+                       f"direct ._EVENTS.{node.func.attr}() on an "
+                       "observability ring: bypasses the lock, the "
+                       "sequence numbering, and the bound — emit through "
+                       "telemetry.record(kind, ...) / timeline.begin-end")
 
     @staticmethod
-    def _is_recorder(resolved) -> bool:
-        return bool(resolved) and resolved.lstrip(".").endswith(
-            "telemetry.recorder")
+    def _is_ring_owner(resolved) -> bool:
+        if not resolved:
+            return False
+        tail = resolved.lstrip(".")
+        return (tail.endswith("telemetry.recorder")
+                or tail.endswith("telemetry.timeline"))
+
+    # -- check 2: a raw begin is closed on every exit path --------------------
+
+    @staticmethod
+    def _timeline_call(sym, func_node, names) -> bool:
+        dotted = sym.resolve(func_node)
+        return (name_matches(dotted, names)
+                and "timeline" in (dotted or ""))
+
+    def _unclosed_spans(self, ctx, sym):
+        closed_scopes = {}  # scope node -> has a finally-closed end
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and self._timeline_call(sym, node.func, {"begin"})):
+                continue
+            if self._escapes(sym, node):
+                continue
+            scope = sym.enclosing_function(node) or ctx.tree
+            has_end = closed_scopes.get(scope)
+            if has_end is None:
+                has_end = closed_scopes[scope] = \
+                    self._scope_has_finally_end(sym, scope)
+            if has_end:
+                continue
+            yield (node.lineno,
+                   "timeline.begin(...) with no timeline.end in a "
+                   "finally on this path: an exception between them "
+                   "leaks an unclosed span (the trace shows work that "
+                   "never settled) — use `with timeline.span(...)`, "
+                   "close the id in a finally, or store it on an owner "
+                   "object")
+
+    @staticmethod
+    def _escapes(sym, call) -> bool:
+        """True when the begin id's lifetime leaves this function: stored
+        on an attribute/subscript (an owner object closes it later) or
+        returned to the caller."""
+        parent = sym.parent.get(call)
+        if isinstance(parent, ast.Assign):
+            return any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in parent.targets)
+        return isinstance(parent, ast.Return)
+
+    def _scope_has_finally_end(self, sym, scope) -> bool:
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Try):
+                continue
+            for stmt in node.finalbody:
+                for call in ast.walk(stmt):
+                    if (isinstance(call, ast.Call)
+                            and self._timeline_call(sym, call.func,
+                                                    {"end"})):
+                        return True
+        return False
 
     # -- check 2: commit-class events settle with the transaction ------------
 

@@ -1,0 +1,313 @@
+"""Phase-attribution regression doctor (ISSUE 11 tentpole, part 3).
+
+``check_perf_trend`` / ``check_counter_invariants`` can REFUSE a bench
+headline, but until now they could not say *which phase regressed or
+why* — the operator got "+22% > 15% budget" and a 40-key JSON diff to
+eyeball.  This tool diffs the phase and telemetry subtrees of two
+BENCH_DETAILS-style snapshots and prints a ranked attribution:
+
+    attestation_apply_s +0.90 s explains 81% of the regression;
+    plan_hit_ratio fell 0.490 -> 0.220
+
+Three entry points:
+
+* ``attribution_line(cur_row, prev_row)`` — the one-line summary
+  ``bench.check_perf_trend`` appends to its refusal message (the exit-4
+  path names its suspect);
+* ``diagnose_row(cur_row, prev_row)`` — the full ranked structure
+  (per-phase deltas + shares, sub-phase detail, telemetry drift,
+  histogram-p99 shifts when the rows carry ``phase_histograms``);
+* the CLI — ``python tools/perf_doctor.py [CURRENT PREVIOUS]`` /
+  ``make doctor`` — compares the two newest snapshots: the working-tree
+  ``BENCH_DETAILS.json`` against ``BENCH_DETAILS_PREV.json`` (written by
+  every bench run before it overwrites the details), falling back to the
+  newest differing git-history version of BENCH_DETAILS.json when no
+  PREV file exists yet.
+
+The doctor is deliberately dependency-free (stdlib only) and makes no
+judgement calls the gates haven't already made: it ATTRIBUTES a refusal,
+it never issues one.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+# the phases that sum (approximately) to an e2e row's wall time — the
+# attribution universe.  Sub-phases refine a top phase's delta without
+# double-counting it.
+TOP_PHASES = ("sig_verify_s", "attestation_apply_s", "sync_apply_s",
+              "slot_roots_s", "other_s")
+SUB_PHASES = {
+    "sig_verify_s": ("hash_to_g2_s", "msm_s", "miller_s", "marshal_s",
+                     "overlap_s"),
+    "attestation_apply_s": ("resolve_s", "apply_s", "mirror_flush_s"),
+}
+
+# telemetry ratios whose drift explains a phase move (cache-keying
+# regressions), and counters whose mere appearance is the story
+_TEL_RATIOS = ("plan_hit_ratio", "memo_hit_ratio", "h2c_hit_ratio",
+               "overlap_ratio")
+_TEL_COUNTERS = ("replayed_blocks", "breaker_trips", "native_degraded",
+                 "pipeline_drains")
+_RATIO_NOISE = 0.02   # ratio drift below this is noise, not attribution
+_SHARE_NOISE = 0.02   # phases explaining <2% of the regression are noise
+
+
+def _num(row, key) -> Optional[float]:
+    try:
+        v = row.get(key)
+        return float(v) if v is not None else None
+    except (AttributeError, TypeError, ValueError):
+        return None
+
+
+def is_e2e_row(row) -> bool:
+    """True for a row the doctor can attribute: a wall-time value plus at
+    least one of the phase keys."""
+    return (isinstance(row, dict) and _num(row, "value") is not None
+            and any(_num(row, k) is not None for k in TOP_PHASES))
+
+
+def diagnose_row(cur: dict, prev: dict) -> Optional[dict]:
+    """Ranked attribution of ``cur`` vs ``prev`` (same-metric
+    BENCH_DETAILS rows); None when the rows aren't comparable.  The
+    structure is symmetric — a negative total is an improvement and the
+    contributors then explain the win."""
+    if not (is_e2e_row(cur) and is_e2e_row(prev)):
+        return None
+    if cur.get("metric") != prev.get("metric"):
+        return None
+    total = _num(cur, "value") - _num(prev, "value")
+    contributors: List[dict] = []
+    for phase in TOP_PHASES:
+        c, p = _num(cur, phase), _num(prev, phase)
+        if c is None or p is None:
+            continue
+        delta = c - p
+        entry = {"phase": phase, "cur_s": round(c, 3), "prev_s": round(p, 3),
+                 "delta_s": round(delta, 3),
+                 "share": (round(delta / total, 3) if total else None)}
+        subs = []
+        for sub in SUB_PHASES.get(phase, ()):
+            cs, ps = _num(cur, sub), _num(prev, sub)
+            if cs is None or ps is None or abs(cs - ps) < 1e-4:
+                continue
+            subs.append({"phase": sub, "cur_s": round(cs, 3),
+                         "prev_s": round(ps, 3),
+                         "delta_s": round(cs - ps, 3)})
+        if subs:
+            subs.sort(key=lambda s: -abs(s["delta_s"]))
+            entry["sub_phases"] = subs
+        contributors.append(entry)
+    # rank by contribution IN THE DIRECTION of the total move: a
+    # regressed run lists its regressed phases first even when an
+    # improvement elsewhere has the larger |delta| — the verdict must
+    # name a suspect, not the phase that got faster
+    direction = 1.0 if total >= 0 else -1.0
+    contributors.sort(key=lambda c: -c["delta_s"] * direction)
+    return {
+        "metric": cur.get("metric"),
+        "cur_value_s": _num(cur, "value"),
+        "prev_value_s": _num(prev, "value"),
+        "delta_s": round(total, 3),
+        "regressed": total > 0,
+        "contributors": contributors,
+        "telemetry_drift": _telemetry_drift(cur, prev),
+        "histogram_shifts": _histogram_shifts(cur, prev),
+    }
+
+
+def _telemetry_drift(cur: dict, prev: dict) -> List[dict]:
+    """Ratio falls and counter appearances in the embedded telemetry
+    subtree — the WHY behind a phase delta (a plan-cache keying break
+    shows up here before it shows up anywhere else)."""
+    ct = cur.get("telemetry") if isinstance(cur.get("telemetry"), dict) else {}
+    pt = (prev.get("telemetry")
+          if isinstance(prev.get("telemetry"), dict) else {})
+    out = []
+    for key in _TEL_RATIOS:
+        c, p = ct.get(key), pt.get(key)
+        if (isinstance(c, (int, float)) and isinstance(p, (int, float))
+                and abs(c - p) >= _RATIO_NOISE):
+            out.append({"key": key, "prev": round(float(p), 3),
+                        "cur": round(float(c), 3),
+                        "drift": round(float(c) - float(p), 3)})
+    for key in _TEL_COUNTERS:
+        c, p = ct.get(key) or 0, pt.get(key) or 0
+        if isinstance(c, (int, float)) and isinstance(p, (int, float)) \
+                and c != p:
+            out.append({"key": key, "prev": p, "cur": c,
+                        "drift": round(float(c) - float(p), 3)})
+    out.sort(key=lambda d: -abs(d["drift"]))
+    return out
+
+
+def _histogram_shifts(cur: dict, prev: dict) -> List[dict]:
+    """p99 moves in the per-phase latency histograms both rows embed
+    (ISSUE 11 bench rows) — a tail regression the sums can hide."""
+    ch = cur.get("phase_histograms")
+    ph = prev.get("phase_histograms")
+    if not (isinstance(ch, dict) and isinstance(ph, dict)):
+        return []
+    out = []
+    for phase in sorted(set(ch) & set(ph)):
+        c, p = ch[phase], ph[phase]
+        if not (isinstance(c, dict) and isinstance(p, dict)):
+            continue
+        c99, p99 = c.get("p99_ms"), p.get("p99_ms")
+        if (isinstance(c99, (int, float)) and isinstance(p99, (int, float))
+                and p99 > 0 and abs(c99 - p99) / p99 >= 0.25):
+            out.append({"phase": phase, "prev_p99_ms": p99,
+                        "cur_p99_ms": c99})
+    return out
+
+
+def attribution_from_diag(diag: Optional[dict]) -> Optional[str]:
+    """The one-line attribution for an already-computed diagnosis: top
+    contributor + its share, plus the largest telemetry drift."""
+    if diag is None or not diag["contributors"]:
+        return None
+    top = diag["contributors"][0]
+    delta = top["delta_s"]
+    parts = [f"{top['phase']} {delta:+.2f} s"]
+    share = top.get("share")
+    if share is not None and share > 0 and diag["delta_s"] > 0:
+        parts.append(f"explains {min(share, 1.0):.0%} of the regression")
+    line = " ".join(parts)
+    drift = diag["telemetry_drift"]
+    if drift:
+        d = drift[0]
+        verb = "fell" if d["drift"] < 0 else "rose"
+        line += (f"; {d['key']} {verb} "
+                 f"{d['prev']:.3g} -> {d['cur']:.3g}")
+    return line
+
+
+def attribution_line(cur: dict, prev: dict) -> Optional[str]:
+    """The one-line attribution the trend gate's refusal message carries
+    (``diagnose_row`` + ``attribution_from_diag`` in one call)."""
+    return attribution_from_diag(diagnose_row(cur, prev))
+
+
+# -- snapshot discovery --------------------------------------------------------
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_snapshot(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _git_previous_details(repo: str) -> Optional[dict]:
+    """The newest git-history version of BENCH_DETAILS.json whose content
+    differs from the working tree — the fallback "previous snapshot"
+    before the first post-ISSUE-11 bench run writes a PREV file."""
+    try:
+        with open(os.path.join(repo, "BENCH_DETAILS.json")) as f:
+            current = f.read()
+        revs = subprocess.run(
+            ["git", "log", "--format=%H", "--", "BENCH_DETAILS.json"],
+            cwd=repo, capture_output=True, text=True, timeout=30,
+            check=True).stdout.split()
+        for rev in revs:
+            blob = subprocess.run(
+                ["git", "show", f"{rev}:BENCH_DETAILS.json"], cwd=repo,
+                capture_output=True, text=True, timeout=30)
+            if blob.returncode == 0 and blob.stdout != current:
+                return json.loads(blob.stdout)
+    except (OSError, ValueError, subprocess.SubprocessError):
+        return None
+    return None
+
+
+def newest_snapshot_pair(repo: Optional[str] = None):
+    """(current, previous, label) — BENCH_DETAILS.json against the PREV
+    file when it exists, else against git history; previous is None when
+    nothing comparable exists."""
+    repo = repo or _repo_root()
+    cur_path = os.path.join(repo, "BENCH_DETAILS.json")
+    prev_path = os.path.join(repo, "BENCH_DETAILS_PREV.json")
+    current = load_snapshot(cur_path)
+    if os.path.exists(prev_path):
+        return current, load_snapshot(prev_path), "BENCH_DETAILS_PREV.json"
+    return current, _git_previous_details(repo), "git history"
+
+
+# -- report rendering ----------------------------------------------------------
+
+
+def render(diag: dict) -> str:
+    lines = [
+        f"{diag['metric']}: {diag['prev_value_s']:.3f} s -> "
+        f"{diag['cur_value_s']:.3f} s ({diag['delta_s']:+.3f} s, "
+        f"{'REGRESSED' if diag['regressed'] else 'improved/steady'})"
+    ]
+    total = diag["delta_s"]
+    for c in diag["contributors"]:
+        share = c.get("share")
+        noise = (share is not None and total
+                 and abs(c["delta_s"] / total) < _SHARE_NOISE)
+        if noise and abs(c["delta_s"]) < 0.01:
+            continue
+        share_txt = (f"  ({min(share, 1.0):>4.0%} of the move)"
+                     if share is not None and share > 0 else "")
+        lines.append(f"  {c['phase']:<22} {c['prev_s']:>8.3f} -> "
+                     f"{c['cur_s']:>8.3f}  {c['delta_s']:+.3f} s{share_txt}")
+        for s in c.get("sub_phases", ()):
+            lines.append(f"      {s['phase']:<18} {s['prev_s']:>8.3f} -> "
+                         f"{s['cur_s']:>8.3f}  {s['delta_s']:+.3f} s")
+    for d in diag["telemetry_drift"]:
+        verb = "fell" if d["drift"] < 0 else "rose"
+        lines.append(f"  telemetry: {d['key']} {verb} "
+                     f"{d['prev']} -> {d['cur']}")
+    for h in diag["histogram_shifts"]:
+        lines.append(f"  tail: {h['phase']} p99 {h['prev_p99_ms']} ms -> "
+                     f"{h['cur_p99_ms']} ms")
+    verdict = attribution_from_diag(diag)
+    if diag["regressed"] and verdict:
+        lines.append(f"  verdict: {verdict}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    if len(argv) >= 2:
+        current, previous, label = (load_snapshot(argv[0]),
+                                    load_snapshot(argv[1]), argv[1])
+    elif len(argv) == 1:
+        print("need zero (newest pair) or two snapshot paths",
+              file=sys.stderr)
+        return 2
+    else:
+        current, previous, label = newest_snapshot_pair()
+    if previous is None:
+        print("perf-doctor: no previous snapshot to compare against "
+              "(no BENCH_DETAILS_PREV.json yet and no differing git "
+              "version) — run bench twice, or pass two paths")
+        return 0
+    print(f"perf-doctor: current BENCH_DETAILS vs {label}")
+    compared = 0
+    for key in sorted(set(current) & set(previous)):
+        diag = diagnose_row(current.get(key), previous.get(key))
+        if diag is None:
+            continue
+        compared += 1
+        print()
+        print(render(diag))
+    if not compared:
+        print("no comparable e2e rows shared by the two snapshots")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
