@@ -47,6 +47,15 @@ soak:
 soak-deep:
 	CSTPU_SOAK_DEEP=1 python -m pytest tests/soak -q
 
+# node firehose (ISSUE 12 / ROADMAP item 1): the concurrent serving
+# harness — multi-producer gossip + blocks through the single-writer
+# node with journal-replay spec parity; analyzer-gated like chaos/soak.
+# No 'not slow' filter: the slow-marked deep profile runs here (tier-1
+# pays only the fast smoke).  CSTPU_FIREHOSE_GOSSIP / _EPOCHS /
+# _PRODUCERS scale the deep profile.
+firehose:
+	python -m pytest tests/node tests/analysis/test_live_tree_clean.py -q
+
 # phase-attribution regression doctor (ISSUE 11): diff the two newest
 # bench snapshots (BENCH_DETAILS.json vs BENCH_DETAILS_PREV.json, or the
 # newest differing git version) and print ranked per-phase attribution
@@ -83,4 +92,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep doctor limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE
+.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep firehose doctor limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE

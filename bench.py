@@ -133,45 +133,53 @@ def _install_real_pubkeys(spec, state, n):
         BranchNode(contents, uint_to_leaf(n)))
 
 
+def _bench_cache_dir():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench_cache")
+
+
+def _read_framed(path, typ):
+    """Length-prefixed SSZ list file -> decoded objects (the corpus cache
+    framing, shared by the block and firehose caches)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    out, off = [], 0
+    while off < len(raw):
+        ln = int.from_bytes(raw[off:off + 4], "little")
+        off += 4
+        out.append(typ.decode_bytes(raw[off:off + ln]))
+        off += ln
+    return out
+
+
+def _write_framed(path, objs):
+    """Atomically persist SSZ objects in the length-prefixed framing."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        for obj in objs:
+            enc = obj.encode_bytes()
+            f.write(len(enc).to_bytes(4, "little"))
+            f.write(enc)
+    os.replace(tmp, path)
+
+
 def _corpus_through_cache(spec, state, build_fn, n=None):
     """Signed-block corpus cache: the set is a pure function of the
     pre-epoch state (whose root covers validator count, fork, pubkeys,
     balances) and the builder logic (versioned key).  A warm bench run
     skips the ~4 min rebuild; the measured phase is unaffected either
     way.  Returns (cache_hit, build_or_load_seconds, blocks)."""
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".bench_cache")
     cache_key = (f"blocks_v2_{n or N_VALIDATORS}_"
                  f"{bytes(state.hash_tree_root()).hex()[:24]}")
-    cache_path = os.path.join(cache_dir, cache_key + ".ssz")
-
-    def _load_corpus():
-        with open(cache_path, "rb") as f:
-            raw = f.read()
-        blocks, off = [], 0
-        while off < len(raw):
-            ln = int.from_bytes(raw[off:off + 4], "little")
-            off += 4
-            blocks.append(spec.SignedBeaconBlock.decode_bytes(raw[off:off + ln]))
-            off += ln
-        return blocks
-
-    def _store_corpus(blocks):
-        os.makedirs(cache_dir, exist_ok=True)
-        tmp = cache_path + ".tmp"
-        with open(tmp, "wb") as f:
-            for sb in blocks:
-                enc = sb.encode_bytes()
-                f.write(len(enc).to_bytes(4, "little"))
-                f.write(enc)
-        os.replace(tmp, cache_path)
+    cache_path = os.path.join(_bench_cache_dir(), cache_key + ".ssz")
 
     if os.path.exists(cache_path):
-        t, blocks = _timed(_load_corpus)
+        t, blocks = _timed(_read_framed, cache_path, spec.SignedBeaconBlock)
         return True, t, blocks
     t, blocks = _timed(build_fn)
     try:
-        _store_corpus(blocks)
+        _write_framed(cache_path, blocks)
     except OSError:
         pass  # read-only tree: cold path every run
     return False, t, blocks
@@ -1156,6 +1164,146 @@ def bench_forkchoice_ingest(results, n_validators=None, n_attestations=100_000):
         bls.bls_active = was_active
 
 
+def _firehose_corpus_through_cache(spec, state, n_epochs, gossip_target):
+    """Firehose corpus cache (chain + gossip), keyed like the block
+    corpus: a pure function of the prepared anchor state's root and the
+    builder parameters.  Returns (cache_hit, seconds, corpus)."""
+    from consensus_specs_tpu.node import firehose
+
+    key = (f"firehose_v1_{len(state.validators)}_{n_epochs}e_{gossip_target}_"
+           f"{bytes(state.hash_tree_root()).hex()[:24]}")
+    blocks_path = os.path.join(_bench_cache_dir(), key + ".blocks.ssz")
+    atts_path = os.path.join(_bench_cache_dir(), key + ".atts.ssz")
+
+    if os.path.exists(blocks_path) and os.path.exists(atts_path):
+        def _load():
+            chain = _read_framed(blocks_path, spec.SignedBeaconBlock)
+            gossip = {}
+            for att in _read_framed(atts_path, spec.Attestation):
+                gossip.setdefault(int(att.data.slot), []).append(att)
+            return firehose.FirehoseCorpus(
+                firehose.default_anchor_block(spec, state), chain, gossip)
+
+        t, corpus = _timed(_load)
+        return True, t, corpus
+    t, corpus = _timed(firehose.build_corpus, spec, state, n_epochs,
+                       gossip_target)
+    try:
+        _write_framed(blocks_path, corpus.chain)
+        _write_framed(atts_path, [a for s in sorted(corpus.gossip)
+                                  for a in corpus.gossip[s]])
+    except OSError:
+        pass  # read-only tree: cold path every run
+    return False, t, corpus
+
+
+def bench_node_firehose(results, n_validators=None, n_epochs=2,
+                        gossip_target=100_000, n_gossip_producers=3):
+    """Driver-parsed ``node_firehose`` row (ISSUE 12): the node serving
+    pipeline under production-shaped concurrent load — ``n_epochs`` of
+    full blocks routed through the engine-backed ``on_block`` (fork
+    choice + batched stf transition as ONE pipeline) interleaved with
+    ≥``gossip_target`` single-attester gossip votes from concurrent
+    producer threads over the bounded ingest queue, then the node's
+    apply journal replayed through the literal spec handlers with
+    byte-identical head/root asserted.  BLS off like the fork-choice
+    ingest row (orchestration, not pairing — the e2e rows gate that);
+    the stf fast path must still carry EVERY block (zero replays, the
+    acceptance bar for the composition actually engaging)."""
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.forkchoice import engine as fc_engine
+    from consensus_specs_tpu.node import firehose
+    from consensus_specs_tpu.node import service as node_service
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.stf import verify as stf_verify
+    from consensus_specs_tpu.telemetry import recorder
+
+    n = n_validators or N_VALIDATORS
+    spec = get_spec("phase0", "mainnet")
+    was_active = bls.bls_active
+    bls.bls_active = False
+    was_recording = recorder.enabled()
+    if not was_recording:
+        recorder.reset()
+        recorder.enable()
+    try:
+        t_build_state, state = _timed(build_state, spec, n)
+        firehose.prepare_anchor(spec, state)
+        corpus_cached, t_corpus, corpus = _firehose_corpus_through_cache(
+            spec, state, n_epochs, gossip_target)
+        n_gossip = sum(len(v) for v in corpus.gossip.values())
+
+        node_service.reset_stats()
+        stf.reset_stats()
+        fc_engine.reset_stats()
+        run = firehose.run_firehose(
+            spec, state, corpus, n_gossip_producers=n_gossip_producers)
+        node = run.pop("node")
+
+        assert run["producer_threads"] >= 4, run["producer_threads"]
+        assert run["blocks"] >= 2 * int(spec.SLOTS_PER_EPOCH)
+        assert n_gossip >= gossip_target, n_gossip
+        assert stf.stats["replayed_blocks"] == 0, \
+            f"node replayed {stf.stats['replayed_blocks']} blocks " \
+            f"({stf.stats['replay_reasons']})"
+        assert stf.stats["fast_blocks"] == run["blocks"], \
+            "stf fast path did not carry every block"
+        assert run["service"]["rejected_batches"] == 0, \
+            f"firehose rejected {run['service']['rejected_batches']} batches"
+
+        t_parity, ref = _timed(
+            firehose.replay_journal_literal, spec, state,
+            corpus.anchor_block, node._journal)
+        roots = firehose.assert_parity(spec, node, ref)
+
+        queue = run["queue"]
+        results["node_firehose"] = {
+            "metric": (f"node_firehose_{n_epochs}epochs_{n_gossip}_"
+                       f"gossip_atts_{n}_validators"),
+            "value": run["elapsed_s"],
+            "unit": "s",
+            "vs_baseline": round(t_parity / run["elapsed_s"], 1),
+            "blocks_per_s": run["blocks_per_s"],
+            "atts_per_s": run["atts_per_s"],
+            "blocks": run["blocks"],
+            "gossip_attestations": n_gossip,
+            "producer_threads": run["producer_threads"],
+            "applied_items": run["applied_items"],
+            "head_parity": True,
+            **roots,
+            "literal_replay_s": round(t_parity, 3),
+            "queue_depth_max": queue["depth_max"],
+            "queue_blocked_puts": queue["blocked_puts"],
+            "queue_blocked_s": round(queue["blocked_s"], 3),
+            "state_build_s": round(t_build_state, 3),
+            "corpus_build_s": round(t_corpus, 3),
+            "corpus_cached": corpus_cached,
+            # counter invariants (the trend gate reads this subtree):
+            # behavioral rot — a silently replayed block, an open
+            # breaker, degraded native — refuses the headline like a
+            # slowdown.  Hit-ratio keys are deliberately absent: the
+            # firehose corpus carries each aggregate once, so the e2e
+            # rows' structural re-carry floors do not apply.
+            "telemetry": {
+                "replayed_blocks": stf.stats["replayed_blocks"],
+                "fast_blocks": stf.stats["fast_blocks"],
+                "breaker_state": stf.stats["breaker_state"],
+                "breaker_trips": stf.stats["breaker_trips"],
+                "native_degraded": stf_verify.stats["native_degraded"],
+                "rejected_batches": run["service"]["rejected_batches"],
+                "requeued_items": run["service"]["requeued_items"],
+                "attestations_ingested":
+                    fc_engine.stats["attestations_ingested"],
+                "fc_prunes": fc_engine.stats["prunes"],
+            },
+        }
+    finally:
+        bls.bls_active = was_active
+        if not was_recording:
+            recorder.disable()
+
+
 def bench_scale_probe(results):
     """Scale-headroom probe (VERDICT r4 item 7): the BLS-free epoch
     transition at 2^20 validators (registry limit is 2^40; real mainnet is
@@ -1531,7 +1679,7 @@ def main():
         # chaos run: import the instrumented modules, then fail fast on a
         # typo'd site name — a silently-disarmed schedule would report a
         # clean row that exercised nothing
-        from consensus_specs_tpu import faults, forkchoice, stf  # noqa: F401
+        from consensus_specs_tpu import faults, forkchoice, node, stf  # noqa: F401
 
         faults.assert_sites_registered()
     results = {}
@@ -1571,6 +1719,11 @@ def main():
             bench_forkchoice_ingest(results)
         except Exception as exc:
             results["forkchoice_batch_ingest"] = {"error": repr(exc)[:300]}
+        if os.environ.get("BENCH_FIREHOSE") != "0":
+            try:
+                bench_node_firehose(results)
+            except Exception as exc:
+                results["node_firehose"] = {"error": repr(exc)[:300]}
     if os.environ.get("BENCH_SCALE_PROBE") == "1":
         try:
             bench_scale_probe(results)
@@ -1615,8 +1768,10 @@ def main():
         except (OSError, ValueError):
             prev_details = {}
     # rows produced only by opt-in probes survive runs that skip them
+    # (node_firehose: QUICK runs and BENCH_FIREHOSE=0 skip the row, but
+    # its counter-invariant history must stay diffable run over run)
     for preserved in ("epoch_scale_1m", "epoch_e2e_scale_1m",
-                      "epoch_e2e_scale_2m"):
+                      "epoch_e2e_scale_2m", "node_firehose"):
         if preserved not in results and prev_details.get(preserved):
             results[preserved] = prev_details[preserved]
     if prev_details:
@@ -1701,10 +1856,16 @@ def main():
             # slowdown; the validator-scale rows (1M/2M) are gated the
             # same way, and their wall time rides the perf trend too
             for row_key in ("epoch_e2e_bls", "epoch_e2e_bls_altair",
-                            "epoch_e2e_scale_1m", "epoch_e2e_scale_2m"):
+                            "epoch_e2e_scale_1m", "epoch_e2e_scale_2m",
+                            "node_firehose"):
                 regressions.append(check_counter_invariants(
                     results.get(row_key), prev_details.get(row_key)))
-            for row_key in ("epoch_e2e_scale_1m", "epoch_e2e_scale_2m"):
+            # node_firehose rides the same wall-time trend gate as the
+            # scale rows (value is the serving wall; blocks/s + atts/s
+            # ride in the row) — composition throughput can't silently
+            # erode run over run (ISSUE 12)
+            for row_key in ("epoch_e2e_scale_1m", "epoch_e2e_scale_2m",
+                            "node_firehose"):
                 regressions.append(check_perf_trend(
                     results.get(row_key), prev_details.get(row_key),
                     previous_details=prev_details.get(row_key)))

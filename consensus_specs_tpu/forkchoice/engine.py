@@ -92,9 +92,17 @@ def _cp(checkpoint) -> tuple:
 class ForkChoiceEngine:
     """Proto-array LMD-GHOST over a wrapped spec ``Store``."""
 
-    def __init__(self, spec, store):
+    def __init__(self, spec, store, block_handler=None):
         self.spec = spec
         self.store = store
+        # the on_block seam (ISSUE 12): a drop-in replacement for
+        # ``spec.on_block(store, signed_block)`` with the SAME contract —
+        # same store mutations on success, the spec's exact exception and
+        # partial store on failure.  The node subsystem installs its
+        # engine-backed handler here (node/service.py routes the state
+        # transition through the batched stf engine); None keeps the
+        # literal spec handler.
+        self._block_handler = block_handler
         self.proto = ProtoArray()
         self._head = None
         self._justified_seen = None
@@ -203,7 +211,8 @@ class ForkChoiceEngine:
                 tracing.span("forkchoice/on_block"):
             _SITE_ON_BLOCK()  # pre-mutation: a fault leaves store + proto as-is
             try:
-                self.spec.on_block(self.store, signed_block)
+                (self._block_handler or self.spec.on_block)(
+                    self.store, signed_block)
                 self._insert_block(
                     self.spec.hash_tree_root(signed_block.message))
                 self._sync_checkpoints()

@@ -1,0 +1,18 @@
+"""Node serving pipeline: the two engines composed into one servable
+surface (ISSUE 12; ROADMAP item 1).
+
+Layers (see docs/architecture.md, "Node serving pipeline"):
+
+* ``service``  — the ``Node``: a fork-choice engine whose ``on_block``
+  routes the state transition through the batched stf engine
+  (``engine_backed_on_block``), behind a single-writer apply loop;
+* ``ingest``   — bounded multi-producer FIFO work queue with
+  back-pressure, feeding the apply loop;
+* ``firehose`` — seeded concurrent load harness: N epochs of blocks +
+  ≥100k-attestation gossip from concurrent producer threads, with
+  journal-replay head/root parity vs the literal spec.
+"""
+from .ingest import IngestQueue
+from .service import Node, engine_backed_on_block
+
+__all__ = ["IngestQueue", "Node", "engine_backed_on_block"]

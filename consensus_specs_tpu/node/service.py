@@ -1,0 +1,329 @@
+"""The node: one servable pipeline over the two engines (ISSUE 12
+tentpole; ROADMAP item 1).
+
+Eleven PRs built two fast libraries — the proto-array fork-choice engine
+(``forkchoice/engine.py``) and the batched stf engine
+(``stf/engine.py``) — but ``on_block`` still replayed blocks through the
+literal ``spec.state_transition``.  This module composes them into ONE
+pipeline:
+
+* **engine-backed ``on_block``** — ``engine_backed_on_block`` is the
+  spec handler (specs/src/phase0.py:1602-1641; bellatrix adds the
+  merge-transition check) with the state transition routed through
+  ``stf.apply_signed_blocks``: the block's signature batch dispatches to
+  the pipeline worker, attestations apply vectorized, slot roots ride
+  the resident merkle path — and the stf engine's rollback contract,
+  literal-replay fallback, and circuit breaker carry over UNCHANGED
+  (``apply_signed_blocks`` is semantically identical to
+  ``state_transition``, same post-state, same exception at the same
+  point).  A ``Node``'s fork-choice engine gets this handler installed
+  at construction (the ``block_handler`` seam), so head tracking, block
+  verification, and state transition are one pipeline.
+
+* **single-writer apply loop over a bounded multi-producer queue** —
+  fork choice is single-writer by contract; producers (gossip readers,
+  block fetchers, the clock) enqueue into ``node/ingest.py``'s bounded
+  FIFO and ``run_apply_loop`` drains it on ONE thread.  A non-blocking
+  writer lock enforces the contract (a second concurrent writer raises
+  instead of corrupting the store).  A failed item is put back at the
+  HEAD of the queue before the exception propagates — a retried loop
+  resumes exactly where it stopped, and the ``node.apply`` fault probe
+  fires before any store/proto mutation so an injected failure leaves
+  both untouched (tests/chaos/test_node_chaos.py).  Invalid gossip is
+  production-shaped load, not a crash: an attestation batch the spec
+  rejects (``AssertionError``) is counted and dropped, the loop keeps
+  serving.
+
+* **parity journal** — every applied item lands in ``node.journal`` in
+  apply order, so a concurrent run's end state is exactly replayable
+  through the literal spec handlers (the firehose's head/root parity
+  leg replays the journal, making byte-identical-state assertions
+  meaningful under nondeterministic producer interleaving).
+
+Observability: ``node_block``/``node_gossip`` flight-recorder events
+(recorded only after the engine call settled — OB01's commit
+discipline), ``node/apply`` timeline spans carrying the enqueue-time
+causality link (Perfetto shows the producer → apply-loop handoff), and a
+``node`` snapshot provider on the telemetry bus (queue depth,
+applied/rejected counters, producer stats).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Sequence
+
+from consensus_specs_tpu import faults, telemetry
+from consensus_specs_tpu.forkchoice import ForkChoiceEngine
+from consensus_specs_tpu.stf import apply_signed_blocks
+from consensus_specs_tpu.telemetry import recorder, timeline
+
+from . import ingest
+
+# probed at the top of every apply (direct handler or loop item), BEFORE
+# the engine dispatch: an injected failure leaves store + proto-array
+# exactly as they were and the dequeued item back at the queue head
+_SITE_APPLY = faults.site("node.apply")
+
+stats = {
+    "blocks_applied": 0,
+    "ticks_applied": 0,
+    "attestation_batches_applied": 0,
+    "attestations_applied": 0,
+    "rejected_batches": 0,
+    "rejected_attestations": 0,
+    "requeued_items": 0,
+    "apply_loop_runs": 0,
+}
+
+
+def reset_stats() -> None:
+    """Zero the node counters AND the ingest queue's (they attribute one
+    pipeline; a firehose run must not inherit a previous run's counts)."""
+    for k in stats:
+        stats[k] = 0
+    ingest.reset_stats()
+
+
+def _telemetry_provider() -> dict:
+    return {**stats, "queue": ingest.snapshot()}
+
+
+telemetry.register_provider("node", _telemetry_provider, replace=True)
+
+
+def engine_backed_on_block(spec, store, signed_block) -> None:
+    """``spec.on_block`` with the state transition routed through the
+    batched stf engine — same store mutations, same exceptions at the
+    same points (``apply_signed_blocks`` is differentially pinned to
+    ``state_transition``), so this is a drop-in for the fork-choice
+    engine's ``block_handler`` seam."""
+    block = signed_block.message
+    # Parent block must be known
+    assert block.parent_root in store.block_states
+    pre_state = store.block_states[block.parent_root].copy()
+    # Blocks cannot be in the future
+    assert spec.get_current_slot(store) >= block.slot
+    # Block must be later than the finalized epoch slot, on its chain
+    finalized_slot = spec.compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch)
+    assert block.slot > finalized_slot
+    assert spec.get_ancestor(store, block.parent_root, finalized_slot) == \
+        store.finalized_checkpoint.root
+
+    # the one substitution: the batched engine instead of the literal
+    # spec.state_transition (rollback/breaker/replay semantics inside)
+    state = pre_state.copy()
+    apply_signed_blocks(spec, state, (signed_block,), True)
+
+    # [New in Bellatrix] merge-transition validation, against the
+    # untransitioned pre-state exactly as the spec orders it
+    is_mtb = getattr(spec, "is_merge_transition_block", None)
+    if is_mtb is not None and is_mtb(pre_state, block.body):
+        spec.validate_merge_block(block)
+
+    root = spec.hash_tree_root(block)
+    store.blocks[root] = block
+    store.block_states[root] = state
+
+    time_into_slot = ((store.time - store.genesis_time)
+                      % spec.config.SECONDS_PER_SLOT)
+    is_before_attesting_interval = (
+        time_into_slot
+        < spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT)
+    if spec.get_current_slot(store) == block.slot \
+            and is_before_attesting_interval:
+        store.proposer_boost_root = root
+
+    if state.current_justified_checkpoint.epoch > \
+            store.justified_checkpoint.epoch:
+        if state.current_justified_checkpoint.epoch > \
+                store.best_justified_checkpoint.epoch:
+            store.best_justified_checkpoint = \
+                state.current_justified_checkpoint
+        if spec.should_update_justified_checkpoint(
+                store, state.current_justified_checkpoint):
+            store.justified_checkpoint = state.current_justified_checkpoint
+
+    if state.finalized_checkpoint.epoch > store.finalized_checkpoint.epoch:
+        store.finalized_checkpoint = state.finalized_checkpoint
+        store.justified_checkpoint = state.current_justified_checkpoint
+
+
+def default_anchor_block(spec, anchor_state):
+    """The anchor block a state implies: its ``latest_block_header`` with
+    the state root filled — valid whenever the header's body root is the
+    empty body's (genesis states; firehose-prepared anchors)."""
+    header = anchor_state.latest_block_header
+    return spec.BeaconBlock(
+        slot=header.slot, proposer_index=header.proposer_index,
+        parent_root=header.parent_root,
+        state_root=anchor_state.hash_tree_root())
+
+
+class Node:
+    """A servable consensus node: fork choice + batched state transition
+    behind one single-writer handler surface and one ingest queue."""
+
+    def __init__(self, spec, anchor_state, anchor_block=None,
+                 queue_cap: int = ingest.DEFAULT_CAP, journal: bool = True):
+        self.spec = spec
+        if anchor_block is None:
+            anchor_block = default_anchor_block(spec, anchor_state)
+        store = spec.get_forkchoice_store(anchor_state, anchor_block)
+        self.engine = ForkChoiceEngine(
+            spec, store, block_handler=self._on_block_stf)
+        self.queue = ingest.IngestQueue(cap=queue_cap)
+        # apply-order journal: the literal-spec parity replay's script.
+        # Owner-mutated only (analyzer-registered next to the queue).
+        self._journal = [] if journal else None
+        self._writer_lock = threading.Lock()
+        self._clock_cond = threading.Condition()
+        self._clock_slot = int(spec.get_current_slot(store))
+
+    def _on_block_stf(self, store, signed_block) -> None:
+        """The ``block_handler`` installed on the fork-choice engine:
+        the spec handler shape with the batched stf transition."""
+        engine_backed_on_block(self.spec, store, signed_block)
+
+    # -- single-writer contract ----------------------------------------------
+
+    @contextlib.contextmanager
+    def _single_writer(self):
+        if not self._writer_lock.acquire(blocking=False):
+            raise RuntimeError(
+                "concurrent node apply: fork choice is single-writer — "
+                "producers must enqueue, only the apply loop applies")
+        try:
+            yield
+        finally:
+            self._writer_lock.release()
+
+    def _journal_append(self, kind: str, payload) -> None:
+        if self._journal is not None:
+            self._journal.append((kind, payload))
+
+    def _note_clock(self) -> None:
+        slot = int(self.spec.get_current_slot(self.engine.store))
+        if slot != self._clock_slot:
+            with self._clock_cond:
+                self._clock_slot = slot
+                self._clock_cond.notify_all()
+
+    def wait_for_clock(self, slot: int,
+                       timeout: Optional[float] = None) -> bool:
+        """Block until the store clock reaches ``slot`` (producers pace
+        themselves against the apply loop with this — e.g. gossip for
+        slot N enqueues only once the clock passed N, so the votes are
+        mature on arrival)."""
+        with self._clock_cond:
+            return self._clock_cond.wait_for(
+                lambda: self._clock_slot >= slot, timeout)
+
+    # -- handlers (the single writer's API) ----------------------------------
+
+    def on_tick(self, time) -> None:
+        with self._single_writer():
+            _SITE_APPLY()
+            self.engine.on_tick(time)
+            stats["ticks_applied"] += 1
+            self._journal_append("tick", int(time))
+        self._note_clock()
+
+    def on_block(self, signed_block) -> None:
+        with self._single_writer():
+            _SITE_APPLY()
+            self.engine.on_block(signed_block)
+            stats["blocks_applied"] += 1
+            self._journal_append("block", signed_block)
+            if recorder.enabled():
+                recorder.record("node_block",
+                                slot=int(signed_block.message.slot))
+
+    def on_attestations(self, attestations: Sequence,
+                        is_from_block: bool = False) -> None:
+        with self._single_writer():
+            _SITE_APPLY()
+            self.engine.on_attestations(list(attestations),
+                                        is_from_block=is_from_block)
+            stats["attestation_batches_applied"] += 1
+            stats["attestations_applied"] += len(attestations)
+            self._journal_append("attestations", tuple(attestations))
+            if recorder.enabled():
+                recorder.record("node_gossip", n=len(attestations))
+
+    def on_attestation(self, attestation, is_from_block: bool = False) -> None:
+        self.on_attestations([attestation], is_from_block=is_from_block)
+
+    def on_attester_slashing(self, attester_slashing) -> None:
+        with self._single_writer():
+            _SITE_APPLY()
+            self.engine.on_attester_slashing(attester_slashing)
+            self._journal_append("attester_slashing", attester_slashing)
+
+    def get_head(self):
+        return self.engine.get_head()
+
+    @property
+    def store(self):
+        return self.engine.store
+
+    @property
+    def journal(self) -> list:
+        return list(self._journal or ())
+
+    # -- producer surface ----------------------------------------------------
+
+    def enqueue_tick(self, time, timeout: Optional[float] = None) -> None:
+        self.queue.put("tick", int(time), timeout=timeout)
+
+    def enqueue_block(self, signed_block,
+                      timeout: Optional[float] = None) -> None:
+        self.queue.put("block", signed_block, timeout=timeout)
+
+    def enqueue_attestations(self, attestations: Sequence,
+                             timeout: Optional[float] = None) -> None:
+        self.queue.put("attestations", tuple(attestations), timeout=timeout)
+
+    # -- the apply loop ------------------------------------------------------
+
+    def apply_item(self, item: ingest.WorkItem) -> None:
+        """Apply one dequeued work item.  A rejected gossip batch (spec
+        validation ``AssertionError``) is counted and dropped; ANY other
+        failure re-queues the item at the head and propagates — the
+        store and proto-array are untouched past the probe, so a retry
+        picks up exactly where the loop stopped."""
+        try:
+            with timeline.span("node/apply", link=item.link, kind=item.kind):
+                if item.kind == "tick":
+                    self.on_tick(item.payload)
+                elif item.kind == "block":
+                    self.on_block(item.payload)
+                elif item.kind == "attestations":
+                    try:
+                        self.on_attestations(item.payload)
+                    except AssertionError:
+                        stats["rejected_batches"] += 1
+                        stats["rejected_attestations"] += len(item.payload)
+                        if recorder.enabled():
+                            recorder.record("node_gossip_rejected",
+                                            n=len(item.payload))
+                else:
+                    raise ValueError(f"unknown work item kind {item.kind!r}")
+        except BaseException:
+            self.queue.requeue_front(item)
+            stats["requeued_items"] += 1
+            raise
+
+    def run_apply_loop(self, timeout: Optional[float] = None) -> int:
+        """Drain the queue until it is closed and empty (or ``timeout``
+        elapses waiting for work).  Returns the number of items applied.
+        This is THE single writer: run it on one thread."""
+        stats["apply_loop_runs"] += 1
+        applied = 0
+        while True:
+            item = self.queue.get(timeout=timeout)
+            if item is None:
+                return applied
+            self.apply_item(item)
+            applied += 1

@@ -71,19 +71,37 @@ def _slot_wall_time(spec, state, slot) -> int:
 
 _engine_mode = False
 _engine_mirrors: dict = {}  # id(primary store) -> ForkChoiceEngine
+_mirror_factory = None      # (spec, genesis_state, anchor) -> engine-like
+
+
+def _default_mirror_factory(spec, genesis_state, anchor):
+    from consensus_specs_tpu.forkchoice import ForkChoiceEngine
+
+    shadow = spec.get_forkchoice_store(genesis_state, anchor)
+    return ForkChoiceEngine(spec, shadow)
 
 
 @contextlib.contextmanager
-def engine_mode():
+def engine_mode(mirror_factory=None):
     """Mirror every helper-driven store mutation into a shadow proto-array
-    engine and assert head/checkpoint parity after each step."""
-    global _engine_mode
-    prev = _engine_mode
+    engine and assert head/checkpoint parity after each step.
+
+    ``mirror_factory`` swaps WHAT shadows the store: any object exposing
+    the engine handler surface (``on_tick`` / ``on_block`` /
+    ``on_attestations`` / ``on_attester_slashing`` / ``get_head`` /
+    ``.store``) works — the node differential suite passes a
+    ``Node``-backed factory so every scenario scripted through these
+    helpers also pins the engine-backed ``on_block`` pipeline (ISSUE
+    12)."""
+    global _engine_mode, _mirror_factory
+    prev, prev_factory = _engine_mode, _mirror_factory
     _engine_mode = True
+    _mirror_factory = mirror_factory or _default_mirror_factory
     try:
         yield
     finally:
         _engine_mode = prev
+        _mirror_factory = prev_factory
         if not _engine_mode:
             _engine_mirrors.clear()
 
@@ -141,10 +159,8 @@ def get_genesis_forkchoice_store_and_block(spec, genesis_state):
     anchor = spec.BeaconBlock(state_root=genesis_state.hash_tree_root())
     store = spec.get_forkchoice_store(genesis_state, anchor)
     if _engine_mode:
-        from consensus_specs_tpu.forkchoice import ForkChoiceEngine
-
-        shadow = spec.get_forkchoice_store(genesis_state, anchor)
-        _engine_mirrors[id(store)] = (store, ForkChoiceEngine(spec, shadow))
+        _engine_mirrors[id(store)] = (
+            store, _mirror_factory(spec, genesis_state, anchor))
     return store, anchor
 
 

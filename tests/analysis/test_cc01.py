@@ -124,3 +124,22 @@ def test_cc01_respects_targeted_noqa():
     src = _ALIAS_INSERT.replace(
         "] = perm", "] = perm  # noqa: CC01 (test warms the cache)")
     assert cc01("tests/helper.py", src) == []
+
+
+def test_cc01_flags_node_queue_and_journal_pokes():
+    # ISSUE 12: the ingest deque and the apply journal are single-writer
+    # structures — an outside append breaks back-pressure/FIFO causality
+    # (queue) or fakes an applied history (journal)
+    src = ("def smuggle(queue, node, item):\n"
+           "    queue._items.append(item)\n"
+           "    node._journal[0] = ('block', item)\n")
+    found = cc01("consensus_specs_tpu/stf/helper.py", src)
+    assert [f.line for f in found] == [2, 3]
+    assert "node ingest queue" in found[0].message
+    assert "node apply journal" in found[1].message
+
+
+def test_cc01_node_owner_module_is_exempt():
+    src = ("def requeue_front(self, item):\n"
+           "    self._items.appendleft(item)\n")
+    assert cc01("consensus_specs_tpu/node/ingest.py", src) == []

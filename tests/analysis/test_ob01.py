@@ -149,3 +149,23 @@ def test_ob01_telemetry_module_is_exempt_from_span_check():
            "    sid = timeline.begin(name)\n"
            "    return sid\n")
     assert ob01("consensus_specs_tpu/telemetry/metrics.py", src) == []
+
+
+def test_ob01_node_commit_kinds_inside_open_transaction_are_flagged():
+    # ISSUE 12: node_block/node_gossip assert an item fully applied —
+    # the same commit-class discipline as cache_commit/block_fast
+    src = _HEADER + ("def apply_item(spec, state, sb):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "        telemetry.record('node_block', slot=1)\n")
+    found = ob01("consensus_specs_tpu/node/x.py", src)
+    assert [f.line for f in found] == [8]
+    assert "never happened" in found[0].message
+
+
+def test_ob01_node_gossip_after_the_with_block_is_clean():
+    src = _HEADER + ("def apply_item(spec, state, batch):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "    telemetry.record('node_gossip', n=len(batch))\n")
+    assert ob01("consensus_specs_tpu/node/x.py", src) == []

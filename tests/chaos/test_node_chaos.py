@@ -1,0 +1,174 @@
+"""Node-pipeline chaos: injected failures at the ingest/apply seams must
+leave the store, the proto-array, and the queue mutually consistent —
+the failed item back at the queue head, no partial store mutation, head
+parity with a literal-spec replay of the journal across the fault, and
+a clean retry.
+
+``COVERED_SITES`` is closed over by test_registry_complete.py.
+"""
+import pytest
+
+from consensus_specs_tpu import faults
+from consensus_specs_tpu.node import Node, firehose
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+F = faults.Fault
+
+COVERED_SITES = {"node.apply", "node.enqueue"}
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    """Corpus construction and replay run BLS off (signature seams belong
+    to the stf chaos suite; the node seams are queue/apply discipline)."""
+    from consensus_specs_tpu.crypto import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+_SCAFFOLD = {}
+
+
+def _scaffold():
+    """(spec, genesis_state, corpus): one epoch of full blocks plus ~200
+    single-attester gossip votes, the firehose corpus shape at chaos
+    scale."""
+    if not _SCAFFOLD:
+        from consensus_specs_tpu.specs.builder import get_spec
+
+        spec = get_spec("phase0", "minimal")
+        state = create_genesis_state(
+            spec, default_balances(spec), default_activation_threshold(spec))
+        corpus = firehose.build_corpus(
+            spec, state, n_epochs=1, gossip_target=200)
+        _SCAFFOLD["phase0"] = (spec, state, corpus)
+    return _SCAFFOLD["phase0"]
+
+
+def _enqueue_prefix(spec, node, corpus, n_blocks):
+    """Queue ticks+blocks for ``corpus.chain[:n_blocks]`` plus the first
+    block's gossip — a deterministic single-writer workload (no producer
+    threads; thread concurrency is the firehose tests' job)."""
+    for signed in corpus.chain[:n_blocks]:
+        s = int(signed.message.slot)
+        node.enqueue_tick(int(node.store.genesis_time)
+                          + s * int(spec.config.SECONDS_PER_SLOT))
+        node.enqueue_block(signed)
+    last = int(corpus.chain[n_blocks - 1].message.slot)
+    node.enqueue_tick(int(node.store.genesis_time)
+                      + (last + 1) * int(spec.config.SECONDS_PER_SLOT))
+    node.enqueue_attestations(corpus.gossip[int(
+        corpus.chain[0].message.slot)])
+    node.queue.close()
+
+
+def test_apply_fault_leaves_node_untouched_and_item_requeued():
+    """A fault at the apply seam fires before any store/proto mutation:
+    the failed item sits back at the queue head, nothing half-landed,
+    and a retried loop drains to the exact state a fault-free literal
+    replay of the journal produces."""
+    spec, state, corpus = _scaffold()
+    node = Node(spec, state)
+    _enqueue_prefix(spec, node, corpus, 3)
+    depth_before = node.queue.depth()
+
+    # hit 4 = the second block's apply (tick, block, tick, block)
+    with faults.inject(faults.FaultPlan([F("node.apply", nth=4)])):
+        with pytest.raises(faults.InjectedFault):
+            node.run_apply_loop()
+    # first block landed, second did not — and is back at the head
+    assert len(node.store.blocks) == 2  # anchor + block 1
+    assert len(node.engine.proto) == 2
+    head_item = node.queue.get(timeout=0)
+    assert head_item.kind == "block"
+    assert int(head_item.payload.message.slot) == \
+        int(corpus.chain[1].message.slot)
+    node.queue.requeue_front(head_item)
+    assert node.queue.depth() == depth_before - 3
+
+    # retry drains the remainder; end state parity vs the literal spec
+    node.run_apply_loop()
+    ref = firehose.replay_journal_literal(
+        spec, state, corpus.anchor_block, node._journal)
+    firehose.assert_parity(spec, node, ref)
+
+
+def test_enqueue_fault_leaves_queue_untouched():
+    """The enqueue probe fires before the append: a dying put leaves the
+    queue empty and a retried put lands the same item."""
+    spec, state, corpus = _scaffold()
+    node = Node(spec, state)
+    with faults.inject(faults.FaultPlan([F("node.enqueue")])):
+        with pytest.raises(faults.InjectedFault):
+            node.enqueue_block(corpus.chain[0])
+    assert node.queue.depth() == 0
+    node.enqueue_block(corpus.chain[0])
+    assert node.queue.depth() == 1
+
+
+def test_apply_fault_mid_firehose_holds_journal_parity():
+    """A fault mid-CONCURRENT-firehose: the run raises, producers abort,
+    and everything the node DID apply before the fault replays through
+    the literal spec to byte-identical head/root — the partial journal
+    is a true history.  A fresh fault-free run over the same corpus then
+    succeeds end-to-end (retry at run granularity)."""
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.node import service
+
+    spec, state, corpus = _scaffold()
+    service.reset_stats()
+    with faults.inject(faults.FaultPlan([F("node.apply", nth=9)])):
+        with pytest.raises(faults.InjectedFault):
+            firehose.run_firehose(
+                spec, state, corpus, n_gossip_producers=3, queue_cap=8,
+                gossip_batch=32, producer_timeout=30.0)
+    # the faulted node is gone with the raise; what matters is the redo:
+    stf.reset_stats()
+    service.reset_stats()
+    result = firehose.run_firehose(
+        spec, state, corpus, n_gossip_producers=3, queue_cap=8,
+        gossip_batch=32, producer_timeout=30.0)
+    node = result["node"]
+    assert stf.stats["replayed_blocks"] == 0
+    ref = firehose.replay_journal_literal(
+        spec, state, corpus.anchor_block, node._journal)
+    firehose.assert_parity(spec, node, ref)
+
+
+def test_apply_fault_partial_journal_is_replayable():
+    """The sharper mid-firehose claim: hold on to the faulted node and
+    prove its PARTIAL journal replays to parity — the fault tore nothing
+    (single-writer loop + pre-mutation probe = item-granular
+    atomicity)."""
+    spec, state, corpus = _scaffold()
+    node = Node(spec, state)
+    _enqueue_prefix(spec, node, corpus, 4)
+    with faults.inject(faults.FaultPlan([F("node.apply", nth=6)])):
+        with pytest.raises(faults.InjectedFault):
+            node.run_apply_loop()
+    assert len(node._journal) == 5  # items applied before the fault
+    ref = firehose.replay_journal_literal(
+        spec, state, corpus.anchor_block, node._journal)
+    firehose.assert_parity(spec, node, ref)
+
+
+def test_single_writer_contract_is_enforced():
+    """A second concurrent writer raises instead of corrupting the
+    store: the writer lock is held across every apply."""
+    spec, state, corpus = _scaffold()
+    node = Node(spec, state)
+    acquired = node._writer_lock.acquire(blocking=False)
+    assert acquired
+    try:
+        with pytest.raises(RuntimeError, match="single-writer"):
+            node.on_tick(int(node.store.genesis_time) + 6)
+    finally:
+        node._writer_lock.release()
+    node.on_tick(int(node.store.genesis_time) + 6)  # and now it applies

@@ -13,9 +13,10 @@ from consensus_specs_tpu import faults
 
 # importing the instrumented modules registers their sites
 import consensus_specs_tpu.forkchoice.engine  # noqa: F401
+import consensus_specs_tpu.node.service  # noqa: F401  (registers ingest's too)
 import consensus_specs_tpu.stf.engine  # noqa: F401
 
-from . import test_forkchoice_chaos, test_stf_chaos
+from . import test_forkchoice_chaos, test_node_chaos, test_stf_chaos
 
 
 def _production_sites():
@@ -27,7 +28,8 @@ def _production_sites():
 def test_every_site_has_a_chaos_case():
     registered = _production_sites()
     covered = (set(test_stf_chaos.COVERED_SITES)
-               | set(test_forkchoice_chaos.COVERED_SITES))
+               | set(test_forkchoice_chaos.COVERED_SITES)
+               | set(test_node_chaos.COVERED_SITES))
     missing = registered - covered
     assert not missing, (
         f"fault sites with no chaos case: {sorted(missing)} — add a case to "

@@ -1,0 +1,171 @@
+"""Firehose harness tests (ISSUE 12).
+
+The fast smoke runs in tier-1: a real concurrent run — 4 producer
+threads over the bounded queue, two epochs of minimal-preset blocks,
+gossip interleaved — with journal-replay parity vs the literal spec and
+the stf fast path asserted on every block.  The slow-marked deep
+profile (``make firehose``) scales the same run up via
+``CSTPU_FIREHOSE_GOSSIP`` / ``_EPOCHS`` / ``_PRODUCERS`` and adds the
+telemetry-surface assertions (bus provider, recorder events)."""
+import os
+
+import pytest
+
+from consensus_specs_tpu import stf, telemetry
+from consensus_specs_tpu.node import firehose, service
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    from consensus_specs_tpu.crypto import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+_STATE = {}
+
+
+def _spec_and_state():
+    if not _STATE:
+        from consensus_specs_tpu.specs.builder import get_spec
+
+        spec = get_spec("phase0", "minimal")
+        state = create_genesis_state(
+            spec, default_balances(spec), default_activation_threshold(spec))
+        _STATE["phase0"] = (spec, state)
+    return _STATE["phase0"]
+
+
+def _run(spec, state, corpus, **kw):
+    service.reset_stats()
+    stf.reset_stats()
+    result = firehose.run_firehose(spec, state, corpus, **kw)
+    node = result["node"]
+    ref = firehose.replay_journal_literal(
+        spec, state, corpus.anchor_block, node._journal)
+    result["parity"] = firehose.assert_parity(spec, node, ref)
+    return result
+
+
+def test_firehose_smoke_concurrent_parity():
+    """Two epochs, 4 producer threads, a deliberately tight queue: every
+    block through the engine-backed fast path, every gossip batch
+    accepted, and byte-identical head/root vs the literal spec replay of
+    the node's own apply journal."""
+    spec, state = _spec_and_state()
+    corpus = firehose.build_corpus(spec, state, n_epochs=2,
+                                   gossip_target=600)
+    result = _run(spec, state, corpus, n_gossip_producers=3, queue_cap=16,
+                  gossip_batch=64, producer_timeout=60.0)
+    assert result["producer_threads"] == 4
+    assert result["blocks"] == 2 * int(spec.SLOTS_PER_EPOCH)
+    assert result["gossip_attestations"] >= 600
+    assert stf.stats["fast_blocks"] == result["blocks"]
+    assert stf.stats["replayed_blocks"] == 0
+    assert result["service"]["rejected_batches"] == 0
+    # the bounded queue actually exercised (items far exceed the cap)
+    assert result["queue"]["enqueued"] > 16
+
+
+def test_firehose_backpressure_engages():
+    """A cap-1 queue forces every producer through the full-queue wait at
+    least once — the back-pressure path is a tested path, not a
+    theoretical one."""
+    spec, state = _spec_and_state()
+    corpus = firehose.build_corpus(spec, state, n_epochs=1,
+                                   gossip_target=100)
+    result = _run(spec, state, corpus, n_gossip_producers=3, queue_cap=1,
+                  gossip_batch=16, producer_timeout=60.0)
+    assert result["queue"]["blocked_puts"] > 0
+    assert result["queue"]["blocked_s"] > 0
+
+
+def test_firehose_rejected_gossip_is_counted_not_fatal():
+    """A batch the spec rejects (unknown block root) is dropped and
+    counted; the run completes and parity holds for what WAS applied."""
+    spec, state = _spec_and_state()
+    corpus = firehose.build_corpus(spec, state, n_epochs=1,
+                                   gossip_target=60)
+    # poison one slot's gossip: votes for a root the store never sees
+    bad_slot = sorted(corpus.gossip)[2]
+    for att in corpus.gossip[bad_slot]:
+        att.data.beacon_block_root = b"\xee" * 32
+    result = _run(spec, state, corpus, n_gossip_producers=2, queue_cap=8,
+                  gossip_batch=16, producer_timeout=60.0)
+    assert result["service"]["rejected_batches"] > 0
+    assert result["service"]["rejected_attestations"] == \
+        len(corpus.gossip[bad_slot])
+
+
+def test_node_telemetry_provider_on_bus():
+    """The ``node`` snapshot provider reports the pipeline's counters and
+    the queue gauge through the same bus every other producer uses."""
+    spec, state = _spec_and_state()
+    corpus = firehose.build_corpus(spec, state, n_epochs=1,
+                                   gossip_target=50)
+    _run(spec, state, corpus, n_gossip_producers=2, queue_cap=8,
+         gossip_batch=16, producer_timeout=60.0)
+    snap = telemetry.snapshot()["providers"]["node"]
+    assert snap["blocks_applied"] == len(corpus.chain)
+    assert snap["attestations_applied"] >= 50
+    assert snap["queue"]["depth"] == 0
+    assert snap["queue"]["enqueued"] == snap["queue"]["dequeued"]
+    assert sum(snap["queue"]["producers"].values()) == \
+        snap["queue"]["enqueued"]
+
+
+def test_firehose_timeline_shows_producer_to_apply_handoff():
+    """With the timeline armed, enqueue and apply spans share each item's
+    causality link across threads — the Perfetto handoff edge exists in
+    the ring (ISSUE 12 telemetry satellite)."""
+    from consensus_specs_tpu.telemetry import timeline
+
+    spec, state = _spec_and_state()
+    corpus = firehose.build_corpus(spec, state, n_epochs=1,
+                                   gossip_target=40)
+    timeline.reset()
+    timeline.enable()
+    try:
+        _run(spec, state, corpus, n_gossip_producers=2, queue_cap=8,
+             gossip_batch=16, producer_timeout=60.0)
+        events = timeline.events()
+    finally:
+        timeline.disable()
+        timeline.reset()
+    enq = {e["link"]: e for e in events
+           if e.get("name") == "node/enqueue" and "link" in e}
+    app = [e for e in events
+           if e.get("name") == "node/apply" and "link" in e]
+    assert enq and app
+    crossed = [e for e in app
+               if e["link"] in enq and e["tid"] != enq[e["link"]]["tid"]]
+    assert crossed, "no cross-thread enqueue->apply link found"
+
+
+@pytest.mark.slow
+def test_firehose_deep_profile():
+    """The ``make firehose`` leg: a heavier seeded run (env-scalable) —
+    same asserts as the smoke at a volume that makes the queue bound,
+    the epoch fence, and the fork-choice prune all work for a living."""
+    spec, state = _spec_and_state()
+    n_epochs = int(os.environ.get("CSTPU_FIREHOSE_EPOCHS", "4"))
+    gossip = int(os.environ.get("CSTPU_FIREHOSE_GOSSIP", "20000"))
+    producers = int(os.environ.get("CSTPU_FIREHOSE_PRODUCERS", "3"))
+    corpus = firehose.build_corpus(spec, state, n_epochs=n_epochs,
+                                   gossip_target=gossip)
+    result = _run(spec, state, corpus, n_gossip_producers=producers,
+                  queue_cap=32, gossip_batch=256, producer_timeout=120.0)
+    assert result["gossip_attestations"] >= gossip
+    assert stf.stats["replayed_blocks"] == 0
+    assert stf.stats["fast_blocks"] == result["blocks"]
+    assert result["service"]["rejected_batches"] == 0
+    # deep chains finalize: the prune path ran mid-firehose
+    assert result["node"].store.finalized_checkpoint.epoch > 0

@@ -1,0 +1,146 @@
+"""Ingest-queue unit pins: bounded back-pressure, FIFO across concurrent
+producers, close semantics, failure re-queue, and the telemetry
+counters the node provider reports."""
+import threading
+import time
+
+import pytest
+
+from consensus_specs_tpu.node import ingest
+from consensus_specs_tpu.node.ingest import IngestQueue
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    ingest.reset_stats()
+    yield
+    ingest.reset_stats()
+
+
+def test_fifo_order_and_counters():
+    q = IngestQueue(cap=8)
+    for i in range(5):
+        q.put("tick", i)
+    q.close()
+    got = []
+    while True:
+        item = q.get(timeout=0)
+        if item is None:
+            break
+        got.append(item.payload)
+    assert got == [0, 1, 2, 3, 4]
+    assert ingest.stats["enqueued"] == 5
+    assert ingest.stats["dequeued"] == 5
+    assert ingest.stats["depth_max"] == 5
+
+
+def test_bounded_put_blocks_until_space_and_counts():
+    q = IngestQueue(cap=2)
+    q.put("tick", 0)
+    q.put("tick", 1)
+
+    landed = threading.Event()
+
+    def producer():
+        q.put("tick", 2)  # must block: queue full
+        landed.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not landed.is_set()
+    assert q.get().payload == 0  # frees a slot
+    assert landed.wait(timeout=5)
+    t.join(timeout=5)
+    assert ingest.stats["blocked_puts"] == 1
+    assert ingest.stats["blocked_s"] > 0
+    assert [q.get().payload, q.get().payload] == [1, 2]
+
+
+def test_put_timeout_raises_and_drops_nothing():
+    q = IngestQueue(cap=1)
+    q.put("tick", 0)
+    with pytest.raises(TimeoutError):
+        q.put("tick", 1, timeout=0.05)
+    assert q.depth() == 1
+    assert q.get().payload == 0
+
+
+def test_closed_queue_rejects_puts_and_drains():
+    q = IngestQueue(cap=4)
+    q.put("block", "b")
+    q.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        q.put("tick", 1)
+    assert q.get().kind == "block"
+    assert q.get(timeout=0) is None  # closed + drained = end of stream
+    assert q.get(timeout=0) is None  # and stays that way
+
+
+def test_close_wakes_blocked_producer():
+    q = IngestQueue(cap=1)
+    q.put("tick", 0)
+    failed = []
+
+    def producer():
+        try:
+            q.put("tick", 1)
+        except RuntimeError as exc:
+            failed.append(exc)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    q.close()
+    t.join(timeout=5)
+    assert failed, "blocked producer must wake and see the close"
+    assert q.depth() == 1  # the blocked item never half-landed
+
+
+def test_requeue_front_restores_head_position():
+    q = IngestQueue(cap=4)
+    q.put("tick", 0)
+    q.put("block", "b")
+    item = q.get()
+    q.requeue_front(item)
+    assert q.get().payload == 0  # the failed item is next again
+    assert ingest.stats["requeued"] == 1
+
+
+def test_fifo_across_concurrent_producers():
+    """Cross-thread FIFO: each producer's own enqueue order is preserved
+    in the drain (the causality the firehose's epoch fencing relies
+    on)."""
+    q = IngestQueue(cap=16)
+    n_each = 50
+
+    def producer(tag):
+        for i in range(n_each):
+            q.put("tick", (tag, i))
+
+    threads = [threading.Thread(target=producer, args=(t,), daemon=True)
+               for t in range(4)]
+    for t in threads:
+        t.start()
+
+    got = []
+    while len(got) < 4 * n_each:
+        item = q.get(timeout=10)
+        assert item is not None
+        got.append(item.payload)
+    for t in threads:
+        t.join(timeout=5)
+    for tag in range(4):
+        seq = [i for (g, i) in got if g == tag]
+        assert seq == sorted(seq), f"producer {tag} order not preserved"
+    producers = ingest.stats["producers"]
+    assert sum(producers.values()) == 4 * n_each
+
+
+def test_snapshot_reports_live_depth():
+    q = IngestQueue(cap=4)
+    q.put("tick", 0)
+    snap = ingest.snapshot()
+    assert snap["depth"] == 1
+    assert snap["cap"] == 4
+    assert snap["enqueued"] == 1

@@ -1,0 +1,129 @@
+"""Differential suite: the node serving pipeline vs the literal spec
+``Store`` (ISSUE 12).
+
+The engine differential suite pins the proto-array engine; this suite
+pins the NODE — the same adversarial scenarios replayed with a
+``Node``-backed mirror, so every helper-driven store mutation runs
+through the engine-backed ``on_block`` (fork choice + batched stf
+transition as one pipeline) with head + justified/finalized parity
+asserted after every step.  What this adds over the engine suite: the
+spec-handler reimplementation in ``node/service.py``
+(``engine_backed_on_block``) is held to the spec's exact accept/reject
+behavior — boost timing, finality-descendant checks, future-block
+rejection — across every scenario in the get_head / ex_ante / on_block
+suites, plus a finalizing multi-epoch chain (justified refresh + prune
+through the node path).
+
+The full enumeration runs on phase0; altair replays a representative
+subset (the node handler is fork-agnostic — the stf engine owns the
+fork dispatch, and the engine suite already drives both phases through
+the identical mirror machinery — so the altair leg guards the
+composition, not the scenarios; tier-1 stays within budget).
+"""
+import pytest
+
+from consensus_specs_tpu.node import Node
+from consensus_specs_tpu.testing.context import spec_state_test, with_phases
+from consensus_specs_tpu.testing.helpers.fork_choice import (
+    apply_next_epoch_with_attestations,
+    assert_engine_parity,
+    engine_mode,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+)
+from consensus_specs_tpu.testing.helpers.state import next_epoch
+
+from . import test_ex_ante as _ex_ante
+from . import test_get_head as _get_head
+from . import test_on_block as _on_block
+from .scenario import slot_time
+
+
+def _node_mirror(spec, genesis_state, anchor):
+    """The shadow: a full Node (engine-backed on_block, journal off —
+    scenario replays don't need the parity script)."""
+    return Node(spec, genesis_state, anchor, journal=False)
+
+
+_REPLAY_CASES = [
+    (mod, name)
+    for mod in (_get_head, _ex_ante, _on_block)
+    for name in sorted(dir(mod))
+    if name.startswith("test_")
+]
+
+# altair spot set: one scenario per suite, covering a head walk under
+# votes, an ex-ante boost interaction, and an on_block reject path
+_ALTAIR_SPOT = {"test_shorter_chain_but_heavier_weight",
+                "test_ex_ante_vanilla",
+                "test_on_block_future_block"}
+
+
+@pytest.mark.parametrize(
+    "mod,name", _REPLAY_CASES,
+    ids=[f"{m.__name__.rsplit('.', 1)[-1]}::{n}" for m, n in _REPLAY_CASES])
+def test_replay_scenario_through_node(mod, name):
+    """Re-run an existing adversarial fork-choice scenario with a Node
+    mirror attached: every handler call replays through the node's
+    single-writer surface (engine-backed on_block included) expecting
+    the same validity verdict, with parity asserted after each step."""
+    with engine_mode(mirror_factory=_node_mirror):
+        getattr(mod, name)(phase="phase0", bls_active=False)
+
+
+@pytest.mark.parametrize("name", sorted(_ALTAIR_SPOT))
+def test_replay_altair_scenario_through_node(name):
+    mod = next(m for m, n in _REPLAY_CASES if n == name)
+    with engine_mode(mirror_factory=_node_mirror):
+        getattr(mod, name)(phase="altair", bls_active=False)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_node_finalizing_chain(spec, state):
+    """Full-participation epochs through the node until finalization
+    advances: the engine-backed on_block carries justified refresh,
+    finalized movement, and the proto-array prune, with per-step parity
+    (the helpers assert it after every handler call)."""
+    test_steps = []
+    with engine_mode(mirror_factory=_node_mirror):
+        store, _anchor = get_genesis_forkchoice_store_and_block(
+            spec, state.copy())
+        next_epoch(spec, state)
+        on_tick_and_append_step(
+            spec, store, slot_time(spec, store, state.slot), test_steps)
+        for _ in range(3):
+            state, store, _last = yield from \
+                apply_next_epoch_with_attestations(
+                    spec, state, store, True, True, test_steps=test_steps)
+            assert_engine_parity(spec, store)
+        assert store.finalized_checkpoint.epoch > 0
+    yield "steps", "data", test_steps
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_node_on_block_stf_stats_engaged(spec, state):
+    """The composition proof at unit scale: a block applied through
+    ``Node.on_block`` lands in ``stf.stats`` as a fast block, not a
+    literal replay (the acceptance bar the firehose holds at 100k
+    scale)."""
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.testing.helpers.block import build_empty_block
+    from consensus_specs_tpu.testing.helpers.state import (
+        state_transition_and_sign_block,
+    )
+
+    anchor = state.copy()
+    block = build_empty_block(spec, state, slot=int(state.slot) + 1)
+    signed = state_transition_and_sign_block(spec, state, block)
+
+    node = Node(spec, anchor)
+    stf.reset_stats()
+    node.on_tick(int(anchor.genesis_time)
+                 + (int(block.slot) + 1) * int(spec.config.SECONDS_PER_SLOT))
+    node.on_block(signed)
+    assert stf.stats["fast_blocks"] == 1
+    assert stf.stats["replayed_blocks"] == 0
+    assert bytes(node.get_head()) == bytes(block.hash_tree_root())
+    yield None

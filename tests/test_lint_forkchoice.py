@@ -95,3 +95,26 @@ def test_legacy_check_file_facade_still_works(tmp_path):
     bad = tmp_path / "latin.py"
     bad.write_bytes(b"# caf\xe9\n")
     assert ["E902"] == [x[2].split()[0] for x in lint.check_file(bad)]
+
+
+_VOTE_STATE_VIOLATIONS = """\
+def bad(store, root, i):
+    store.proposer_boost_root = root        # boost rebind
+    store.equivocating_indices.add(i)       # the spec's own write shape
+    store.equivocating_indices.discard(i)
+    store.equivocating_indices = set()      # rebind
+"""
+
+
+def test_fc01_flags_widened_vote_state_mutations(tmp_path):
+    # ISSUE 12: proposer_boost_root + equivocating_indices (set mutators
+    # included — .add is how the spec itself writes it) join
+    # latest_messages under the rule
+    found = _findings_for(tmp_path, "helpers.py", _VOTE_STATE_VIOLATIONS)
+    assert sorted(f.line for f in found) == [2, 3, 4, 5]
+
+
+def test_fc01_exempts_node_dir(tmp_path):
+    d = tmp_path / "node"
+    d.mkdir()
+    assert _findings_for(d, "service.py", _VOTE_STATE_VIOLATIONS) == []

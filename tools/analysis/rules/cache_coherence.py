@@ -44,7 +44,12 @@ from typing import FrozenSet, Tuple
 from ..core import Rule, register
 from ..symbols import module_matches, root_name, written_targets
 
-_INSERTING_METHODS = {"update", "setdefault", "__setitem__"}
+# append-family methods cover the deque/list-shaped registered
+# structures (the pipeline in-flight queue, the node ingest queue) the
+# dict-shaped memos never needed guarding against (ISSUE 12)
+_INSERTING_METHODS = {"update", "setdefault", "__setitem__",
+                      "append", "appendleft", "extend", "extendleft",
+                      "insert"}
 _ARRAY_MUTATORS = {"fill", "sort", "put", "itemset", "partition", "resize"}
 
 
@@ -160,6 +165,27 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         module="consensus_specs_tpu.ssz.node",
         instance_attrs=frozenset({"_root", "_dirty_chunks"}),
         invalidators=frozenset({"_invalidate"}),
+    ),
+    # the node serving pipeline's single-writer structures (ISSUE 12):
+    # the bounded ingest deque moves items only through the owner's
+    # put/get/requeue_front (lock + FIFO + depth accounting live there —
+    # an outside append would break back-pressure and enqueue-order
+    # causality), and the apply journal is the parity replay's script (an
+    # outside write would make the literal-spec replay assert a history
+    # the node never applied)
+    CacheSpec(
+        name="node ingest queue",
+        owner=("node",),
+        module="consensus_specs_tpu.node.ingest",
+        instance_attrs=frozenset({"_items"}),
+        invalidators=frozenset(),
+    ),
+    CacheSpec(
+        name="node apply journal",
+        owner=("node",),
+        module="consensus_specs_tpu.node.service",
+        instance_attrs=frozenset({"_journal"}),
+        invalidators=frozenset(),
     ),
     # telemetry-owned structures (ISSUE 9): the provider registry and the
     # flight-recorder ring are mutated only through their owner module's

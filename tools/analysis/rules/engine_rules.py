@@ -4,7 +4,13 @@ FC01 — the spec ``Store`` and the proto-array engine each hold a
 latest-message view; they stay in lockstep only if every write goes
 through the spec handlers or ``forkchoice/batch.py``.  A stray
 ``store.latest_messages[i] = ...`` anywhere else silently desynchronizes
-the two vote stores.
+the two vote stores.  ISSUE 12 widens the guarded surface to the other
+head-determining store state — ``proposer_boost_root`` and
+``equivocating_indices`` — and sanctions ``node/`` alongside
+``forkchoice/``: the node's engine-backed ``on_block`` IS the spec
+handler's shape (it owns the boost write), but any other module writing
+these desynchronizes the proto-array mirror the same way a stray
+latest-message write would.
 
 ST01 — per-item ``bls.Verify`` / ``bls.FastAggregateVerify`` loops are
 the one-pairing-at-a-time pattern the batched block engine deletes; new
@@ -20,39 +26,57 @@ import ast
 from ..core import Rule, register
 from ..symbols import written_targets
 
+# dict mutators plus the set mutators equivocating_indices actually
+# sees (the spec's own write shape is store.equivocating_indices.add)
 _MUTATING_DICT_METHODS = {"update", "pop", "popitem", "clear", "setdefault",
-                          "__setitem__", "__delitem__"}
+                          "__setitem__", "__delitem__",
+                          "add", "remove", "discard",
+                          "difference_update", "symmetric_difference_update",
+                          "intersection_update"}
 
 
-def _is_latest_messages(expr) -> bool:
-    return isinstance(expr, ast.Attribute) and expr.attr == "latest_messages"
+# head-determining store state: the proto-array mirrors all of it, so a
+# write from an unsanctioned module silently desynchronizes the engine
+_STORE_VOTE_ATTRS = ("latest_messages", "proposer_boost_root",
+                     "equivocating_indices")
+
+
+def _store_vote_attr(expr):
+    if isinstance(expr, ast.Attribute) and expr.attr in _STORE_VOTE_ATTRS:
+        return expr.attr
+    return None
 
 
 @register
 class LatestMessagesMutationRule(Rule):
-    """Direct ``store.latest_messages`` mutation outside ``specs/`` and
-    ``forkchoice/``: subscript assignment / augmented assignment /
-    deletion, mutating dict-method calls, and rebinding the attribute."""
+    """Direct mutation of head-determining ``Store`` state
+    (``latest_messages`` / ``proposer_boost_root`` /
+    ``equivocating_indices``) outside ``specs/``, ``forkchoice/`` and
+    ``node/``: subscript assignment / augmented assignment / deletion,
+    mutating dict-method calls, and rebinding the attribute."""
 
     code = "FC01"
-    summary = "direct store.latest_messages mutation outside specs/+forkchoice/"
+    summary = "direct store vote-state mutation outside specs/+forkchoice/+node/"
 
     def check(self, ctx):
-        if ctx.tree is None or ctx.in_dir("specs", "forkchoice"):
+        if ctx.tree is None or ctx.in_dir("specs", "forkchoice", "node"):
             return
-        msg = ("direct store.latest_messages mutation "
-               "(route through spec handlers or forkchoice/batch.py)")
+        msg = ("direct store.{} mutation (route through spec handlers, "
+               "forkchoice/batch.py, or the node's engine-backed handler)")
         for node in ast.walk(ctx.tree):
             for kind, expr, method in written_targets(node):
                 if kind == "method":
-                    if (method in _MUTATING_DICT_METHODS
-                            and _is_latest_messages(expr)):
-                        yield (node.lineno, msg)
-                elif isinstance(expr, ast.Subscript) and _is_latest_messages(
-                        expr.value):
-                    yield (node.lineno, msg)
-                elif _is_latest_messages(expr):
-                    yield (node.lineno, msg)
+                    attr = _store_vote_attr(expr)
+                    if method in _MUTATING_DICT_METHODS and attr:
+                        yield (node.lineno, msg.format(attr))
+                elif isinstance(expr, ast.Subscript):
+                    attr = _store_vote_attr(expr.value)
+                    if attr:
+                        yield (node.lineno, msg.format(attr))
+                else:
+                    attr = _store_vote_attr(expr)
+                    if attr:
+                        yield (node.lineno, msg.format(attr))
 
 
 _PER_ITEM_VERIFY_FNS = {"Verify", "FastAggregateVerify"}

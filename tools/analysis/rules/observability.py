@@ -49,7 +49,12 @@ from ..core import Rule, register
 from ..symbols import name_matches, walk_scope
 
 _RING_APPENDERS = {"append", "appendleft", "extend", "extendleft", "insert"}
-_COMMIT_KINDS = {"cache_commit", "block_fast", "mirror_flush", "memo_commit"}
+# node_block / node_gossip (ISSUE 12) are the node pipeline's
+# commit-class events: each asserts an item fully applied — recorded
+# before the block's transaction settles, a fault would roll the apply
+# back and the timeline would claim a served item that never landed
+_COMMIT_KINDS = {"cache_commit", "block_fast", "mirror_flush",
+                 "memo_commit", "node_block", "node_gossip"}
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
