@@ -56,6 +56,14 @@ soak-deep:
 firehose:
 	python -m pytest tests/node tests/analysis/test_live_tree_clean.py -q
 
+# adversarial firehose (ISSUE 13 / ROADMAP item 4): the survival arc —
+# equivocation storms, long-range reorgs, finality stalls, junk and
+# duplicate floods through the admission gate + poison containment,
+# with journal parity, zero-halt and bounded-memory asserts; the same
+# CSTPU_FIREHOSE_* knobs scale the slow-marked deep profile
+firehose-adversarial:
+	python -m pytest tests/node/test_adversarial.py tests/node/test_admission.py tests/analysis/test_live_tree_clean.py -q
+
 # phase-attribution regression doctor (ISSUE 11): diff the two newest
 # bench snapshots (BENCH_DETAILS.json vs BENCH_DETAILS_PREV.json, or the
 # newest differing git version) and print ranked per-phase attribution
@@ -92,4 +100,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep firehose doctor limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE
+.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep firehose firehose-adversarial doctor limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE

@@ -1164,6 +1164,15 @@ def bench_forkchoice_ingest(results, n_validators=None, n_attestations=100_000):
         bls.bls_active = was_active
 
 
+def _framed_atts_by_slot(path, spec):
+    """Load a framed attestation file back into the corpus's
+    slot-keyed table (shared by the honest and adversarial caches)."""
+    out = {}
+    for att in _read_framed(path, spec.Attestation):
+        out.setdefault(int(att.data.slot), []).append(att)
+    return out
+
+
 def _firehose_corpus_through_cache(spec, state, n_epochs, gossip_target):
     """Firehose corpus cache (chain + gossip), keyed like the block
     corpus: a pure function of the prepared anchor state's root and the
@@ -1178,11 +1187,9 @@ def _firehose_corpus_through_cache(spec, state, n_epochs, gossip_target):
     if os.path.exists(blocks_path) and os.path.exists(atts_path):
         def _load():
             chain = _read_framed(blocks_path, spec.SignedBeaconBlock)
-            gossip = {}
-            for att in _read_framed(atts_path, spec.Attestation):
-                gossip.setdefault(int(att.data.slot), []).append(att)
             return firehose.FirehoseCorpus(
-                firehose.default_anchor_block(spec, state), chain, gossip)
+                firehose.default_anchor_block(spec, state), chain,
+                _framed_atts_by_slot(atts_path, spec))
 
         t, corpus = _timed(_load)
         return True, t, corpus
@@ -1296,6 +1303,180 @@ def bench_node_firehose(results, n_validators=None, n_epochs=2,
                 "attestations_ingested":
                     fc_engine.stats["attestations_ingested"],
                 "fc_prunes": fc_engine.stats["prunes"],
+            },
+        }
+    finally:
+        bls.bls_active = was_active
+        if not was_recording:
+            recorder.disable()
+
+
+def _adversarial_corpus_through_cache(spec, state, n_epochs, gossip_target):
+    """Adversarial corpus cache (ISSUE 13): the heavy parts (honest
+    chain + gossip + shed reserve + fork branch) persist framed like the
+    honest firehose corpus; the seeded schedules (orphans, slashings,
+    junk, duplicate/future picks) re-derive deterministically from the
+    same seed.  Returns (cache_hit, seconds, corpus)."""
+    from consensus_specs_tpu.node import adversary
+
+    key = (f"firehose_adv_v1_{len(state.validators)}_{n_epochs}e_"
+           f"{gossip_target}_{bytes(state.hash_tree_root()).hex()[:24]}")
+    paths = {part: os.path.join(_bench_cache_dir(), f"{key}.{part}.ssz")
+             for part in ("blocks", "atts", "shed", "fork")}
+
+    if all(os.path.exists(p) for p in paths.values()):
+        def _load():
+            chain = _read_framed(paths["blocks"], spec.SignedBeaconBlock)
+            fork = _read_framed(paths["fork"], spec.SignedBeaconBlock)
+            return adversary.build_adversarial_corpus(
+                spec, state, n_epochs=n_epochs, gossip_target=gossip_target,
+                prebuilt=(chain, _framed_atts_by_slot(paths["atts"], spec),
+                          _framed_atts_by_slot(paths["shed"], spec), fork))
+
+        t, corpus = _timed(_load)
+        return True, t, corpus
+    t, corpus = _timed(adversary.build_adversarial_corpus, spec, state,
+                       90013, n_epochs, gossip_target)
+    try:
+        _write_framed(paths["blocks"], corpus.chain)
+        _write_framed(paths["fork"], corpus.fork_blocks)
+        for part, table in (("atts", corpus.gossip),
+                            ("shed", corpus.shed_gossip)):
+            _write_framed(paths[part], [a for s in sorted(table)
+                                        for a in table[s]])
+    except OSError:
+        pass  # read-only tree: cold path every run
+    return False, t, corpus
+
+
+def bench_node_firehose_adversarial(results, n_validators=None, n_epochs=3,
+                                    gossip_target=100_000,
+                                    n_gossip_producers=2):
+    """Driver-parsed ``node_firehose_adversarial`` row (ISSUE 13): the
+    survival layer under concurrent hostile load — the honest chain
+    (with a finality-stall epoch) plus the long-range reorg branch
+    delivered child-first, the equivocation storm, junk/duplicate
+    floods, never-linking orphans, and future pre-deliveries, all
+    through the bounded queue against the single-writer loop.  Asserts
+    the full contract in-run: ZERO apply-loop halts (the drain
+    completing is the assert), byte-identical head/root vs the literal
+    spec replay of the journal, every admission ring bounded at its
+    cap, the stf fast path on every applied block (canonical AND fork),
+    the junk producer quarantined with its reserve gossip shed, and
+    journal-based crash recovery rebuilding the same head byte-exactly.
+    BLS off like the honest row."""
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.forkchoice import engine as fc_engine
+    from consensus_specs_tpu.node import admission, adversary, firehose
+    from consensus_specs_tpu.node import service as node_service
+    from consensus_specs_tpu.node.service import recover_node
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.stf import verify as stf_verify
+    from consensus_specs_tpu.telemetry import recorder
+
+    n = n_validators or N_VALIDATORS
+    spec = get_spec("phase0", "mainnet")
+    was_active = bls.bls_active
+    bls.bls_active = False
+    was_recording = recorder.enabled()
+    if not was_recording:
+        recorder.reset()
+        recorder.enable()
+    try:
+        t_build_state, state = _timed(build_state, spec, n)
+        firehose.prepare_anchor(spec, state)
+        corpus_cached, t_corpus, corpus = _adversarial_corpus_through_cache(
+            spec, state, n_epochs, gossip_target)
+        n_gossip = sum(len(v) for v in corpus.gossip.values())
+
+        node_service.reset_stats()
+        stf.reset_stats()
+        fc_engine.reset_stats()
+        run = adversary.run_adversarial_firehose(
+            spec, state, corpus, n_gossip_producers=n_gossip_producers)
+        node = run.pop("node")
+        adm = run["admission"]
+        svc = run["service"]
+
+        assert n_gossip >= gossip_target, n_gossip
+        # zero halts + the fast path on every applied block
+        assert stf.stats["replayed_blocks"] == 0, \
+            f"adversarial node replayed {stf.stats['replayed_blocks']} " \
+            f"blocks ({stf.stats['replay_reasons']})"
+        assert svc["blocks_applied"] == run["blocks"] + run["fork_blocks"]
+        assert stf.stats["fast_blocks"] == svc["blocks_applied"]
+        assert svc["quarantined_items"] == 0  # no poison without faults
+        # the survival counters all moved
+        assert adm["orphans_relinked"] == run["fork_blocks"] - 1
+        assert adm["orphans_expired"] >= 1
+        assert adm["parked_released"] == adm["parked"] >= 1
+        assert adm["malformed"] >= len(corpus.junk)
+        assert adm["stale_ticks"] >= 1  # the clock-rewind attack died here
+        assert adm["quarantines"] >= 1 and adm["shed_items"] >= 1
+        assert adm["duplicates"] >= len(corpus.duplicate_slots)
+        assert len(node.store.equivocating_indices) > 0
+        adversary.assert_bounded(adm)
+
+        t_parity, ref = _timed(
+            firehose.replay_journal_literal, spec, state,
+            corpus.anchor_block, node._journal)
+        roots = firehose.assert_parity(spec, node, ref)
+
+        # crash-recovery leg: rebuild from the journal, byte-identical
+        t_recover, recovered = _timed(
+            recover_node, spec, state, corpus.anchor_block, node.journal)
+        head = bytes(node.get_head())
+        assert bytes(recovered.get_head()) == head
+        assert bytes(
+            recovered.store.block_states[head].hash_tree_root()) == bytes(
+            node.store.block_states[head].hash_tree_root()), \
+            "recovered node diverged from the crashed node's state"
+
+        results["node_firehose_adversarial"] = {
+            "metric": (f"node_firehose_adversarial_{n_epochs}epochs_"
+                       f"{n_gossip}_gossip_atts_{n}_validators"),
+            "value": run["elapsed_s"],
+            "unit": "s",
+            "vs_baseline": round(t_parity / run["elapsed_s"], 1),
+            "blocks_per_s": run["blocks_per_s"],
+            "atts_per_s": run["atts_per_s"],
+            "blocks": run["blocks"],
+            "fork_blocks": run["fork_blocks"],
+            "slashings": run["slashings"],
+            "gossip_attestations": n_gossip,
+            "producer_threads": run["producer_threads"],
+            "processed_items": run["processed_items"],
+            "head_parity": True,
+            "recovered_head_parity": True,
+            **roots,
+            "literal_replay_s": round(t_parity, 3),
+            "recover_s": round(t_recover, 3),
+            "state_build_s": round(t_build_state, 3),
+            "corpus_build_s": round(t_corpus, 3),
+            "corpus_cached": corpus_cached,
+            "admission": {k: adm[k] for k in (
+                "admitted", "duplicates", "orphaned", "orphans_relinked",
+                "orphans_expired", "parked", "parked_released", "malformed",
+                "stale_blocks", "stale_ticks", "shed_items", "quarantines",
+                "dead_lettered", "orphan_pool_depth", "orphan_pool_cap",
+                "parked_depth", "parked_cap", "dead_letter_depth",
+                "dead_letter_cap", "seen_size", "seen_cap")},
+            # counter invariants (the trend gate reads this subtree):
+            # a halt-shaped regression — a replayed block, a quarantined
+            # item in a fault-free run, an open breaker — refuses the
+            # headline like a slowdown
+            "telemetry": {
+                "replayed_blocks": stf.stats["replayed_blocks"],
+                "fast_blocks": stf.stats["fast_blocks"],
+                "breaker_state": stf.stats["breaker_state"],
+                "breaker_trips": stf.stats["breaker_trips"],
+                "native_degraded": stf_verify.stats["native_degraded"],
+                "rejected_batches": svc["rejected_batches"],
+                "quarantined_items": svc["quarantined_items"],
+                "requeued_items": svc["requeued_items"],
+                "attestations_ingested":
+                    fc_engine.stats["attestations_ingested"],
             },
         }
     finally:
@@ -1650,6 +1831,12 @@ def check_counter_invariants(current, previous=None, plan_floor=0.25,
                 f"{tel['breaker_state']}")
     if tel.get("native_degraded"):
         return f"counter invariant: {metric} ran with native BLS degraded"
+    if tel.get("quarantined_items"):
+        # ISSUE 13: a fault-free bench run has no poison items — a
+        # dead-lettered item here means the apply path broke and the
+        # containment layer absorbed it (wall-time would never show it)
+        return (f"counter invariant: {metric} quarantined "
+                f"{tel['quarantined_items']} items in a fault-free run")
     for key, floor in (("plan_hit_ratio", plan_floor),
                        ("memo_hit_ratio", memo_floor)):
         ratio = tel.get(key)
@@ -1724,6 +1911,11 @@ def main():
                 bench_node_firehose(results)
             except Exception as exc:
                 results["node_firehose"] = {"error": repr(exc)[:300]}
+            try:
+                bench_node_firehose_adversarial(results)
+            except Exception as exc:
+                results["node_firehose_adversarial"] = {
+                    "error": repr(exc)[:300]}
     if os.environ.get("BENCH_SCALE_PROBE") == "1":
         try:
             bench_scale_probe(results)
@@ -1771,7 +1963,8 @@ def main():
     # (node_firehose: QUICK runs and BENCH_FIREHOSE=0 skip the row, but
     # its counter-invariant history must stay diffable run over run)
     for preserved in ("epoch_scale_1m", "epoch_e2e_scale_1m",
-                      "epoch_e2e_scale_2m", "node_firehose"):
+                      "epoch_e2e_scale_2m", "node_firehose",
+                      "node_firehose_adversarial"):
         if preserved not in results and prev_details.get(preserved):
             results[preserved] = prev_details[preserved]
     if prev_details:
@@ -1857,15 +2050,16 @@ def main():
             # same way, and their wall time rides the perf trend too
             for row_key in ("epoch_e2e_bls", "epoch_e2e_bls_altair",
                             "epoch_e2e_scale_1m", "epoch_e2e_scale_2m",
-                            "node_firehose"):
+                            "node_firehose", "node_firehose_adversarial"):
                 regressions.append(check_counter_invariants(
                     results.get(row_key), prev_details.get(row_key)))
             # node_firehose rides the same wall-time trend gate as the
             # scale rows (value is the serving wall; blocks/s + atts/s
             # ride in the row) — composition throughput can't silently
-            # erode run over run (ISSUE 12)
+            # erode run over run (ISSUE 12); the adversarial row joins
+            # it (ISSUE 13): survival must not get slower either
             for row_key in ("epoch_e2e_scale_1m", "epoch_e2e_scale_2m",
-                            "node_firehose"):
+                            "node_firehose", "node_firehose_adversarial"):
                 regressions.append(check_perf_trend(
                     results.get(row_key), prev_details.get(row_key),
                     previous_details=prev_details.get(row_key)))

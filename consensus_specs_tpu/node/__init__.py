@@ -10,9 +10,16 @@ Layers (see docs/architecture.md, "Node serving pipeline"):
   back-pressure, feeding the apply loop;
 * ``firehose`` — seeded concurrent load harness: N epochs of blocks +
   ≥100k-attestation gossip from concurrent producer threads, with
-  journal-replay head/root parity vs the literal spec.
+  journal-replay head/root parity vs the literal spec;
+* ``admission`` — the survival layer (ISSUE 13): content-root dedup,
+  bounded slot-expiring orphan pool with re-link, future-slot parking,
+  malformed rejection, per-producer scoring/quarantine, and the
+  dead-letter ring the apply loop's poison-pill containment feeds;
+* ``adversary`` — seeded deterministic adversarial corpora
+  (equivocation storms, long-range reorgs, finality stalls, junk and
+  duplicate floods) and the adversarial firehose driver.
 """
 from .ingest import IngestQueue
-from .service import Node, engine_backed_on_block
+from .service import Node, engine_backed_on_block, recover_node
 
-__all__ = ["IngestQueue", "Node", "engine_backed_on_block"]
+__all__ = ["IngestQueue", "Node", "engine_backed_on_block", "recover_node"]

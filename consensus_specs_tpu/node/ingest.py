@@ -58,6 +58,8 @@ stats = {
     "enqueued": 0,
     "dequeued": 0,
     "requeued": 0,        # items put back at the head by a failed apply
+    "requeue_overflow": 0,  # re-queues that found the queue already full
+    "requeue_attempts_max": 0,  # deepest per-item retry count observed
     "blocked_puts": 0,    # puts that found the queue full
     "blocked_s": 0.0,     # seconds producers spent in back-pressure waits
     "depth_max": 0,
@@ -86,13 +88,22 @@ def reset_stats() -> None:
 
 class WorkItem(NamedTuple):
     """One unit of ingest work: ``kind`` is ``"tick"`` / ``"block"`` /
-    ``"attestations"``, ``payload`` the handler input, ``link`` the
-    timeline causality id minted at enqueue (None with the timeline
-    off)."""
+    ``"attestations"`` / ``"attester_slashing"``, ``payload`` the
+    handler input, ``link`` the timeline causality id minted at enqueue
+    (None with the timeline off), ``producer`` the enqueuing thread's
+    name (the admission gate's peer-scoring identity — ISSUE 13), and
+    ``attempts`` the number of failed applies so far (incremented by
+    ``requeue_front``; the apply loop's retry cap consumes it), and
+    ``readmit`` marking an item that already passed the admission dedup
+    check once (a crash-path re-queue must skip it, or the item's own
+    seen-key would judge the retry a duplicate)."""
 
     kind: str
     payload: object
     link: Optional[int]
+    producer: str = ""
+    attempts: int = 0
+    readmit: bool = False
 
 
 class IngestQueue:
@@ -141,9 +152,9 @@ class IngestQueue:
                             stats["blocked_s"] += time.perf_counter() - t0
                 if self._closed:
                     raise RuntimeError("put into a closed ingest queue")
-                self._items.append(WorkItem(kind, payload, link))
-                depth = len(self._items)
                 name = threading.current_thread().name
+                self._items.append(WorkItem(kind, payload, link, name))
+                depth = len(self._items)
                 with _STATS_LOCK:
                     stats["enqueued"] += 1
                     if depth > stats["depth_max"]:
@@ -185,18 +196,33 @@ class IngestQueue:
             self._not_full.notify()
             return item
 
-    def requeue_front(self, item: WorkItem) -> None:
+    def requeue_front(self, item: WorkItem,
+                      count_attempt: bool = True) -> WorkItem:
         """Put a failed item back at the HEAD of the queue (apply-loop
         failure contract: the item that broke stays next in line, so a
         retried loop resumes exactly where it stopped — nothing is lost,
         nothing is reordered).  Owner API: only the apply loop calls it,
-        and only for an item it just dequeued — so the momentary cap
-        overshoot is bounded at one."""
+        for an item it just dequeued plus that item's pending cascade
+        followups on a crash — so the momentary cap overshoot is bounded
+        by one in-flight item and its followups, and ``requeue_overflow``
+        makes every overshoot visible instead of silent (ISSUE 13
+        satellite).  With ``count_attempt`` (the failure path) the item
+        comes back with ``attempts`` incremented — the count the apply
+        loop's retry cap consumes; crash-path re-queues pass False, a
+        kill is not a poison signal.  Returns the copy that landed."""
+        retried = item._replace(
+            attempts=item.attempts + (1 if count_attempt else 0))
         with self._lock:
-            self._items.appendleft(item)
+            if len(self._items) >= self._cap:
+                with _STATS_LOCK:
+                    stats["requeue_overflow"] += 1
+            self._items.appendleft(retried)
             with _STATS_LOCK:
                 stats["requeued"] += 1
+                if retried.attempts > stats["requeue_attempts_max"]:
+                    stats["requeue_attempts_max"] = retried.attempts
             self._not_empty.notify()
+        return retried
 
     # -- introspection -------------------------------------------------------
 

@@ -25,20 +25,30 @@ pipeline:
   block fetchers, the clock) enqueue into ``node/ingest.py``'s bounded
   FIFO and ``run_apply_loop`` drains it on ONE thread.  A non-blocking
   writer lock enforces the contract (a second concurrent writer raises
-  instead of corrupting the store).  A failed item is put back at the
-  HEAD of the queue before the exception propagates — a retried loop
-  resumes exactly where it stopped, and the ``node.apply`` fault probe
+  instead of corrupting the store).  The ``node.apply`` fault probe
   fires before any store/proto mutation so an injected failure leaves
-  both untouched (tests/chaos/test_node_chaos.py).  Invalid gossip is
-  production-shaped load, not a crash: an attestation batch the spec
-  rejects (``AssertionError``) is counted and dropped, the loop keeps
-  serving.
+  both untouched (tests/chaos/test_node_chaos.py).
 
-* **parity journal** — every applied item lands in ``node.journal`` in
-  apply order, so a concurrent run's end state is exactly replayable
-  through the literal spec handlers (the firehose's head/root parity
-  leg replays the journal, making byte-identical-state assertions
-  meaningful under nondeterministic producer interleaving).
+* **the survival layer (ISSUE 13)** — every loop item passes the
+  admission gate (``node/admission.py``: content-root dedup, orphan
+  pool, future parking, malformed rejection, peer quarantine) before a
+  spec handler sees it, and the loop CONTAINS failure instead of
+  halting: a spec rejection (``AssertionError``) is counted, charged to
+  the producer, and dropped; any other failure re-queues at the head
+  with exponential backoff up to ``max_item_retries`` total attempts
+  (the ingest queue's per-item ``attempts`` count), then quarantines to
+  the bounded dead-letter ring (``node_quarantine`` flight-recorder
+  event) while serving continues.  Only a real kill
+  (``BaseException``) propagates — with the item back at the head, so
+  the journal stays a true history for recovery.
+
+* **parity journal + crash recovery** — every applied item lands in
+  ``node.journal`` in apply order, so a concurrent run's end state is
+  exactly replayable through the literal spec handlers (the firehose's
+  head/root parity leg replays the journal, making
+  byte-identical-state assertions meaningful under nondeterministic
+  producer interleaving) — and ``recover_node`` rebuilds a crashed
+  node byte-identically from the same journal.
 
 Observability: ``node_block``/``node_gossip`` flight-recorder events
 (recorded only after the engine call settled — OB01's commit
@@ -49,8 +59,10 @@ applied/rejected counters, producer stats).
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
+import time
 from typing import Optional, Sequence
 
 from consensus_specs_tpu import faults, telemetry
@@ -58,31 +70,50 @@ from consensus_specs_tpu.forkchoice import ForkChoiceEngine
 from consensus_specs_tpu.stf import apply_signed_blocks
 from consensus_specs_tpu.telemetry import recorder, timeline
 
-from . import ingest
+from . import admission, ingest
 
 # probed at the top of every apply (direct handler or loop item), BEFORE
 # the engine dispatch: an injected failure leaves store + proto-array
 # exactly as they were and the dequeued item back at the queue head
 _SITE_APPLY = faults.site("node.apply")
+# probed BEFORE a journal replay begins: an injected recovery failure
+# leaves the half-built node discarded and nothing global touched — a
+# retried recovery starts clean (tests/chaos/test_node_chaos.py)
+_SITE_RECOVER = faults.site("node.recover")
+
+# total apply ATTEMPTS a poison item gets before the loop quarantines it
+# to the dead-letter ring (the containment contract: the node keeps
+# serving; the item keeps its evidence)
+DEFAULT_MAX_ITEM_RETRIES = 3
+DEFAULT_RETRY_BACKOFF_S = 0.01
 
 stats = {
     "blocks_applied": 0,
     "ticks_applied": 0,
     "attestation_batches_applied": 0,
     "attestations_applied": 0,
+    "slashings_applied": 0,
     "rejected_batches": 0,
     "rejected_attestations": 0,
+    "rejected_blocks": 0,
+    "rejected_slashings": 0,
+    "rejected_ticks": 0,
+    "retried_items": 0,
+    "quarantined_items": 0,
     "requeued_items": 0,
+    "recoveries": 0,
     "apply_loop_runs": 0,
 }
 
 
 def reset_stats() -> None:
-    """Zero the node counters AND the ingest queue's (they attribute one
-    pipeline; a firehose run must not inherit a previous run's counts)."""
+    """Zero the node counters AND the ingest queue's and admission
+    gate's (they attribute one pipeline; a firehose run must not inherit
+    a previous run's counts)."""
     for k in stats:
         stats[k] = 0
     ingest.reset_stats()
+    admission.reset_stats()
 
 
 def _telemetry_provider() -> dict:
@@ -166,7 +197,11 @@ class Node:
     behind one single-writer handler surface and one ingest queue."""
 
     def __init__(self, spec, anchor_state, anchor_block=None,
-                 queue_cap: int = ingest.DEFAULT_CAP, journal: bool = True):
+                 queue_cap: int = ingest.DEFAULT_CAP, journal: bool = True,
+                 admission_gate: bool = True,
+                 max_item_retries: int = DEFAULT_MAX_ITEM_RETRIES,
+                 retry_backoff_s: float = DEFAULT_RETRY_BACKOFF_S,
+                 adopt_admission: bool = True):
         self.spec = spec
         if anchor_block is None:
             anchor_block = default_anchor_block(spec, anchor_state)
@@ -180,6 +215,20 @@ class Node:
         self._writer_lock = threading.Lock()
         self._clock_cond = threading.Condition()
         self._clock_slot = int(spec.get_current_slot(store))
+        # the survival layer (ISSUE 13): the admission gate judges every
+        # LOOP item before a spec handler sees it; direct handler calls
+        # (the differential mirrors') bypass it by design — they want
+        # the spec's exact accept/reject verdicts.  A fresh node adopts
+        # the process-wide admission surface (pools reset);
+        # ``recover_node`` opts out — recovery must PRESERVE the crashed
+        # surface's dead letters, peer scores, and quarantine set (the
+        # post-mortem evidence and the shed protection both outlive the
+        # crash)
+        self._admission = admission_gate
+        self._max_item_retries = max(1, int(max_item_retries))
+        self._retry_backoff_s = float(retry_backoff_s)
+        if adopt_admission:
+            admission.reset_state()
 
     def _on_block_stf(self, store, signed_block) -> None:
         """The ``block_handler`` installed on the fork-choice engine:
@@ -259,6 +308,7 @@ class Node:
         with self._single_writer():
             _SITE_APPLY()
             self.engine.on_attester_slashing(attester_slashing)
+            stats["slashings_applied"] += 1
             self._journal_append("attester_slashing", attester_slashing)
 
     def get_head(self):
@@ -285,45 +335,207 @@ class Node:
                              timeout: Optional[float] = None) -> None:
         self.queue.put("attestations", tuple(attestations), timeout=timeout)
 
+    def enqueue_attester_slashing(self, attester_slashing,
+                                  timeout: Optional[float] = None) -> None:
+        self.queue.put("attester_slashing", attester_slashing,
+                       timeout=timeout)
+
     # -- the apply loop ------------------------------------------------------
 
     def apply_item(self, item: ingest.WorkItem) -> None:
-        """Apply one dequeued work item.  A rejected gossip batch (spec
-        validation ``AssertionError``) is counted and dropped; ANY other
-        failure re-queues the item at the head and propagates — the
-        store and proto-array are untouched past the probe, so a retry
-        picks up exactly where the loop stopped."""
-        try:
-            with timeline.span("node/apply", link=item.link, kind=item.kind):
-                if item.kind == "tick":
-                    self.on_tick(item.payload)
-                elif item.kind == "block":
-                    self.on_block(item.payload)
-                elif item.kind == "attestations":
-                    try:
-                        self.on_attestations(item.payload)
-                    except AssertionError:
-                        stats["rejected_batches"] += 1
-                        stats["rejected_attestations"] += len(item.payload)
-                        if recorder.enabled():
-                            recorder.record("node_gossip_rejected",
-                                            n=len(item.payload))
-                else:
-                    raise ValueError(f"unknown work item kind {item.kind!r}")
-        except BaseException:
+        """Dispatch one work item to its handler, raising on any failure
+        (a spec rejection raises the spec's ``AssertionError``).  The
+        verdict/containment policy — admission, rejection counting,
+        retries, quarantine — is ``_process_item``'s job; this is the
+        raw apply."""
+        with timeline.span("node/apply", link=item.link, kind=item.kind):
+            if item.kind == "tick":
+                self.on_tick(item.payload)
+            elif item.kind == "block":
+                self.on_block(item.payload)
+            elif item.kind == "attestations":
+                self.on_attestations(item.payload)
+            elif item.kind == "attester_slashing":
+                self.on_attester_slashing(item.payload)
+            else:
+                raise ValueError(f"unknown work item kind {item.kind!r}")
+
+    # -- containment (ISSUE 13): the loop never halts on a poison item --------
+
+    def _count_rejected(self, item: ingest.WorkItem) -> None:
+        """A spec-invalid item (``AssertionError`` out of the handler):
+        production-shaped load, counted + charged + dropped."""
+        if item.kind == "attestations":
+            stats["rejected_batches"] += 1
+            stats["rejected_attestations"] += len(item.payload)
+            if recorder.enabled():
+                recorder.record("node_gossip_rejected", n=len(item.payload))
+        elif item.kind == "block":
+            stats["rejected_blocks"] += 1
+            if recorder.enabled():
+                recorder.record("node_block_rejected",
+                                slot=int(item.payload.message.slot))
+        elif item.kind == "attester_slashing":
+            stats["rejected_slashings"] += 1
+        else:
+            stats["rejected_ticks"] += 1
+        admission.charge(item.producer, admission.CHARGE_REJECTED)
+        # a rejection is a verdict on CURRENT store state (an unknown
+        # root can arrive later): forget the dedup key so an honest
+        # re-delivery is re-judged instead of dying as a duplicate
+        admission.forget(item)
+
+    def _contain_failure(self, item: ingest.WorkItem,
+                         exc: Exception) -> None:
+        """Bounded per-item retries with backoff, then quarantine to the
+        dead-letter ring — the poison-pill contract: the loop keeps
+        serving.  A failing quarantine (its own fault site) propagates
+        un-handled: the CALLER re-queues the item ahead of any pending
+        followups (exact order restored) — containment of last resort
+        must fail loudly, never half-record."""
+        if item.attempts + 1 >= self._max_item_retries:
+            admission.dead_letter(item, exc)
+            stats["quarantined_items"] += 1
+        else:
+            stats["retried_items"] += 1
+            if self._retry_backoff_s > 0:
+                time.sleep(self._retry_backoff_s * (2 ** item.attempts))
             self.queue.requeue_front(item)
             stats["requeued_items"] += 1
-            raise
 
-    def run_apply_loop(self, timeout: Optional[float] = None) -> int:
+    def _process_item(self, item: ingest.WorkItem,
+                      readmit: bool = False) -> None:
+        """One dequeued item through the survival layer: admission
+        verdict, apply, containment, and the follow-ups a success
+        unlocks (orphan re-links after a block, parked releases after a
+        tick) — processed iteratively so a long re-link chain cannot
+        recurse."""
+        work = collections.deque([(item, readmit)])
+        while work:
+            it, re = work.popleft()
+            clock_before = self._clock_slot
+            try:
+                # admission runs INSIDE containment: a fault at the gate
+                # is an infrastructure failure, not a verdict — the item
+                # re-queues and the retry re-judges it (nothing is lost).
+                # A retried item (attempts > 0) already passed the dedup
+                # check once and sits in the seen-set: it re-enters as a
+                # re-admission, not a duplicate.
+                if self._admission:
+                    verdict, it = admission.admit(
+                        self.spec, self.store, it, self._clock_slot,
+                        readmit=re or it.attempts > 0 or it.readmit)
+                    if verdict != admission.VERDICT_ADMIT:
+                        continue
+                self.apply_item(it)
+            except AssertionError:
+                self._count_rejected(it)
+            except Exception as exc:
+                try:
+                    self._contain_failure(it, exc)
+                except BaseException:
+                    # containment itself failed (e.g. a quarantine
+                    # fault): restore the queue in EXACT order — the
+                    # in-flight item first, its pending followups right
+                    # behind — and propagate loudly
+                    for rest, _re in reversed(work):
+                        self.queue.requeue_front(
+                            rest._replace(readmit=True),
+                            count_attempt=False)
+                    work.clear()
+                    self.queue.requeue_front(it)
+                    stats["requeued_items"] += 1
+                    raise
+            except BaseException:
+                # a real kill (KeyboardInterrupt, SystemExit): crash
+                # semantics — the item back at the head, the journal a
+                # true history, recovery's replay picks up from here.
+                # Pending followups were already POPPED from the
+                # admission pools: re-queue them behind the in-flight
+                # item (in order) or they would vanish unaccounted.
+                # Neither they nor the interrupted item FAILED — the
+                # kill is not a poison signal, so no attempt is charged
+                for rest, _re in reversed(work):
+                    self.queue.requeue_front(rest._replace(readmit=True),
+                                             count_attempt=False)
+                work.clear()
+                self.queue.requeue_front(it._replace(readmit=True),
+                                         count_attempt=False)
+                stats["requeued_items"] += 1
+                raise
+            else:
+                if not self._admission:
+                    continue
+                if it.kind == "block":
+                    root = bytes(it.payload.message.hash_tree_root())
+                    work.extend((child, True)
+                                for child in admission.pop_children(root))
+                elif it.kind == "tick":
+                    released = admission.on_clock(
+                        self._clock_slot,
+                        self._clock_slot - clock_before)
+                    work.extend((r, True) for r in released)
+
+    def run_apply_loop(self, timeout: Optional[float] = None,
+                       max_items: Optional[int] = None) -> int:
         """Drain the queue until it is closed and empty (or ``timeout``
-        elapses waiting for work).  Returns the number of items applied.
-        This is THE single writer: run it on one thread."""
+        elapses waiting for work).  Returns the number of items
+        processed.  This is THE single writer: run it on one thread.
+        A poison item never halts the loop — it is retried up to the
+        node's cap with backoff, then quarantined to the dead-letter
+        ring (``node_quarantine`` flight-recorder event) while serving
+        continues.  ``max_items`` stops the loop after that many items —
+        the crash-drill hook the recovery tests kill the loop with."""
         stats["apply_loop_runs"] += 1
-        applied = 0
-        while True:
+        processed = 0
+        while max_items is None or processed < max_items:
             item = self.queue.get(timeout=timeout)
             if item is None:
-                return applied
-            self.apply_item(item)
-            applied += 1
+                return processed
+            self._process_item(item)
+            processed += 1
+        return processed
+
+
+def recover_node(spec, anchor_state, anchor_block=None, journal=(),
+                 **node_kwargs) -> Node:
+    """Journal-based crash recovery (ISSUE 13): rebuild a fresh ``Node``
+    from the same anchor and replay a crashed node's apply-order journal
+    through the engine-backed handlers — the recovered store is
+    byte-identical to the crashed one's (the journal is a true history:
+    item-granular atomicity means nothing half-applied, and every
+    handler is deterministic given apply order).  Orphan/parked pools
+    are NOT part of the contract — pooled items were never applied, so
+    they are simply gossip the mesh will re-deliver.  The dead-letter
+    ring, peer scores, and quarantine set DO survive: recovery must not
+    destroy the post-mortem evidence or release a quarantined flooder.
+
+    The ``node.recover`` probe fires after construction and before the
+    replay: an injected recovery failure discards the half-built node
+    and touches nothing global — a retried recovery starts clean.
+    Emits ``node_recovered`` once the replay fully settles."""
+    node_kwargs.setdefault("adopt_admission", False)
+    node = Node(spec, anchor_state, anchor_block, **node_kwargs)
+    if node_kwargs.get("adopt_admission") is False:
+        # clear the TRANSIENT surface only: seen-keys for items that
+        # never applied (the in-flight item at the kill, pooled
+        # orphans) must not judge the mesh's re-delivery a duplicate —
+        # but dead letters, scores, and quarantine survive
+        admission.reset_transient()
+    _SITE_RECOVER()
+    with timeline.span("node/recover", items=len(journal)):
+        for kind, payload in journal:
+            if kind == "tick":
+                node.on_tick(payload)
+            elif kind == "block":
+                node.on_block(payload)
+            elif kind == "attestations":
+                node.on_attestations(payload)
+            elif kind == "attester_slashing":
+                node.on_attester_slashing(payload)
+            else:
+                raise ValueError(f"unknown journal kind {kind!r}")
+    stats["recoveries"] += 1
+    if recorder.enabled():
+        recorder.record("node_recovered", items=len(journal))
+    return node

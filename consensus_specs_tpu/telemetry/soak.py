@@ -169,6 +169,8 @@ def _pipeline_inflight_cap() -> int:
 def bounded_cache_sizes() -> List[dict]:
     """(name, size, cap) of every bounded structure the telemetry bus
     reports — the memory-flatness sample."""
+    import consensus_specs_tpu.node.admission  # noqa: F401  (registers provider)
+
     from . import snapshot
 
     providers = snapshot()["providers"]
@@ -206,6 +208,21 @@ def bounded_cache_sizes() -> List[dict]:
          "size": providers.get("timeline", {}).get("events", 0),
          "cap": providers.get("timeline", {}).get("cap", 0)},
     ]
+    # the node admission survival structures (ISSUE 13): orphan pool,
+    # parked ring, dead-letter ring, seen-set, and the peer-score table
+    # all carry caps on the bus — a soak (or the adversarial firehose)
+    # proves they stay bounded over every epoch
+    adm = providers.get("node.admission", {})
+    for name, size_key, cap_key in (
+            ("node.admission.orphans", "orphan_pool_depth",
+             "orphan_pool_cap"),
+            ("node.admission.parked", "parked_depth", "parked_cap"),
+            ("node.admission.dead_letters", "dead_letter_depth",
+             "dead_letter_cap"),
+            ("node.admission.seen", "seen_size", "seen_cap"),
+            ("node.admission.scores", "scores_size", "scores_cap")):
+        samples.append({"name": name, "size": adm.get(size_key, 0),
+                        "cap": adm.get(cap_key, 0)})
     for key in ("ctx_size", "ctx_lookup_size", "plan_ctx_lookup_size",
                 "active_size", "proposer_size"):
         samples.append({"name": f"stf.plan_cache.{key[:-5]}",

@@ -143,3 +143,47 @@ def test_cc01_node_owner_module_is_exempt():
     src = ("def requeue_front(self, item):\n"
            "    self._items.appendleft(item)\n")
     assert cc01("consensus_specs_tpu/node/ingest.py", src) == []
+
+
+# -- ISSUE 13: node admission survival structures -----------------------------
+
+
+_ORPHAN_POOL_INSERT = """\
+from consensus_specs_tpu.node import admission
+
+def inject(parent, item):
+    admission._ORPHANS.setdefault(parent, []).append((0, item))
+"""
+
+_DEAD_LETTER_APPEND = """\
+from consensus_specs_tpu.node import admission
+
+def forge(record):
+    admission._DEAD_LETTERS.append(record)
+"""
+
+_OWNER_SIDE_POOL = """\
+_ORPHANS = {}
+
+def _pool(parent, item):
+    _ORPHANS.setdefault(parent, []).append((0, item))
+"""
+
+
+def test_cc01_flags_outside_orphan_pool_insert():
+    found = cc01("consensus_specs_tpu/stf/x.py", _ORPHAN_POOL_INSERT)
+    assert [f.line for f in found] == [4]
+    assert "node orphan pool" in found[0].message
+
+
+def test_cc01_flags_forged_dead_letter():
+    # a producer writing its own dead letter would fake the post-mortem's
+    # "every entry came from an exhausted retry" claim
+    found = cc01("consensus_specs_tpu/forkchoice/x.py", _DEAD_LETTER_APPEND)
+    assert [f.line for f in found] == [4]
+    assert "node dead-letter ring" in found[0].message
+
+
+def test_cc01_owner_module_pool_writes_are_legal():
+    assert cc01("consensus_specs_tpu/node/admission.py",
+                _OWNER_SIDE_POOL) == []

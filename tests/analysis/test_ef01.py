@@ -151,3 +151,45 @@ def test_ef01_speculated_commit_through_defer_is_sanctioned():
            "    if handle.result() is None:\n"
            "        staging.defer(_commit, keys)\n")
     assert ef01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+# -- ISSUE 13: admission-pool inserts next to the node fault probes -----------
+
+
+_NODE_HEADER = ("from consensus_specs_tpu import faults\n"
+                "from consensus_specs_tpu.stf import staging\n"
+                "_SITE = faults.site('node.x.probe')\n"
+                "_ORPHANS = {}\n")
+
+
+def test_ef01_flags_unrouted_orphan_pool_insert_next_to_probe():
+    src = _NODE_HEADER + ("def pool(parent, item):\n"
+                          "    _SITE()\n"
+                          "    _ORPHANS[parent] = [item]\n")
+    found = ef01("consensus_specs_tpu/node/x.py", src)
+    assert [f.line for f in found] == [7]
+    assert "strand" in found[0].message
+
+
+def test_ef01_orphan_insert_with_handler_invalidation_is_clean():
+    # the live admission.py shape: the insert carries its own undo
+    src = _NODE_HEADER + ("def pool(parent, item):\n"
+                          "    _SITE()\n"
+                          "    try:\n"
+                          "        _ORPHANS[parent] = [item]\n"
+                          "    except BaseException:\n"
+                          "        _ORPHANS.pop(parent, None)\n"
+                          "        raise\n")
+    assert ef01("consensus_specs_tpu/node/x.py", src) == []
+
+
+def test_ef01_admission_side_tables_are_observational():
+    # a stranded seen-key/parking entry is self-healing (re-admission
+    # skips dedup; parking decays on the clock): EF01 skips them
+    src = ("from consensus_specs_tpu import faults\n"
+           "_SITE = faults.site('node.x.probe')\n"
+           "_SEEN = {}\n"
+           "def mark(key):\n"
+           "    _SITE()\n"
+           "    _SEEN[key] = True\n")
+    assert ef01("consensus_specs_tpu/node/x.py", src) == []

@@ -169,3 +169,36 @@ def test_ob01_node_gossip_after_the_with_block_is_clean():
                      "        _SITE()\n"
                      "    telemetry.record('node_gossip', n=len(batch))\n")
     assert ob01("consensus_specs_tpu/node/x.py", src) == []
+
+
+# -- ISSUE 13: containment commit-kinds ---------------------------------------
+
+
+def test_ob01_node_quarantine_inside_open_transaction_is_flagged():
+    # node_quarantine asserts the poison item LANDED in the dead-letter
+    # ring; recorded before settlement, a fault would put a containment
+    # action in the post-mortem that never happened
+    src = _HEADER + ("def contain(item):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "        telemetry.record('node_quarantine', kind='x')\n")
+    found = ob01("consensus_specs_tpu/node/x.py", src)
+    assert [f.line for f in found] == [8]
+    assert "never happened" in found[0].message
+
+
+def test_ob01_node_recovered_inside_open_transaction_is_flagged():
+    src = _HEADER + ("def recover(journal):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "        telemetry.record('node_recovered', items=1)\n")
+    found = ob01("consensus_specs_tpu/node/x.py", src)
+    assert [f.line for f in found] == [8]
+
+
+def test_ob01_node_recovered_after_the_with_block_is_clean():
+    src = _HEADER + ("def recover(journal):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "    telemetry.record('node_recovered', items=1)\n")
+    assert ob01("consensus_specs_tpu/node/x.py", src) == []

@@ -1,15 +1,18 @@
-"""Node-pipeline chaos: injected failures at the ingest/apply seams must
-leave the store, the proto-array, and the queue mutually consistent —
-the failed item back at the queue head, no partial store mutation, head
-parity with a literal-spec replay of the journal across the fault, and
-a clean retry.
+"""Node-pipeline chaos: injected failures at the ingest/admission/apply/
+quarantine/recovery seams must leave the store, the proto-array, the
+queue, and the admission pools mutually consistent — and the apply loop
+must CONTAIN them (ISSUE 13): a transient fault retries transparently, a
+poison item quarantines to the dead-letter ring while serving continues,
+and a crashed loop's journal rebuilds a byte-identical node.  Every case
+ends on head/root parity between the node and a literal-spec replay of
+its journal, plus a clean re-run where the contract promises one.
 
 ``COVERED_SITES`` is closed over by test_registry_complete.py.
 """
 import pytest
 
 from consensus_specs_tpu import faults
-from consensus_specs_tpu.node import Node, firehose
+from consensus_specs_tpu.node import Node, admission, firehose, recover_node
 from consensus_specs_tpu.testing.context import (
     default_activation_threshold,
     default_balances,
@@ -18,7 +21,8 @@ from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
 
 F = faults.Fault
 
-COVERED_SITES = {"node.apply", "node.enqueue"}
+COVERED_SITES = {"node.apply", "node.enqueue", "node.admission",
+                 "node.quarantine", "node.recover"}
 
 
 @pytest.fixture(autouse=True)
@@ -69,35 +73,177 @@ def _enqueue_prefix(spec, node, corpus, n_blocks):
     node.queue.close()
 
 
-def test_apply_fault_leaves_node_untouched_and_item_requeued():
-    """A fault at the apply seam fires before any store/proto mutation:
-    the failed item sits back at the queue head, nothing half-landed,
-    and a retried loop drains to the exact state a fault-free literal
-    replay of the journal produces."""
-    spec, state, corpus = _scaffold()
-    node = Node(spec, state)
-    _enqueue_prefix(spec, node, corpus, 3)
-    depth_before = node.queue.depth()
-
-    # hit 4 = the second block's apply (tick, block, tick, block)
-    with faults.inject(faults.FaultPlan([F("node.apply", nth=4)])):
-        with pytest.raises(faults.InjectedFault):
-            node.run_apply_loop()
-    # first block landed, second did not — and is back at the head
-    assert len(node.store.blocks) == 2  # anchor + block 1
-    assert len(node.engine.proto) == 2
-    head_item = node.queue.get(timeout=0)
-    assert head_item.kind == "block"
-    assert int(head_item.payload.message.slot) == \
-        int(corpus.chain[1].message.slot)
-    node.queue.requeue_front(head_item)
-    assert node.queue.depth() == depth_before - 3
-
-    # retry drains the remainder; end state parity vs the literal spec
-    node.run_apply_loop()
+def _assert_journal_parity(spec, state, corpus, node):
     ref = firehose.replay_journal_literal(
         spec, state, corpus.anchor_block, node._journal)
     firehose.assert_parity(spec, node, ref)
+
+
+def test_apply_fault_retries_transparently_and_holds_parity():
+    """A transient fault at the apply seam (fires once) no longer halts
+    the loop: the item re-queues at the head, the retry applies it, the
+    drain completes, and the journal replays to byte-identical
+    head/root.  Nothing was quarantined — one failure is not poison."""
+    from consensus_specs_tpu.node import service
+
+    spec, state, corpus = _scaffold()
+    service.reset_stats()
+    node = Node(spec, state, retry_backoff_s=0.0)
+    _enqueue_prefix(spec, node, corpus, 3)
+
+    # hit 4 = the second block's apply (tick, block, tick, block)
+    with faults.inject(faults.FaultPlan([F("node.apply", nth=4)])):
+        node.run_apply_loop()
+    assert service.stats["retried_items"] == 1
+    assert service.stats["requeued_items"] == 1
+    assert service.stats["quarantined_items"] == 0
+    assert service.stats["blocks_applied"] == 3
+    assert admission.dead_letters() == []
+    _assert_journal_parity(spec, state, corpus, node)
+
+
+def test_poison_item_quarantined_loop_keeps_serving():
+    """The poison-pill contract: an item that fails EVERY retry moves to
+    the bounded dead-letter ring (flight-recorder ``node_quarantine``
+    event) and the loop keeps draining.  The poisoned block's children
+    orphan (their parent never applied) instead of raising, and the
+    journal — which holds only what truly applied — still replays to
+    parity."""
+    from consensus_specs_tpu.node import service
+    from consensus_specs_tpu.telemetry import recorder
+
+    spec, state, corpus = _scaffold()
+    service.reset_stats()
+    was_recording = recorder.enabled()
+    recorder.reset()
+    recorder.enable()
+    try:
+        node = Node(spec, state, retry_backoff_s=0.0)
+        _enqueue_prefix(spec, node, corpus, 4)
+        # hits 4,5,6 = the second block's three attempts (retries re-probe)
+        plan = faults.FaultPlan([F("node.apply", nth=n) for n in (4, 5, 6)])
+        with faults.inject(plan):
+            node.run_apply_loop()
+        assert [s for s, _n, _k in plan.fired] == ["node.apply"] * 3
+        assert service.stats["quarantined_items"] == 1
+        assert service.stats["retried_items"] == 2
+        letters = admission.dead_letters()
+        assert len(letters) == 1
+        assert letters[0]["item_kind"] == "block"
+        assert letters[0]["attempts"] == 3
+        # the poisoned block's children pooled as orphans, loop completed
+        assert admission.stats["orphaned"] >= 1
+        assert service.stats["blocks_applied"] == 1
+        events = [e for e in recorder.timeline()
+                  if e["kind"] == "node_quarantine"]
+        assert len(events) == 1 and events[0]["kind"] == "node_quarantine"
+        _assert_journal_parity(spec, state, corpus, node)
+    finally:
+        if not was_recording:
+            recorder.disable()
+        recorder.reset()
+
+
+def test_admission_fault_leaves_pools_untouched_and_retries():
+    """A fault at the admission gate fires before any pool/seen-set
+    mutation: the item re-queues un-judged, the retry re-admits it, and
+    the drain ends in parity — admission failure is infrastructure
+    trouble, never item loss."""
+    from consensus_specs_tpu.node import service
+
+    spec, state, corpus = _scaffold()
+    service.reset_stats()
+    node = Node(spec, state, retry_backoff_s=0.0)
+    _enqueue_prefix(spec, node, corpus, 3)
+    plan = faults.FaultPlan([F("node.admission", nth=4)])
+    with faults.inject(plan):
+        node.run_apply_loop()
+    assert plan.fired, "the admission probe never fired"
+    snap = admission.snapshot()
+    assert snap["orphan_pool_depth"] == 0
+    assert snap["dead_letter_depth"] == 0
+    assert service.stats["retried_items"] == 1
+    assert service.stats["blocks_applied"] == 3
+    _assert_journal_parity(spec, state, corpus, node)
+
+
+def test_quarantine_fault_requeues_item_and_propagates():
+    """Containment of last resort must fail loudly, never half-record:
+    with the apply seam stuck AND the quarantine probe firing, the loop
+    re-queues the poison item, leaves the dead-letter ring untouched,
+    and propagates.  Disarming the plan and re-running the loop drains
+    to parity — the failed quarantine lost nothing."""
+    from consensus_specs_tpu.node import service
+
+    spec, state, corpus = _scaffold()
+    service.reset_stats()
+    node = Node(spec, state, retry_backoff_s=0.0)
+    _enqueue_prefix(spec, node, corpus, 3)
+    plan = faults.FaultPlan([F("node.apply", nth=4, sticky=True),
+                             F("node.quarantine", nth=1)])
+    with faults.inject(plan):
+        with pytest.raises(faults.InjectedFault):
+            node.run_apply_loop()
+    assert admission.dead_letters() == []
+    assert service.stats["quarantined_items"] == 0
+    head = node.queue.get(timeout=0)
+    assert head.kind == "block" and head.attempts >= 2
+    node.queue.requeue_front(head)
+    # plan disarmed: the retry drains the remainder to parity
+    node.run_apply_loop()
+    assert service.stats["blocks_applied"] == 3
+    _assert_journal_parity(spec, state, corpus, node)
+
+
+def test_recover_fault_discards_fresh_node_and_retry_is_clean():
+    """A fault at the recovery seam fires after construction, before the
+    replay: the half-built node is discarded, nothing global is
+    touched, and a retried recovery rebuilds the crashed node's exact
+    head/root from the same journal."""
+    from consensus_specs_tpu.node import service
+
+    spec, state, corpus = _scaffold()
+    service.reset_stats()
+    node = Node(spec, state, retry_backoff_s=0.0)
+    _enqueue_prefix(spec, node, corpus, 4)
+    # crash mid-epoch: five items applied, then the loop is killed
+    node.run_apply_loop(max_items=5)
+    journal = node.journal
+    assert len(journal) == 5
+    crashed_head = bytes(node.get_head())
+
+    with faults.inject(faults.FaultPlan([F("node.recover")])):
+        with pytest.raises(faults.InjectedFault):
+            recover_node(spec, state, corpus.anchor_block, journal)
+    assert service.stats["recoveries"] == 0
+
+    recovered = recover_node(spec, state, corpus.anchor_block, journal)
+    assert service.stats["recoveries"] == 1
+    assert bytes(recovered.get_head()) == crashed_head
+    assert bytes(recovered.store.block_states[crashed_head].hash_tree_root()) \
+        == bytes(node.store.block_states[crashed_head].hash_tree_root())
+    assert recovered.store.justified_checkpoint == \
+        node.store.justified_checkpoint
+
+
+def test_apply_fault_mid_firehose_is_contained_with_parity():
+    """A transient fault mid-CONCURRENT-firehose no longer aborts the
+    run: the retry absorbs it, the run completes end-to-end, the stf
+    fast path carried every block, and the journal replays to parity."""
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.node import service
+
+    spec, state, corpus = _scaffold()
+    stf.reset_stats()
+    service.reset_stats()
+    with faults.inject(faults.FaultPlan([F("node.apply", nth=9)])):
+        result = firehose.run_firehose(
+            spec, state, corpus, n_gossip_producers=3, queue_cap=8,
+            gossip_batch=32, producer_timeout=30.0)
+    node = result["node"]
+    assert service.stats["retried_items"] == 1
+    assert stf.stats["replayed_blocks"] == 0
+    _assert_journal_parity(spec, state, corpus, node)
 
 
 def test_enqueue_fault_leaves_queue_untouched():
@@ -113,50 +259,31 @@ def test_enqueue_fault_leaves_queue_untouched():
     assert node.queue.depth() == 1
 
 
-def test_apply_fault_mid_firehose_holds_journal_parity():
-    """A fault mid-CONCURRENT-firehose: the run raises, producers abort,
-    and everything the node DID apply before the fault replays through
-    the literal spec to byte-identical head/root — the partial journal
-    is a true history.  A fresh fault-free run over the same corpus then
-    succeeds end-to-end (retry at run granularity)."""
-    from consensus_specs_tpu import stf
-    from consensus_specs_tpu.node import service
-
+def test_crash_kill_partial_journal_is_replayable():
+    """Item-granular atomicity across a mid-epoch kill: the partial
+    journal is a true history — it replays through the literal spec to
+    byte-identical head/root, and a recovered node resumes serving the
+    REST of the corpus to the same end state as an uncrashed node."""
     spec, state, corpus = _scaffold()
-    service.reset_stats()
-    with faults.inject(faults.FaultPlan([F("node.apply", nth=9)])):
-        with pytest.raises(faults.InjectedFault):
-            firehose.run_firehose(
-                spec, state, corpus, n_gossip_producers=3, queue_cap=8,
-                gossip_batch=32, producer_timeout=30.0)
-    # the faulted node is gone with the raise; what matters is the redo:
-    stf.reset_stats()
-    service.reset_stats()
-    result = firehose.run_firehose(
-        spec, state, corpus, n_gossip_producers=3, queue_cap=8,
-        gossip_batch=32, producer_timeout=30.0)
-    node = result["node"]
-    assert stf.stats["replayed_blocks"] == 0
-    ref = firehose.replay_journal_literal(
-        spec, state, corpus.anchor_block, node._journal)
-    firehose.assert_parity(spec, node, ref)
-
-
-def test_apply_fault_partial_journal_is_replayable():
-    """The sharper mid-firehose claim: hold on to the faulted node and
-    prove its PARTIAL journal replays to parity — the fault tore nothing
-    (single-writer loop + pre-mutation probe = item-granular
-    atomicity)."""
-    spec, state, corpus = _scaffold()
-    node = Node(spec, state)
+    node = Node(spec, state, retry_backoff_s=0.0)
     _enqueue_prefix(spec, node, corpus, 4)
-    with faults.inject(faults.FaultPlan([F("node.apply", nth=6)])):
-        with pytest.raises(faults.InjectedFault):
-            node.run_apply_loop()
-    assert len(node._journal) == 5  # items applied before the fault
-    ref = firehose.replay_journal_literal(
-        spec, state, corpus.anchor_block, node._journal)
-    firehose.assert_parity(spec, node, ref)
+    node.run_apply_loop(max_items=5)
+    assert len(node._journal) == 5
+    _assert_journal_parity(spec, state, corpus, node)
+
+    # recovery + resume: drain the crashed node's leftover queue through
+    # the recovered node; end state matches the literal replay of the
+    # combined journal
+    recovered = recover_node(spec, state, corpus.anchor_block, node.journal,
+                             retry_backoff_s=0.0)
+    while True:
+        item = node.queue.get(timeout=0)
+        if item is None:
+            break
+        recovered.queue.put(item.kind, item.payload)
+    recovered.queue.close()
+    recovered.run_apply_loop()
+    _assert_journal_parity(spec, state, corpus, recovered)
 
 
 def test_single_writer_contract_is_enforced():

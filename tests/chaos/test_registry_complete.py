@@ -51,6 +51,18 @@ def test_registry_depth_meets_the_acceptance_floor():
     assert len(_production_sites()) >= 12
 
 
+def test_node_survival_sites_are_registered_and_covered():
+    """ISSUE 13: the survival layer's seams exist AND each carries a
+    chaos case — removing a probe or dropping its case turns this red
+    independently of the generic completeness sweep above."""
+    expected = {"node.apply", "node.enqueue", "node.admission",
+                "node.quarantine", "node.recover"}
+    node_sites = {n for n in _production_sites() if n.startswith("node.")}
+    assert expected <= node_sites, sorted(expected - node_sites)
+    assert node_sites <= set(test_node_chaos.COVERED_SITES), \
+        sorted(node_sites - set(test_node_chaos.COVERED_SITES))
+
+
 def test_site_names_are_unique_and_dotted():
     for name in _production_sites():
         assert "." in name, f"site {name!r} is not a dotted path"

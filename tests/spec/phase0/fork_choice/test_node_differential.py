@@ -101,6 +101,117 @@ def test_node_finalizing_chain(spec, state):
     yield "steps", "data", test_steps
 
 
+# -- orphan-pool differential (ISSUE 13 satellite): out-of-order delivery
+# through the Node's admission gate converges to the in-order literal
+# spec store — the pool changes WHEN a block applies, never WHAT the
+# store ends up holding
+
+
+def _literal_in_order(spec, state, anchor, chain, final_time):
+    """The reference: a literal spec store, clock advanced first (same
+    arrival times as the node leg), blocks applied in chain order."""
+    ref = spec.get_forkchoice_store(state, anchor)
+    spec.on_tick(ref, final_time)
+    for sb in chain:
+        spec.on_block(ref, sb)
+    return ref
+
+
+def _delivery_case(build_delivery):
+    """Shared scaffold: one minimal epoch of full blocks; the node leg
+    delivers per ``build_delivery``, the reference leg applies in order;
+    head + checkpoint parity is byte-exact."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.node import admission, firehose
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.testing.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.testing.helpers.genesis import (
+        create_genesis_state,
+    )
+
+    spec = get_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    corpus = firehose.build_corpus(spec, state, n_epochs=1, gossip_target=8)
+    was_active = bls.bls_active
+    bls.bls_active = False  # unsigned corpus, both legs (the firehose shape)
+    try:
+        admission.reset_stats()
+        node = Node(spec, state, corpus.anchor_block, retry_backoff_s=0.0)
+        last = int(corpus.chain[-1].message.slot)
+        final_time = (int(state.genesis_time)
+                      + (last + 1) * int(spec.config.SECONDS_PER_SLOT))
+        node.enqueue_tick(final_time)
+        applied_chain = build_delivery(spec, node, corpus)
+        node.queue.close()
+        node.run_apply_loop()
+
+        ref = _literal_in_order(spec, state, corpus.anchor_block,
+                                applied_chain, final_time)
+        assert bytes(node.get_head()) == bytes(spec.get_head(ref))
+        head = bytes(node.get_head())
+        assert bytes(node.store.block_states[head].hash_tree_root()) == \
+            bytes(ref.block_states[head].hash_tree_root())
+        assert node.store.justified_checkpoint == ref.justified_checkpoint
+        assert node.store.finalized_checkpoint == ref.finalized_checkpoint
+        return spec, node, corpus, admission
+    finally:
+        bls.bls_active = was_active
+
+
+def test_node_child_before_parent_converges_to_in_order():
+    """The whole epoch delivered in REVERSE: every block but the first
+    orphans, then one cascade re-links the chain — end state identical
+    to the literal spec fed in order."""
+    def deliver(spec, node, corpus):
+        for sb in reversed(corpus.chain):
+            node.enqueue_block(sb)
+        return corpus.chain
+
+    _spec, _node, corpus, admission = _delivery_case(deliver)
+    assert admission.stats["orphaned"] == len(corpus.chain) - 1
+    assert admission.stats["orphans_relinked"] == len(corpus.chain) - 1
+
+
+def test_node_duplicate_redelivery_converges_to_once_each():
+    """Every block delivered twice (the second a fresh wire decode): the
+    duplicates suppress at admission and the store matches the literal
+    spec that saw each block once."""
+    def deliver(spec, node, corpus):
+        for sb in corpus.chain:
+            node.enqueue_block(sb)
+            node.enqueue_block(
+                spec.SignedBeaconBlock.decode_bytes(sb.encode_bytes()))
+        return corpus.chain
+
+    _spec, _node, corpus, admission = _delivery_case(deliver)
+    assert admission.stats["duplicates"] == len(corpus.chain)
+
+
+def test_node_expired_orphan_converges_to_chain_without_it():
+    """A child whose parent is withheld expires out of the pool; the
+    node's store matches the literal spec that never saw the orphan (or
+    its withheld parent) at all."""
+    def deliver(spec, node, corpus):
+        # withhold block 4; its child (block 5) orphans (the default
+        # window is far wider than the corpus) and must expire below
+        node.enqueue_block(corpus.chain[4])
+        for sb in corpus.chain[:3]:
+            node.enqueue_block(sb)
+        return corpus.chain[:3]
+
+    spec, node, corpus, admission = _delivery_case(deliver)
+    assert admission.stats["orphaned"] == 1
+    # housekeeping far past the window drops it
+    admission.expire_orphans(int(corpus.chain[-1].message.slot)
+                             + admission.ORPHAN_EXPIRY_SLOTS + 64)
+    assert admission.stats["orphans_expired"] == 1
+    assert admission.snapshot()["orphan_pool_depth"] == 0
+
+
 @with_phases(["phase0"])
 @spec_state_test
 def test_node_on_block_stf_stats_engaged(spec, state):

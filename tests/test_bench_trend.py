@@ -130,6 +130,15 @@ def test_counters_open_breaker_and_degradation_block():
     assert msg is not None and "degraded" in msg
 
 
+def test_counters_quarantined_items_block():
+    # ISSUE 13: a dead-lettered item in a fault-free bench run means the
+    # apply path broke and containment absorbed it — refuse the headline
+    msg = bench.check_counter_invariants(_e2e_row(quarantined_items=1))
+    assert msg is not None and "quarantined 1 items" in msg
+    # a row that doesn't report the counter (pre-ISSUE-13) stays silent
+    assert bench.check_counter_invariants(_e2e_row()) is None
+
+
 def test_counters_hit_rate_floor_breach_blocks():
     # the exit-4 path the driver sees: a keying regression zeroes the
     # plan hit ratio while wall-time may still look fine

@@ -187,6 +187,41 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         instance_attrs=frozenset({"_journal"}),
         invalidators=frozenset(),
     ),
+    # node survival structures (ISSUE 13): the orphan pool and the
+    # dead-letter ring are admission.py's alone — an outside insert
+    # would break the pool bound, the expiry bookkeeping, and the
+    # post-mortem's claim that every dead letter came from an exhausted
+    # retry.  EF01 inherits these: an insert next to the admission/
+    # quarantine probes must carry its try-invalidation
+    CacheSpec(
+        name="node orphan pool",
+        owner=("node", "admission.py"),
+        module="consensus_specs_tpu.node.admission",
+        module_globals=frozenset({"_ORPHANS"}),
+        invalidators=frozenset({"reset_state"}),
+    ),
+    CacheSpec(
+        name="node dead-letter ring",
+        owner=("node", "admission.py"),
+        module="consensus_specs_tpu.node.admission",
+        module_globals=frozenset({"_DEAD_LETTERS"}),
+        invalidators=frozenset({"reset_state"}),
+    ),
+    # the admission side-tables (seen-set, parked ring, peer scores):
+    # CC01 ownership applies, but a fault-stranded entry is self-healing
+    # by construction — a retried item re-enters as a re-admission
+    # (attempts > 0 skips the dedup check) and scores/parking decay on
+    # the clock — so EF01's transactional-insert discipline does not
+    # (observational, like the latency histograms)
+    CacheSpec(
+        name="node admission side-tables",
+        owner=("node", "admission.py"),
+        module="consensus_specs_tpu.node.admission",
+        module_globals=frozenset({"_SEEN", "_PARKED", "_SCORES",
+                                  "_QUARANTINED"}),
+        invalidators=frozenset({"reset_state"}),
+        observational=True,
+    ),
     # telemetry-owned structures (ISSUE 9): the provider registry and the
     # flight-recorder ring are mutated only through their owner module's
     # API (register_provider / record) — a direct poke from a producer

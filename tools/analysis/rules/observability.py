@@ -52,9 +52,14 @@ _RING_APPENDERS = {"append", "appendleft", "extend", "extendleft", "insert"}
 # node_block / node_gossip (ISSUE 12) are the node pipeline's
 # commit-class events: each asserts an item fully applied — recorded
 # before the block's transaction settles, a fault would roll the apply
-# back and the timeline would claim a served item that never landed
+# back and the timeline would claim a served item that never landed.
+# node_quarantine / node_recovered (ISSUE 13) join them: the first
+# asserts a poison item LANDED in the dead-letter ring, the second that
+# a journal replay fully rebuilt the store — logged early, either would
+# put a containment action in the post-mortem that never settled
 _COMMIT_KINDS = {"cache_commit", "block_fast", "mirror_flush",
-                 "memo_commit", "node_block", "node_gossip"}
+                 "memo_commit", "node_block", "node_gossip",
+                 "node_quarantine", "node_recovered"}
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
