@@ -138,11 +138,18 @@ def _bench_cache_dir():
                         ".bench_cache")
 
 
+_CORPUS_KIND = "bench-corpus"
+
+
 def _read_framed(path, typ):
     """Length-prefixed SSZ list file -> decoded objects (the corpus cache
-    framing, shared by the block and firehose caches)."""
-    with open(path, "rb") as f:
-        raw = f.read()
+    framing, shared by the block and firehose caches).  Reads through
+    the shared artifact envelope (ISSUE 14): a truncated or bit-rotted
+    cache raises ``ArtifactError`` and the caller rebuilds cold instead
+    of feeding a damaged corpus into a measured row."""
+    from consensus_specs_tpu.persist import atomic
+
+    raw = atomic.read_artifact(path, _CORPUS_KIND)
     out, off = [], 0
     while off < len(raw):
         ln = int.from_bytes(raw[off:off + 4], "little")
@@ -153,15 +160,17 @@ def _read_framed(path, typ):
 
 
 def _write_framed(path, objs):
-    """Atomically persist SSZ objects in the length-prefixed framing."""
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        for obj in objs:
-            enc = obj.encode_bytes()
-            f.write(len(enc).to_bytes(4, "little"))
-            f.write(enc)
-    os.replace(tmp, path)
+    """Atomically persist SSZ objects in the length-prefixed framing
+    through ``persist/atomic.py`` — the one torn-write-safe write path
+    in the tree (unique temp + ``os.replace`` + trailing digest)."""
+    from consensus_specs_tpu.persist import atomic
+
+    payload = bytearray()
+    for obj in objs:
+        enc = obj.encode_bytes()
+        payload += len(enc).to_bytes(4, "little")
+        payload += enc
+    atomic.write_artifact(path, bytes(payload), _CORPUS_KIND)
 
 
 def _corpus_through_cache(spec, state, build_fn, n=None):
@@ -175,8 +184,14 @@ def _corpus_through_cache(spec, state, build_fn, n=None):
     cache_path = os.path.join(_bench_cache_dir(), cache_key + ".ssz")
 
     if os.path.exists(cache_path):
-        t, blocks = _timed(_read_framed, cache_path, spec.SignedBeaconBlock)
-        return True, t, blocks
+        from consensus_specs_tpu.persist import atomic
+
+        try:
+            t, blocks = _timed(_read_framed, cache_path,
+                               spec.SignedBeaconBlock)
+            return True, t, blocks
+        except atomic.ArtifactError:
+            pass  # damaged/stale cache artifact: rebuild cold below
     t, blocks = _timed(build_fn)
     try:
         _write_framed(cache_path, blocks)
@@ -1185,14 +1200,19 @@ def _firehose_corpus_through_cache(spec, state, n_epochs, gossip_target):
     atts_path = os.path.join(_bench_cache_dir(), key + ".atts.ssz")
 
     if os.path.exists(blocks_path) and os.path.exists(atts_path):
+        from consensus_specs_tpu.persist import atomic
+
         def _load():
             chain = _read_framed(blocks_path, spec.SignedBeaconBlock)
             return firehose.FirehoseCorpus(
                 firehose.default_anchor_block(spec, state), chain,
                 _framed_atts_by_slot(atts_path, spec))
 
-        t, corpus = _timed(_load)
-        return True, t, corpus
+        try:
+            t, corpus = _timed(_load)
+            return True, t, corpus
+        except atomic.ArtifactError:
+            pass  # damaged/stale cache artifact: rebuild cold below
     t, corpus = _timed(firehose.build_corpus, spec, state, n_epochs,
                        gossip_target)
     try:
@@ -1325,6 +1345,8 @@ def _adversarial_corpus_through_cache(spec, state, n_epochs, gossip_target):
              for part in ("blocks", "atts", "shed", "fork")}
 
     if all(os.path.exists(p) for p in paths.values()):
+        from consensus_specs_tpu.persist import atomic
+
         def _load():
             chain = _read_framed(paths["blocks"], spec.SignedBeaconBlock)
             fork = _read_framed(paths["fork"], spec.SignedBeaconBlock)
@@ -1333,8 +1355,11 @@ def _adversarial_corpus_through_cache(spec, state, n_epochs, gossip_target):
                 prebuilt=(chain, _framed_atts_by_slot(paths["atts"], spec),
                           _framed_atts_by_slot(paths["shed"], spec), fork))
 
-        t, corpus = _timed(_load)
-        return True, t, corpus
+        try:
+            t, corpus = _timed(_load)
+            return True, t, corpus
+        except atomic.ArtifactError:
+            pass  # damaged/stale cache artifact: rebuild cold below
     t, corpus = _timed(adversary.build_adversarial_corpus, spec, state,
                        90013, n_epochs, gossip_target)
     try:
@@ -1483,6 +1508,147 @@ def bench_node_firehose_adversarial(results, n_validators=None, n_epochs=3,
         bls.bls_active = was_active
         if not was_recording:
             recorder.disable()
+
+
+def bench_node_recover_checkpoint(results, n_validators=None, n_epochs=10,
+                                  gossip_target=100_000,
+                                  n_gossip_producers=3):
+    """Driver-parsed ``node_recover_checkpoint`` row (ISSUE 14): crash
+    recovery off the durable checkpoint store vs PR 13's full journal
+    replay, at mainnet validator count.  The firehose serves
+    ``n_epochs`` with an ASYNC ``CheckpointStore`` attached (epoch-
+    fenced writes off the single-writer hot path), then the node
+    "crashes" and recovers twice: the full replay (every journal item
+    through the engine-backed handlers) and the checkpoint fast path
+    (restore the newest artifact, replay only the suffix).  Asserted
+    in-run: the ≥5x acceptance floor, byte-identical head/root/
+    checkpoints/latest-messages for BOTH recoveries vs the crashed
+    node, literal-spec parity for the checkpoint-recovered store, and
+    zero corrupt artifacts in a fault-free run (the counter gate holds
+    that line run over run)."""
+    import shutil
+
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.node import firehose
+    from consensus_specs_tpu.node import service as node_service
+    from consensus_specs_tpu.node.service import recover_node
+    from consensus_specs_tpu.persist import store as persist_store
+    from consensus_specs_tpu.persist.store import CheckpointStore
+    from consensus_specs_tpu.specs.builder import get_spec
+    from consensus_specs_tpu.stf import verify as stf_verify
+
+    n = n_validators or N_VALIDATORS
+    spec = get_spec("phase0", "mainnet")
+    was_active = bls.bls_active
+    bls.bls_active = False
+    ckpt_dir = os.path.join(_bench_cache_dir(), f"persist_{n}")
+    store = None
+    try:
+        t_build_state, state = _timed(build_state, spec, n)
+        firehose.prepare_anchor(spec, state)
+        corpus_cached, t_corpus, corpus = _firehose_corpus_through_cache(
+            spec, state, n_epochs, gossip_target)
+
+        # a fresh store per run: this row measures the recovery path,
+        # not artifact reuse across runs
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        store = CheckpointStore(ckpt_dir, cap=3)
+        node_service.reset_stats()
+        stf.reset_stats()
+        persist_store.reset_stats()
+        run = firehose.run_firehose(
+            spec, state, corpus, n_gossip_producers=n_gossip_producers,
+            checkpoint_store=store)
+        node = run.pop("node")
+        assert store.flush(timeout=120.0), "checkpoint writer stalled"
+        assert persist_store.stats["checkpoints_written"] >= 2, \
+            persist_store.stats
+        assert persist_store.stats["write_failures"] == 0
+        journal = node.journal
+        newest_pos = max(m["journal_pos"] for m in store.entries().values())
+        suffix_items = len(journal) - newest_pos
+        n_written = persist_store.stats["checkpoints_written"]
+
+        # crash drill: full replay (PR 13) vs checkpoint fast path
+        t_full, rec_full = _timed(
+            recover_node, spec, state, corpus.anchor_block, journal)
+        persist_store.reset_stats()
+        t_ckpt, rec_ckpt = _timed(
+            lambda: recover_node(spec, state, corpus.anchor_block, journal,
+                                 checkpoint_store=store))
+        assert node_service.stats["checkpoint_recoveries"] == 1, \
+            "the fast path did not engage"
+        assert persist_store.stats["corruptions"] == 0
+        assert persist_store.stats["restore_fallbacks"] == 0
+        speedup = t_full / t_ckpt
+        assert speedup >= 5.0, (
+            f"checkpoint recovery {t_ckpt:.2f}s vs full replay "
+            f"{t_full:.2f}s: {speedup:.1f}x < the 5x acceptance floor")
+
+        # byte-identical world for BOTH recoveries vs the crashed node
+        head = bytes(node.get_head())
+        head_state_root = bytes(
+            node.store.block_states[head].hash_tree_root())
+        for rec, leg in ((rec_full, "full-replay"),
+                         (rec_ckpt, "checkpoint")):
+            assert bytes(rec.get_head()) == head, leg
+            assert bytes(rec.store.block_states[head].hash_tree_root()) \
+                == head_state_root, leg
+            assert rec.store.justified_checkpoint == \
+                node.store.justified_checkpoint, leg
+            assert rec.store.finalized_checkpoint == \
+                node.store.finalized_checkpoint, leg
+            assert dict(rec.store.latest_messages) == \
+                dict(node.store.latest_messages), leg
+        # and the literal spec agrees with the checkpoint-recovered node
+        t_parity, ref = _timed(
+            firehose.replay_journal_literal, spec, state,
+            corpus.anchor_block, rec_ckpt._journal)
+        roots = firehose.assert_parity(spec, rec_ckpt, ref)
+
+        results["node_recover_checkpoint"] = {
+            "metric": (f"node_recover_checkpoint_{n_epochs}epochs_"
+                       f"{n}_validators"),
+            "value": round(t_ckpt, 3),
+            "unit": "s",
+            "vs_baseline": round(speedup, 1),  # x over full replay
+            "recover_full_s": round(t_full, 3),
+            "recover_checkpoint_s": round(t_ckpt, 3),
+            "journal_items": len(journal),
+            "suffix_items": suffix_items,
+            "checkpoints_written": n_written,
+            "store_depth": store.depth(),
+            "store_cap": store.cap,
+            "bytes_on_disk": store.bytes_on_disk(),
+            "head_parity": True,
+            "recovered_head_parity": True,
+            **roots,
+            "literal_replay_s": round(t_parity, 3),
+            "serving_elapsed_s": run["elapsed_s"],
+            "state_build_s": round(t_build_state, 3),
+            "corpus_build_s": round(t_corpus, 3),
+            "corpus_cached": corpus_cached,
+            # counter invariants (the trend gate reads this subtree): a
+            # corrupt artifact or a silent fallback to full replay in a
+            # fault-free run refuses the headline like a slowdown
+            "telemetry": {
+                "replayed_blocks": stf.stats["replayed_blocks"],
+                "breaker_state": stf.stats["breaker_state"],
+                "native_degraded": stf_verify.stats["native_degraded"],
+                "quarantined_items":
+                    node_service.stats["quarantined_items"],
+                "store_corruptions": persist_store.stats["corruptions"],
+                "restore_fallbacks":
+                    persist_store.stats["restore_fallbacks"],
+                "checkpoint_recoveries":
+                    node_service.stats["checkpoint_recoveries"],
+            },
+        }
+    finally:
+        bls.bls_active = was_active
+        if store is not None:
+            store.close()
 
 
 def bench_scale_probe(results):
@@ -1837,6 +2003,19 @@ def check_counter_invariants(current, previous=None, plan_floor=0.25,
         # containment layer absorbed it (wall-time would never show it)
         return (f"counter invariant: {metric} quarantined "
                 f"{tel['quarantined_items']} items in a fault-free run")
+    if tel.get("store_corruptions"):
+        # ISSUE 14: a fault-free bench run writes and restores its own
+        # checkpoints — a corrupt artifact here means the write path
+        # tore or the codec drifted, and the degradation ladder silently
+        # absorbed it (recovery wall-time would barely show it)
+        return (f"counter invariant: {metric} hit "
+                f"{tel['store_corruptions']} corrupt checkpoint "
+                f"artifacts in a fault-free run")
+    if tel.get("restore_fallbacks"):
+        # the checkpoint fast path silently degrading to full journal
+        # replay is the recovery twin of a replayed block
+        return (f"counter invariant: {metric} fell back to full journal "
+                f"replay {tel['restore_fallbacks']} times")
     for key, floor in (("plan_hit_ratio", plan_floor),
                        ("memo_hit_ratio", memo_floor)):
         ratio = tel.get(key)
@@ -1916,6 +2095,11 @@ def main():
             except Exception as exc:
                 results["node_firehose_adversarial"] = {
                     "error": repr(exc)[:300]}
+            try:
+                bench_node_recover_checkpoint(results)
+            except Exception as exc:
+                results["node_recover_checkpoint"] = {
+                    "error": repr(exc)[:300]}
     if os.environ.get("BENCH_SCALE_PROBE") == "1":
         try:
             bench_scale_probe(results)
@@ -1964,7 +2148,8 @@ def main():
     # its counter-invariant history must stay diffable run over run)
     for preserved in ("epoch_scale_1m", "epoch_e2e_scale_1m",
                       "epoch_e2e_scale_2m", "node_firehose",
-                      "node_firehose_adversarial"):
+                      "node_firehose_adversarial",
+                      "node_recover_checkpoint"):
         if preserved not in results and prev_details.get(preserved):
             results[preserved] = prev_details[preserved]
     if prev_details:
@@ -2050,7 +2235,8 @@ def main():
             # same way, and their wall time rides the perf trend too
             for row_key in ("epoch_e2e_bls", "epoch_e2e_bls_altair",
                             "epoch_e2e_scale_1m", "epoch_e2e_scale_2m",
-                            "node_firehose", "node_firehose_adversarial"):
+                            "node_firehose", "node_firehose_adversarial",
+                            "node_recover_checkpoint"):
                 regressions.append(check_counter_invariants(
                     results.get(row_key), prev_details.get(row_key)))
             # node_firehose rides the same wall-time trend gate as the
@@ -2059,7 +2245,8 @@ def main():
             # erode run over run (ISSUE 12); the adversarial row joins
             # it (ISSUE 13): survival must not get slower either
             for row_key in ("epoch_e2e_scale_1m", "epoch_e2e_scale_2m",
-                            "node_firehose", "node_firehose_adversarial"):
+                            "node_firehose", "node_firehose_adversarial",
+                            "node_recover_checkpoint"):
                 regressions.append(check_perf_trend(
                     results.get(row_key), prev_details.get(row_key),
                     previous_details=prev_details.get(row_key)))

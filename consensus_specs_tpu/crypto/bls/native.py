@@ -64,6 +64,9 @@ def _build() -> str:
     if proc.returncode != 0:
         os.unlink(tmp_path)
         raise ImportError(f"native BLS build failed:\n{proc.stderr[-2000:]}")
+    # durable-io: the .so is a compiler OUTPUT promoted whole — the
+    # envelope cannot wrap a dlopen target, and staleness is already
+    # governed by the source-digest in its filename
     os.replace(tmp_path, so_path)  # atomic: concurrent builders converge
     return so_path
 

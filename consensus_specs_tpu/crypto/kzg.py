@@ -204,51 +204,37 @@ def _fixed_table_path(nat, flat: bytes) -> str:
     return os.path.join(here, f"_msmtab_{key}.bin")
 
 
+_MSM_TABLE_KIND = "msm-fixed-table"
+
+
 def _load_or_build_fixed_table(nat, flat: bytes) -> bytes:
     """Disk-cached shifted-window table: the ~1-5 s expansion of a blob
     setup otherwise recurs in every process.  Keyed by (native source
-    digest, ABI tag, points digest) — the entries are raw Montgomery
-    limbs, valid only for the exact library build *and host ABI* — with a
-    trailing SHA-256 guarding against torn/corrupted files.
+    digest, ABI tag, points digest) in the PATH — the entries are raw
+    Montgomery limbs, valid only for the exact library build *and host
+    ABI* — and persisted through ``persist/atomic.py`` (ISSUE 14: this
+    cache pioneered the torn-write-safe discipline in PR 5; it now rides
+    the one shared implementation): unique-temp + ``os.replace`` writes,
+    trailing SHA-256, and the ABI tag bound INSIDE the envelope too, so
+    even a renamed foreign table degrades to a miss.
 
-    Failure containment: a truncated or damaged file (torn write, disk
-    fault) fails the length/digest check and is REGENERATED in place, and
-    writes go to a uniquely-named temp file promoted with ``os.replace``
-    — concurrent builders each write their own temp and the atomic rename
-    means a reader can never observe a half-written table (the C side's
-    on-curve entry-0 check stays as the tamper backstop behind both)."""
-    import hashlib
-    import os
-    import tempfile
+    Failure containment: a truncated, damaged, or stale-tagged file
+    fails verification and is REGENERATED in place; a reader can never
+    observe a half-written table (the C side's on-curve entry-0 check
+    stays as the tamper backstop behind both)."""
+    from consensus_specs_tpu.persist import atomic
 
     path = _fixed_table_path(nat, flat)
+    tag = _msm_abi_tag(nat)
     expect = 96 * (len(flat) // 96) * nat._MSM_FIXED_WINDOWS
     try:
-        with open(path, "rb") as f:
-            data = f.read()
-        if (len(data) == expect + 32
-                and hashlib.sha256(data[:-32]).digest() == data[-32:]):
-            return data[:-32]
-    except OSError:
-        pass
+        return atomic.read_artifact(path, _MSM_TABLE_KIND, tag,
+                                    expected_payload_len=expect)
+    except atomic.ArtifactError:
+        pass  # missing / truncated / damaged / stale: rebuild below
     table = nat.G1MSMPrecompute(flat)
     try:
-        fd, tmp = tempfile.mkstemp(
-            prefix=os.path.basename(path) + ".", suffix=".tmp",
-            dir=os.path.dirname(path))
-        try:
-            # mkstemp creates 0600; restore plain-open() semantics so a
-            # shared cache stays readable by other accounts' processes
-            umask = os.umask(0)
-            os.umask(umask)
-            os.fchmod(fd, 0o666 & ~umask)
-            with os.fdopen(fd, "wb") as f:
-                f.write(table)
-                f.write(hashlib.sha256(table).digest())
-            os.replace(tmp, path)  # atomic: concurrent builders converge
-        except BaseException:
-            os.unlink(tmp)
-            raise
+        atomic.write_artifact(path, table, _MSM_TABLE_KIND, tag)
     except OSError:
         pass  # read-only tree: rebuild per process
     return table

@@ -215,17 +215,19 @@ def assert_parity(spec, node: Node, ref) -> dict:
 def run_firehose(spec, anchor_state, corpus: FirehoseCorpus,
                  n_gossip_producers: int = 3, queue_cap: int = 64,
                  gossip_batch: int = 512,
-                 producer_timeout: float = 300.0) -> dict:
+                 producer_timeout: float = 300.0, **node_kwargs) -> dict:
     """Serve ``corpus`` through a fresh ``Node`` under concurrent load:
     1 chain driver + ``n_gossip_producers`` gossip threads enqueue, the
-    calling thread runs the single-writer apply loop.  Returns the
-    throughput/behavior row (the caller owns stats resets and the
-    parity leg — see bench.py / tests/node/)."""
+    calling thread runs the single-writer apply loop.  Extra keyword
+    arguments reach the ``Node`` constructor (``checkpoint_store=...``
+    runs the firehose with durable checkpointing — the recovery bench's
+    shape).  Returns the throughput/behavior row (the caller owns stats
+    resets and the parity leg — see bench.py / tests/node/)."""
     spe = int(spec.SLOTS_PER_EPOCH)
     genesis_time = int(anchor_state.genesis_time)
     sps = int(spec.config.SECONDS_PER_SLOT)
     node = Node(spec, anchor_state, corpus.anchor_block,
-                queue_cap=queue_cap)
+                queue_cap=queue_cap, **node_kwargs)
 
     slots = sorted(corpus.gossip)
     remaining_by_epoch: Dict[int, int] = {}

@@ -67,6 +67,7 @@ from typing import Optional, Sequence
 
 from consensus_specs_tpu import faults, telemetry
 from consensus_specs_tpu.forkchoice import ForkChoiceEngine
+from consensus_specs_tpu.persist import store as persist_store
 from consensus_specs_tpu.stf import apply_signed_blocks
 from consensus_specs_tpu.telemetry import recorder, timeline
 
@@ -102,6 +103,9 @@ stats = {
     "quarantined_items": 0,
     "requeued_items": 0,
     "recoveries": 0,
+    "checkpoint_recoveries": 0,
+    "checkpoints_scheduled": 0,
+    "checkpoint_gather_failures": 0,
     "apply_loop_runs": 0,
 }
 
@@ -201,17 +205,27 @@ class Node:
                  admission_gate: bool = True,
                  max_item_retries: int = DEFAULT_MAX_ITEM_RETRIES,
                  retry_backoff_s: float = DEFAULT_RETRY_BACKOFF_S,
-                 adopt_admission: bool = True):
+                 adopt_admission: bool = True,
+                 checkpoint_store=None,
+                 checkpoint_interval_epochs: int = 1,
+                 _warm_store=None):
         self.spec = spec
-        if anchor_block is None:
-            anchor_block = default_anchor_block(spec, anchor_state)
-        store = spec.get_forkchoice_store(anchor_state, anchor_block)
+        if _warm_store is not None:
+            # checkpoint recovery (ISSUE 14): a spec Store rebuilt from a
+            # restored checkpoint — the engine's warm-store path seeds the
+            # proto-array, votes, and checkpoint sync from it
+            store = _warm_store
+        else:
+            if anchor_block is None:
+                anchor_block = default_anchor_block(spec, anchor_state)
+            store = spec.get_forkchoice_store(anchor_state, anchor_block)
         self.engine = ForkChoiceEngine(
             spec, store, block_handler=self._on_block_stf)
         self.queue = ingest.IngestQueue(cap=queue_cap)
         # apply-order journal: the literal-spec parity replay's script.
         # Owner-mutated only (analyzer-registered next to the queue).
         self._journal = [] if journal else None
+        self._journal_last_block = None
         self._writer_lock = threading.Lock()
         self._clock_cond = threading.Condition()
         self._clock_slot = int(spec.get_current_slot(store))
@@ -227,6 +241,16 @@ class Node:
         self._admission = admission_gate
         self._max_item_retries = max(1, int(max_item_retries))
         self._retry_backoff_s = float(retry_backoff_s)
+        # durable checkpoint cadence (ISSUE 14): the apply loop writes a
+        # checkpoint whenever the store clock crosses an epoch boundary
+        # (the fence) — gathering is cheap reference-taking under the
+        # single writer; serialization + the atomic write happen on the
+        # store's background writer thread, off the serving hot path
+        self._ckpt_store = checkpoint_store
+        self._ckpt_interval = max(1, int(checkpoint_interval_epochs))
+        self._spe = int(spec.SLOTS_PER_EPOCH)
+        self._ckpt_epoch_seen = \
+            int(spec.get_current_slot(store)) // self._spe
         if adopt_admission:
             admission.reset_state()
 
@@ -251,6 +275,12 @@ class Node:
     def _journal_append(self, kind: str, payload) -> None:
         if self._journal is not None:
             self._journal.append((kind, payload))
+            if kind == "block":
+                # the checkpoint's content-bound journal anchor (root is
+                # memoized — on_block already hashed the message)
+                self._journal_last_block = (
+                    len(self._journal) - 1,
+                    bytes(payload.message.hash_tree_root()).hex())
 
     def _note_clock(self) -> None:
         slot = int(self.spec.get_current_slot(self.engine.store))
@@ -476,6 +506,71 @@ class Node:
                         self._clock_slot - clock_before)
                     work.extend((r, True) for r in released)
 
+    # -- durable checkpoints (ISSUE 14) --------------------------------------
+
+    def _maybe_checkpoint(self) -> None:
+        """Epoch-fenced checkpoint cadence, called by the apply loop
+        after every settled item.  A failure gathering or (synchronous
+        store) writing is counted and contained — persistence trouble
+        must never halt serving; the atomic layer guarantees it also
+        never leaves a torn artifact behind."""
+        # the clock the node already tracks (every tick updates
+        # _clock_slot in _note_clock) — zero spec calls per settled item
+        epoch = self._clock_slot // self._spe
+        if epoch < self._ckpt_epoch_seen + self._ckpt_interval:
+            return
+        self._ckpt_epoch_seen = epoch
+        if self._journal is None or not self._journal:
+            return  # a journal-less node has nothing a restore can resume
+        try:
+            payload = self._gather_checkpoint()
+            if payload is not None:
+                self._ckpt_store.submit(self.spec, payload)
+                stats["checkpoints_scheduled"] += 1
+        except Exception:
+            stats["checkpoint_gather_failures"] += 1
+
+    def _gather_checkpoint(self):
+        """Snapshot the fork-choice world under the single writer: the
+        finalized anchor, every block/state descending from it (the
+        since-finality window), and the store extras — all as references
+        to immutable views and shallow copies of the small maps, so the
+        gather costs milliseconds and the writer thread serializes from
+        a frozen picture."""
+        spec, store = self.spec, self.engine.store
+        fin_root = bytes(store.finalized_checkpoint.root)
+        if fin_root not in store.blocks:
+            return None
+        window = []
+        descend = {fin_root}
+        for root, block in sorted(store.blocks.items(),
+                                  key=lambda kv: int(kv[1].slot)):
+            rb = bytes(root)
+            if rb == fin_root or bytes(block.parent_root) in descend:
+                descend.add(rb)
+                state = store.block_states[root]
+                # memoize every root in the loop thread (a no-op after
+                # the block's own state-root check) so the writer
+                # thread's tree walk is purely read-only
+                state.hash_tree_root()
+                window.append((rb, block, state))
+        if not window:
+            return None
+        return persist_store.CheckpointPayload(
+            journal_pos=len(self._journal),
+            trigger=_journal_token(self._journal[-1]),
+            time=int(store.time),
+            justified=_cp_pair(store.justified_checkpoint),
+            best_justified=_cp_pair(store.best_justified_checkpoint),
+            finalized=_cp_pair(store.finalized_checkpoint),
+            proposer_boost_root=bytes(store.proposer_boost_root),
+            latest_messages=dict(store.latest_messages),
+            equivocating=frozenset(store.equivocating_indices),
+            anchor_root=fin_root,
+            window=tuple(window),
+            head_state_root=bytes(window[-1][2].hash_tree_root()),
+            last_block=self._journal_last_block)
+
     def run_apply_loop(self, timeout: Optional[float] = None,
                        max_items: Optional[int] = None) -> int:
         """Drain the queue until it is closed and empty (or ``timeout``
@@ -494,47 +589,158 @@ class Node:
                 return processed
             self._process_item(item)
             processed += 1
+            if self._ckpt_store is not None:
+                self._maybe_checkpoint()
         return processed
 
 
-def recover_node(spec, anchor_state, anchor_block=None, journal=(),
-                 **node_kwargs) -> Node:
-    """Journal-based crash recovery (ISSUE 13): rebuild a fresh ``Node``
-    from the same anchor and replay a crashed node's apply-order journal
-    through the engine-backed handlers — the recovered store is
-    byte-identical to the crashed one's (the journal is a true history:
-    item-granular atomicity means nothing half-applied, and every
-    handler is deterministic given apply order).  Orphan/parked pools
-    are NOT part of the contract — pooled items were never applied, so
-    they are simply gossip the mesh will re-deliver.  The dead-letter
-    ring, peer scores, and quarantine set DO survive: recovery must not
-    destroy the post-mortem evidence or release a quarantined flooder.
+def _journal_token(entry) -> tuple:
+    """A content-bound identity token for one journal entry — what a
+    checkpoint records about the entry it was written after, and what
+    recovery compares before trusting that a checkpoint belongs to THIS
+    journal.  Tick tokens alone would collide across any two runs on
+    the same slot schedule, so attestation tokens bind content (first/
+    last data roots) and the checkpoint ALSO records the newest block
+    entry's (position, root) — see ``_recover_from_checkpoint``: a
+    checkpoint directory from a different run must degrade to a stale
+    miss, never splice a foreign suffix onto a restored store."""
+    kind, payload = entry
+    if kind == "block":
+        return ("block", bytes(payload.message.hash_tree_root()).hex())
+    if kind == "tick":
+        return ("tick", int(payload))
+    if kind == "attestations":
+        if not payload:
+            return ("attestations", 0)
+        return ("attestations", len(payload),
+                bytes(payload[0].hash_tree_root()).hex(),
+                bytes(payload[-1].hash_tree_root()).hex())
+    if kind == "attester_slashing":
+        return ("attester_slashing", bytes(payload.hash_tree_root()).hex())
+    return (kind, None)
 
-    The ``node.recover`` probe fires after construction and before the
-    replay: an injected recovery failure discards the half-built node
-    and touches nothing global — a retried recovery starts clean.
-    Emits ``node_recovered`` once the replay fully settles."""
+
+def _cp_pair(checkpoint) -> tuple:
+    return (int(checkpoint.epoch), bytes(checkpoint.root))
+
+
+def _last_block_matches(journal, last_block, pos: int) -> bool:
+    """The checkpoint's content-bound journal anchor: the newest block
+    entry it recorded must sit at the same position with the same root
+    in THIS journal.  Tick/gossip trigger tokens repeat across runs on
+    the same slot schedule; a block root cannot — so a foreign-run
+    checkpoint directory fails here and degrades to a stale miss."""
+    if last_block is None:
+        return True  # a pre-first-block checkpoint has no anchor to pin
+    lbp, lbroot = int(last_block[0]), last_block[1]
+    if not 0 <= lbp < pos or lbp >= len(journal):
+        return False
+    kind, payload = journal[lbp]
+    return (kind == "block"
+            and bytes(payload.message.hash_tree_root()).hex() == lbroot)
+
+
+def _replay_journal(node: Node, journal) -> None:
+    for kind, payload in journal:
+        if kind == "tick":
+            node.on_tick(payload)
+        elif kind == "block":
+            node.on_block(payload)
+        elif kind == "attestations":
+            node.on_attestations(payload)
+        elif kind == "attester_slashing":
+            node.on_attester_slashing(payload)
+        else:
+            raise ValueError(f"unknown journal kind {kind!r}")
+
+
+def _recover_from_checkpoint(spec, journal, checkpoint_store,
+                             node_kwargs) -> Optional[Node]:
+    """The checkpoint fast path: walk candidates newest-first, restore
+    the first one that is intact AND belongs to this journal, then
+    replay only the suffix.  Every rung of the ladder — damaged
+    artifact, stale tag, foreign journal — moves to the next candidate;
+    None (the caller falls back to full replay) only when all are
+    exhausted."""
+    journal = list(journal)
+    for path in checkpoint_store.candidates():
+        try:
+            restored = checkpoint_store.restore(spec, path)
+        except persist_store.CheckpointError:
+            continue  # quarantined + counted + flight-recorded inside
+        pos = restored.journal_pos
+        if not (1 <= pos <= len(journal)) or tuple(
+                _journal_token(journal[pos - 1])) != tuple(restored.trigger):
+            # an intact checkpoint from another journal/run: a stale
+            # miss, not damage — the artifact survives for ITS journal
+            persist_store.stats["stale_artifacts"] += 1
+            continue
+        if not _last_block_matches(journal,
+                                   restored.meta.get("last_block"), pos):
+            persist_store.stats["stale_artifacts"] += 1
+            continue
+        store = restored.as_store(spec)
+        node = Node(spec, None, checkpoint_store=checkpoint_store,
+                    _warm_store=store, **node_kwargs)
+        _SITE_RECOVER()
+        with timeline.span("node/recover", items=len(journal) - pos,
+                           checkpoint=pos):
+            # seed the journal with the covered prefix so the recovered
+            # node's history is the crashed node's, then replay the
+            # suffix through the engine-backed handlers (which append)
+            if node._journal is not None:
+                node._journal = journal[:pos]
+            _replay_journal(node, journal[pos:])
+        stats["checkpoint_recoveries"] += 1
+        if recorder.enabled():
+            recorder.record("checkpoint_restored", journal_pos=pos,
+                            suffix_items=len(journal) - pos,
+                            epoch=restored.meta["finalized"][0])
+        return node
+    return None
+
+
+def recover_node(spec, anchor_state, anchor_block=None, journal=(),
+                 checkpoint_store=None, **node_kwargs) -> Node:
+    """Crash recovery (ISSUE 13; checkpoint fast path ISSUE 14): rebuild
+    a ``Node`` whose store is byte-identical to the crashed one's.
+
+    With a ``checkpoint_store``, recovery first tries the durable fast
+    path: restore the newest valid checkpoint and replay only the
+    journal suffix after its recorded position — O(since-the-last-
+    epoch-fence) instead of O(history).  A truncated, bit-flipped,
+    stale-tagged, or foreign-journal artifact is detected at load,
+    quarantined, counted, flight-recorded (``store_corrupt``), and the
+    ladder moves on; exhausting every candidate falls back to the full
+    journal replay below — recovery never crashes on disk damage and
+    never serves a state the journal doesn't vouch for.
+
+    The full-replay path (PR 13) is unchanged: fresh node from the
+    anchor, the whole journal through the engine-backed handlers.
+    Either way the admission surface is PRESERVED (dead letters, peer
+    scores, quarantine outlive the crash; only the transient seen-keys
+    reset), the ``node.recover`` probe fires after construction and
+    before the replay, and ``node_recovered`` is emitted only once the
+    replay fully settles."""
     node_kwargs.setdefault("adopt_admission", False)
-    node = Node(spec, anchor_state, anchor_block, **node_kwargs)
     if node_kwargs.get("adopt_admission") is False:
         # clear the TRANSIENT surface only: seen-keys for items that
         # never applied (the in-flight item at the kill, pooled
         # orphans) must not judge the mesh's re-delivery a duplicate —
         # but dead letters, scores, and quarantine survive
         admission.reset_transient()
-    _SITE_RECOVER()
-    with timeline.span("node/recover", items=len(journal)):
-        for kind, payload in journal:
-            if kind == "tick":
-                node.on_tick(payload)
-            elif kind == "block":
-                node.on_block(payload)
-            elif kind == "attestations":
-                node.on_attestations(payload)
-            elif kind == "attester_slashing":
-                node.on_attester_slashing(payload)
-            else:
-                raise ValueError(f"unknown journal kind {kind!r}")
+    node = None
+    if checkpoint_store is not None:
+        node = _recover_from_checkpoint(spec, journal, checkpoint_store,
+                                        node_kwargs)
+        if node is None:
+            persist_store.stats["restore_fallbacks"] += 1
+    if node is None:
+        node = Node(spec, anchor_state, anchor_block,
+                    checkpoint_store=checkpoint_store, **node_kwargs)
+        _SITE_RECOVER()
+        with timeline.span("node/recover", items=len(journal)):
+            _replay_journal(node, journal)
     stats["recoveries"] += 1
     if recorder.enabled():
         recorder.record("node_recovered", items=len(journal))

@@ -161,6 +161,18 @@ def _hash_waves_on_device(waves: "List[List[BranchNode]]", wave_hasher) -> None:
             k += 1
 
 
+def branch_with_root(left: Node, right: Node, root: bytes) -> BranchNode:
+    """A ``BranchNode`` with its memoized root pre-installed — the
+    deserialization constructor (persist/store.py's tree codec rebuilds
+    checkpointed states from digest-verified artifacts; recomputing
+    every root would re-pay the full-tree hash the memo exists to skip).
+    Owner-side on purpose: installing ``_root`` anywhere else is a CC01
+    violation, because only a verified byte stream may vouch for it."""
+    node = BranchNode(left, right)
+    node._root = root
+    return node
+
+
 def get_subtree(node: Node, depth: int, index: int) -> Node:
     """Descend `depth` levels; bit k of `index` (MSB first) picks the child."""
     for k in range(depth - 1, -1, -1):

@@ -142,6 +142,8 @@ def dump(reason: str, path: Optional[str] = None,
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f, indent=2)
+        # durable-io: a human-readable post-mortem report, rewritten per
+        # dump — not an integrity-checked artifact (no digest by design)
         os.replace(tmp, path)
     return payload
 

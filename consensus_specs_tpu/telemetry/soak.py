@@ -228,6 +228,13 @@ def bounded_cache_sizes() -> List[dict]:
         samples.append({"name": f"stf.plan_cache.{key[:-5]}",
                         "size": plan.get(key, 0),
                         "cap": plan.get("geometry_cap", 0)})
+    # the durable checkpoint store (ISSUE 14): checkpoints on disk are a
+    # bounded ring like everything else — prune-on-finalization must
+    # hold the depth at its cap over any number of epochs
+    persist = providers.get("persist", {})
+    samples.append({"name": "persist.checkpoints",
+                    "size": persist.get("size", 0),
+                    "cap": persist.get("cap", 0)})
     return samples
 
 
@@ -398,6 +405,8 @@ def _write(report: dict) -> None:
     tmp = f"{path}.tmp"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=2, default=str)
+    # durable-io: SOAK.json is a human-readable run report, rewritten
+    # per soak — not an integrity-checked artifact (no digest by design)
     os.replace(tmp, path)
 
 

@@ -310,6 +310,8 @@ def dump_chrome_trace(path: Optional[str] = None) -> dict:
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f, indent=1)
+        # durable-io: a Chrome-trace JSON export for Perfetto, rewritten
+        # per dump — a viewer input, not an integrity-checked artifact
         os.replace(tmp, path)
     return payload
 

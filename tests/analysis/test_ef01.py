@@ -193,3 +193,30 @@ def test_ef01_admission_side_tables_are_observational():
            "    _SITE()\n"
            "    _SEEN[key] = True\n")
     assert ef01("consensus_specs_tpu/node/x.py", src) == []
+
+
+def test_ef01_persist_index_unrouted_insert_is_flagged():
+    # the durable checkpoint index (ISSUE 14) rides the same registry:
+    # an insert next to a probe without staging routing is gate-red
+    src = ("from consensus_specs_tpu import faults\n"
+           "from consensus_specs_tpu.stf import staging\n"
+           "_SITE = faults.site('persist.x.probe')\n"
+           "_INDEX = {}\n"
+           "def adopt(path, meta):\n"
+           "    _SITE()\n"
+           "    _INDEX[path] = meta\n")
+    found = ef01("consensus_specs_tpu/persist/x.py", src)
+    assert [f.line for f in found] == [7]
+    assert "_INDEX" in found[0].message
+
+
+def test_ef01_persist_index_routed_insert_is_clean():
+    src = ("from consensus_specs_tpu import faults\n"
+           "from consensus_specs_tpu.stf import staging\n"
+           "_SITE = faults.site('persist.x.probe')\n"
+           "_INDEX = {}\n"
+           "def adopt(path, meta):\n"
+           "    _SITE()\n"
+           "    _INDEX[path] = meta\n"
+           "    staging.note_insert(_INDEX, path)\n")
+    assert ef01("consensus_specs_tpu/persist/x.py", src) == []

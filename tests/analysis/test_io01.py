@@ -1,0 +1,103 @@
+"""IO01 durable-artifact IO discipline: raw ``os.replace``/``os.rename``
+promotions and binary open-for-write in production modules must route
+through ``persist/atomic.py`` or declare a ``# durable-io: <why>``
+boundary (ISSUE 14)."""
+from analysis import analyze_text
+
+
+def io01(path, src):
+    return [f for f in analyze_text(path, src) if f.code == "IO01"]
+
+
+def test_io01_flags_raw_os_replace():
+    src = ("import os\n"
+           "def promote(tmp, path):\n"
+           "    os.replace(tmp, path)\n")
+    found = io01("consensus_specs_tpu/stf/x.py", src)
+    assert [f.line for f in found] == [3]
+    assert "persist/atomic" in found[0].message
+
+
+def test_io01_flags_raw_os_rename():
+    src = ("import os\n"
+           "def promote(tmp, path):\n"
+           "    os.rename(tmp, path)\n")
+    found = io01("consensus_specs_tpu/crypto/x.py", src)
+    assert [f.line for f in found] == [3]
+
+
+def test_io01_flags_binary_open_for_write():
+    src = ("def save(path, table):\n"
+           "    with open(path, 'wb') as f:\n"
+           "        f.write(table)\n")
+    found = io01("consensus_specs_tpu/crypto/x.py", src)
+    assert [f.line for f in found] == [2]
+    assert "'wb'" in found[0].message
+
+
+def test_io01_flags_append_and_update_binary_modes():
+    src = ("def patch(path):\n"
+           "    a = open(path, 'ab')\n"
+           "    b = open(path, 'r+b')\n"
+           "    return a, b\n")
+    assert [f.line for f in io01("consensus_specs_tpu/node/x.py",
+                                 src)] == [2, 3]
+
+
+def test_io01_text_writes_and_binary_reads_are_legal():
+    # JSON reports (text mode) and artifact READS are not durable-write
+    # hazards; deletions are invalidations
+    src = ("import json, os\n"
+           "def report(path, payload):\n"
+           "    with open(path, 'w') as f:\n"
+           "        json.dump(payload, f)\n"
+           "    with open(path, 'rb') as f:\n"
+           "        raw = f.read()\n"
+           "    os.unlink(path)\n"
+           "    return raw\n")
+    assert io01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_io01_durable_io_annotation_sanctions_the_line():
+    src = ("import os\n"
+           "def promote(tmp, path):\n"
+           "    # durable-io: compiler output promoted whole\n"
+           "    os.replace(tmp, path)\n")
+    assert io01("consensus_specs_tpu/crypto/x.py", src) == []
+
+
+def test_io01_bare_annotation_does_not_sanction():
+    # the boundary needs a justification, exactly like host-sync
+    src = ("import os\n"
+           "def promote(tmp, path):\n"
+           "    os.replace(tmp, path)  # durable-io:\n")
+    assert [f.line for f in io01("consensus_specs_tpu/crypto/x.py",
+                                 src)] == [3]
+
+
+def test_io01_persist_and_tests_are_exempt():
+    src = ("import os\n"
+           "def promote(tmp, path):\n"
+           "    os.replace(tmp, path)\n")
+    assert io01("consensus_specs_tpu/persist/atomic.py", src) == []
+    assert io01("tests/test_x.py", src) == []
+    assert io01("tools/perf_doctor.py", src) == []
+
+
+def test_io01_computed_mode_is_not_guessed():
+    src = ("def save(path, mode, data):\n"
+           "    with open(path, mode) as f:\n"
+           "        f.write(data)\n")
+    assert io01("consensus_specs_tpu/stf/x.py", src) == []
+
+
+def test_io01_flags_binary_os_fdopen():
+    # the pre-migration MSM-table shape: mkstemp + fdopen(fd, "wb")
+    src = ("import os, tempfile\n"
+           "def save(path, table):\n"
+           "    fd, tmp = tempfile.mkstemp(dir='.')\n"
+           "    with os.fdopen(fd, 'wb') as f:\n"
+           "        f.write(table)\n")
+    found = io01("consensus_specs_tpu/crypto/x.py", src)
+    assert [f.line for f in found] == [4]
+    assert "fdopen" in found[0].message

@@ -180,6 +180,23 @@ def test_ob01_unclosed_span_mutation_turns_red(gate):
                for f in found), found
 
 
+def test_io01_mutation_turns_red(gate):
+    # a hand-rolled artifact promotion next to the real engine code
+    # (ISSUE 14): the torn-write discipline lives in persist/atomic.py
+    # ONLY — a bespoke os.replace outside it is gate-red
+    rel = "consensus_specs_tpu/stf/columns.py"
+    found = _mutated(gate, {rel: lambda t: t + (
+        "\n\nimport os\n"
+        "def _spill_column(tmp, path, col):\n"
+        "    with open(tmp, 'wb') as f:\n"
+        "        f.write(col.tobytes())\n"
+        "    os.replace(tmp, path)\n")})
+    assert any(f.code == "IO01" and "os.replace" in f.message
+               for f in found), found
+    assert any(f.code == "IO01" and "'wb'" in f.message
+               for f in found), found
+
+
 def test_cc01_cross_file_passthrough_mutation_turns_red(gate):
     # the call-graph-aware half of CC01: a helper in ANOTHER file passes
     # the registry-columns producer's cached dict through; mutating its
@@ -221,5 +238,5 @@ def test_dt01_cross_file_callsite_mutation_turns_red(gate):
 def test_registry_covers_every_mutation_code():
     # every rule family proven red above is a registered plugin
     for code in ("FC01", "DT01", "CC01", "RB01", "JX01", "ST01",
-                 "HD01", "SH01", "EF01", "OB01"):
+                 "HD01", "SH01", "EF01", "OB01", "IO01"):
         assert code in REGISTRY, code

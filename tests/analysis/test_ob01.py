@@ -202,3 +202,33 @@ def test_ob01_node_recovered_after_the_with_block_is_clean():
                      "        _SITE()\n"
                      "    telemetry.record('node_recovered', items=1)\n")
     assert ob01("consensus_specs_tpu/node/x.py", src) == []
+
+
+def test_ob01_checkpoint_written_inside_open_transaction_is_flagged():
+    # checkpoint_written asserts a durable artifact was atomically
+    # promoted (ISSUE 14): recorded before settlement, a fault would
+    # roll the block back with the timeline claiming bytes on disk
+    src = _HEADER + ("def write_ckpt(payload):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "        telemetry.record('checkpoint_written', n=1)\n")
+    found = ob01("consensus_specs_tpu/persist/x.py", src)
+    assert [f.line for f in found] == [8]
+
+
+def test_ob01_checkpoint_restored_inside_open_transaction_is_flagged():
+    src = _HEADER + ("def restore(path):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "        telemetry.record('checkpoint_restored', n=1)\n")
+    found = ob01("consensus_specs_tpu/persist/x.py", src)
+    assert [f.line for f in found] == [8]
+
+
+def test_ob01_checkpoint_events_after_the_with_block_are_clean():
+    src = _HEADER + ("def write_ckpt(payload):\n"
+                     "    with staging.block_transaction():\n"
+                     "        _SITE()\n"
+                     "    telemetry.record('checkpoint_written', n=1)\n"
+                     "    telemetry.record('store_corrupt', path='x')\n")
+    assert ob01("consensus_specs_tpu/persist/x.py", src) == []

@@ -1,0 +1,231 @@
+"""Durable-persistence chaos (ISSUE 14): injected failures at the four
+``persist.*`` seams must leave the disk consistent (no torn finals, no
+stray temp files), keep the apply loop serving, and degrade recovery
+down the ladder — damaged artifact -> older candidate -> full journal
+replay — with byte-identical head/root parity at every rung.
+
+``COVERED_SITES`` is closed over by test_registry_complete.py.
+"""
+import os
+
+import pytest
+
+from consensus_specs_tpu import faults
+from consensus_specs_tpu.node import firehose, recover_node, service
+from consensus_specs_tpu.persist import store as persist_store
+from consensus_specs_tpu.persist.store import CheckpointStore
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+F = faults.Fault
+
+COVERED_SITES = {"persist.write", "persist.replace", "persist.read",
+                 "persist.digest"}
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    from consensus_specs_tpu.crypto import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+_SCAFFOLD = {}
+
+
+def _scaffold():
+    """(spec, genesis_state, corpus): three epochs of full blocks — long
+    enough for several epoch-fence checkpoints — plus a little gossip."""
+    if not _SCAFFOLD:
+        from consensus_specs_tpu.specs.builder import get_spec
+
+        spec = get_spec("phase0", "minimal")
+        state = create_genesis_state(
+            spec, default_balances(spec), default_activation_threshold(spec))
+        corpus = firehose.build_corpus(
+            spec, state, n_epochs=3, gossip_target=120)
+        _SCAFFOLD["phase0"] = (spec, state, corpus)
+    return _SCAFFOLD["phase0"]
+
+
+def _serve(spec, state, corpus, ckpt_store):
+    """Run the whole corpus through a fresh node with a SYNCHRONOUS
+    checkpoint store (chaos needs the write to happen at a deterministic
+    point in the apply loop) on the caller's thread."""
+    service.reset_stats()
+    persist_store.reset_stats()
+    node = service.Node(spec, state, corpus.anchor_block,
+                        checkpoint_store=ckpt_store)
+    for signed in corpus.chain:
+        s = int(signed.message.slot)
+        node.enqueue_tick(int(state.genesis_time)
+                          + s * int(spec.config.SECONDS_PER_SLOT))
+        node.enqueue_block(signed)
+        for att in corpus.gossip.get(s - 1, ()):
+            node.enqueue_attestations([att])
+    last = int(corpus.chain[-1].message.slot)
+    node.enqueue_tick(int(state.genesis_time)
+                      + (last + 1) * int(spec.config.SECONDS_PER_SLOT))
+    node.queue.close()
+    node.run_apply_loop()
+    return node
+
+
+def _assert_clean_dir(path):
+    strays = [p for p in os.listdir(path) if p.endswith(".tmp")]
+    assert strays == [], f"stray temp files: {strays}"
+
+
+def _assert_recover_parity(spec, state, corpus, node, ckpt_store):
+    recovered = recover_node(spec, state, corpus.anchor_block, node.journal,
+                             checkpoint_store=ckpt_store)
+    head = bytes(node.get_head())
+    assert bytes(recovered.get_head()) == head
+    assert bytes(recovered.store.block_states[head].hash_tree_root()) == \
+        bytes(node.store.block_states[head].hash_tree_root())
+    assert dict(recovered.store.latest_messages) == \
+        dict(node.store.latest_messages)
+    assert recovered.store.finalized_checkpoint == \
+        node.store.finalized_checkpoint
+    return recovered
+
+
+def test_write_fault_mid_checkpoint_no_torn_finals(tmp_path):
+    """``persist.write`` dying on the SECOND checkpoint: the loop keeps
+    serving (failure counted, never raised into the drain), the first
+    checkpoint's final file is intact, no temp files leak, and recovery
+    succeeds off the surviving artifact with full parity."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    plan = faults.FaultPlan([F("persist.write", nth=2)])
+    with faults.inject(plan):
+        node = _serve(spec, state, corpus, store)
+    assert ("persist.write", 2, "error") in plan.fired
+    assert persist_store.stats["write_failures"] == 1
+    assert service.stats["checkpoint_gather_failures"] == 1
+    # serving never halted: the whole chain applied
+    assert service.stats["blocks_applied"] == len(corpus.chain)
+    _assert_clean_dir(str(tmp_path))
+    # the surviving finals all verify (none torn by the dying writer)
+    survivors = store.candidates()
+    assert len(survivors) >= 1
+    for path in survivors:
+        store.restore(spec, path)
+    assert persist_store.stats["corruptions"] == 0
+    rec = _assert_recover_parity(spec, state, corpus, node, store)
+    assert service.stats["checkpoint_recoveries"] == 1
+    assert rec is not None
+
+
+def test_kill_between_write_and_replace_recovers_off_previous(tmp_path):
+    """Kill-mid-write (``persist.replace`` crash: the temp was fully
+    written, the atomic promotion never ran): the final path must keep
+    its previous content, the temp must not leak, and ``recover_node``
+    succeeds off the PREVIOUS checkpoint — the longer journal suffix
+    replays to the same bytes."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    plan = faults.FaultPlan([F("persist.replace", nth=2, kind="crash",
+                               sticky=True)])
+    with faults.inject(plan):
+        node = _serve(spec, state, corpus, store)
+    assert any(site == "persist.replace" for site, _n, _k in plan.fired)
+    assert persist_store.stats["checkpoints_written"] == 1
+    assert persist_store.stats["write_failures"] >= 1
+    _assert_clean_dir(str(tmp_path))
+    assert len(store.candidates()) == 1
+    before = store.candidates()[0]
+    rec = _assert_recover_parity(spec, state, corpus, node, store)
+    assert service.stats["checkpoint_recoveries"] == 1
+    # the recovered node resumed off the EARLY checkpoint: its journal
+    # still equals the crashed node's full history
+    assert rec.journal == node.journal
+    assert store.candidates()[0] == before
+
+
+def test_read_corruption_degrades_to_journal_replay_with_parity(tmp_path):
+    """Sticky ``persist.read`` corruption (every candidate's bytes come
+    back bit-flipped — the whole directory rotted): every artifact is
+    detected, counted, flight-recorded, quarantined, and recovery falls
+    all the way back to the full journal replay — parity held, no
+    crash."""
+    from consensus_specs_tpu.telemetry import recorder
+
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node = _serve(spec, state, corpus, store)
+    n_finals = len(store.candidates())
+    assert n_finals >= 2
+
+    was_recording = recorder.enabled()
+    recorder.reset()
+    recorder.enable()
+    plan = faults.FaultPlan([F("persist.read", nth=1, kind="corrupt",
+                               sticky=True)])
+    try:
+        with faults.inject(plan):
+            recovered = recover_node(spec, state, corpus.anchor_block,
+                                     node.journal, checkpoint_store=store)
+    finally:
+        if not was_recording:
+            recorder.disable()
+    assert any(site == "persist.read" for site, _n, _k in plan.fired)
+    # every candidate walked the ladder: corrupt -> quarantined
+    assert persist_store.stats["corruptions"] == n_finals
+    assert persist_store.stats["restore_fallbacks"] == 1
+    assert service.stats["checkpoint_recoveries"] == 0
+    assert store.candidates() == []  # index invalidated
+    quarantined = [p for p in os.listdir(tmp_path)
+                   if p.endswith(".corrupt")]
+    assert len(quarantined) == n_finals
+    events = [e for e in recorder.timeline() if e["kind"] == "store_corrupt"]
+    assert len(events) == n_finals
+    head = bytes(node.get_head())
+    assert bytes(recovered.get_head()) == head
+    assert bytes(recovered.store.block_states[head].hash_tree_root()) == \
+        bytes(node.store.block_states[head].hash_tree_root())
+    assert dict(recovered.store.latest_messages) == \
+        dict(node.store.latest_messages)
+
+
+def test_digest_machinery_dying_is_one_more_rung(tmp_path):
+    """``persist.digest`` raising (the verification machinery itself
+    dying mid-check, not the data being wrong) must read as corruption:
+    quarantine, count, move to the next candidate — the first healthy
+    probe (the fault fires once) restores normally."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node = _serve(spec, state, corpus, store)
+    assert len(store.candidates()) >= 2
+    plan = faults.FaultPlan([F("persist.digest", nth=1)])
+    with faults.inject(plan):
+        recovered = recover_node(spec, state, corpus.anchor_block,
+                                 node.journal, checkpoint_store=store)
+    assert ("persist.digest", 1, "error") in plan.fired
+    assert persist_store.stats["corruptions"] == 1
+    assert service.stats["checkpoint_recoveries"] == 1
+    head = bytes(node.get_head())
+    assert bytes(recovered.get_head()) == head
+    assert dict(recovered.store.latest_messages) == \
+        dict(node.store.latest_messages)
+
+
+def test_checkpoint_recovery_under_fault_free_plan_is_exact(tmp_path):
+    """Control case: with the sites armed but never firing (nth beyond
+    every hit), the checkpoint fast path restores and the full journal
+    history is reproduced — the chaos harness itself perturbs nothing."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node = _serve(spec, state, corpus, store)
+    plan = faults.FaultPlan([F("persist.read", nth=10_000)])
+    with faults.inject(plan):
+        rec = _assert_recover_parity(spec, state, corpus, node, store)
+    assert service.stats["checkpoint_recoveries"] == 1
+    assert rec.journal == node.journal
+    assert persist_store.stats["corruptions"] == 0

@@ -16,7 +16,12 @@ import consensus_specs_tpu.forkchoice.engine  # noqa: F401
 import consensus_specs_tpu.node.service  # noqa: F401  (registers ingest's too)
 import consensus_specs_tpu.stf.engine  # noqa: F401
 
-from . import test_forkchoice_chaos, test_node_chaos, test_stf_chaos
+from . import (
+    test_forkchoice_chaos,
+    test_node_chaos,
+    test_persist_chaos,
+    test_stf_chaos,
+)
 
 
 def _production_sites():
@@ -29,7 +34,8 @@ def test_every_site_has_a_chaos_case():
     registered = _production_sites()
     covered = (set(test_stf_chaos.COVERED_SITES)
                | set(test_forkchoice_chaos.COVERED_SITES)
-               | set(test_node_chaos.COVERED_SITES))
+               | set(test_node_chaos.COVERED_SITES)
+               | set(test_persist_chaos.COVERED_SITES))
     missing = registered - covered
     assert not missing, (
         f"fault sites with no chaos case: {sorted(missing)} — add a case to "
@@ -61,6 +67,19 @@ def test_node_survival_sites_are_registered_and_covered():
     assert expected <= node_sites, sorted(expected - node_sites)
     assert node_sites <= set(test_node_chaos.COVERED_SITES), \
         sorted(node_sites - set(test_node_chaos.COVERED_SITES))
+
+
+def test_persist_sites_are_registered_and_covered():
+    """ISSUE 14: the durable-IO seams exist AND each carries a chaos
+    case — an uncovered persist site turns this red independently of the
+    generic completeness sweep above."""
+    expected = {"persist.write", "persist.replace", "persist.read",
+                "persist.digest"}
+    persist_sites = {n for n in _production_sites()
+                     if n.startswith("persist.")}
+    assert expected <= persist_sites, sorted(expected - persist_sites)
+    assert persist_sites <= set(test_persist_chaos.COVERED_SITES), \
+        sorted(persist_sites - set(test_persist_chaos.COVERED_SITES))
 
 
 def test_site_names_are_unique_and_dotted():

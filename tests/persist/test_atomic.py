@@ -1,0 +1,122 @@
+"""The atomic-artifact layer (ISSUE 14): torn-write-safe promotion,
+digest verification, kind/format-tag staleness, and the quarantine
+helper — the discipline every durable byte in the tree now rides."""
+import os
+
+import pytest
+
+from consensus_specs_tpu.persist import atomic
+
+
+def test_roundtrip_and_size(tmp_path):
+    path = str(tmp_path / "a.bin")
+    payload = os.urandom(4096)
+    size = atomic.write_artifact(path, payload, "test-kind", "v1")
+    assert os.path.getsize(path) == size
+    assert atomic.read_artifact(path, "test-kind", "v1") == payload
+
+
+def test_empty_payload_roundtrips(tmp_path):
+    path = str(tmp_path / "a.bin")
+    atomic.write_artifact(path, b"", "k")
+    assert atomic.read_artifact(path, "k") == b""
+
+
+def test_missing_file_is_a_plain_miss(tmp_path):
+    with pytest.raises(atomic.ArtifactMissing):
+        atomic.read_artifact(str(tmp_path / "nope.bin"), "k")
+
+
+def test_truncation_is_corrupt(tmp_path):
+    path = str(tmp_path / "a.bin")
+    atomic.write_artifact(path, os.urandom(512), "k")
+    data = open(path, "rb").read()
+    for cut in (0, 3, len(data) // 2, len(data) - 1):
+        with open(path, "wb") as f:
+            f.write(data[:cut])
+        with pytest.raises(atomic.ArtifactCorrupt):
+            atomic.read_artifact(path, "k")
+
+
+def test_any_flipped_byte_is_corrupt(tmp_path):
+    """Header, payload, or the digest itself: one flipped bit anywhere
+    fails verification — never garbage handed to the consumer."""
+    path = str(tmp_path / "a.bin")
+    atomic.write_artifact(path, os.urandom(256), "k", "t")
+    data = open(path, "rb").read()
+    for pos in (0, 5, len(data) // 2, len(data) - 1):
+        with open(path, "wb") as f:
+            f.write(data[:pos] + bytes([data[pos] ^ 0x40]) + data[pos + 1:])
+        with pytest.raises(atomic.ArtifactError):
+            atomic.read_artifact(path, "k", "t")
+    with open(path, "wb") as f:
+        f.write(data)  # pristine again
+    atomic.read_artifact(path, "k", "t")
+
+
+def test_wrong_kind_or_tag_is_stale_not_corrupt(tmp_path):
+    path = str(tmp_path / "a.bin")
+    atomic.write_artifact(path, b"payload", "kind-a", "tag-1")
+    with pytest.raises(atomic.ArtifactStaleTag):
+        atomic.read_artifact(path, "kind-b", "tag-1")
+    with pytest.raises(atomic.ArtifactStaleTag):
+        atomic.read_artifact(path, "kind-a", "tag-2")
+
+
+def test_format_version_bump_is_stale(tmp_path, monkeypatch):
+    path = str(tmp_path / "a.bin")
+    atomic.write_artifact(path, b"payload", "k")
+    monkeypatch.setattr(atomic, "FORMAT_VERSION", atomic.FORMAT_VERSION + 1)
+    with pytest.raises(atomic.ArtifactStaleTag):
+        atomic.read_artifact(path, "k")
+
+
+def test_expected_payload_len_structural_check(tmp_path):
+    path = str(tmp_path / "a.bin")
+    atomic.write_artifact(path, b"x" * 100, "k")
+    assert atomic.read_artifact(path, "k",
+                                expected_payload_len=100) == b"x" * 100
+    with pytest.raises(atomic.ArtifactCorrupt):
+        atomic.read_artifact(path, "k", expected_payload_len=99)
+
+
+def test_overwrite_promotes_atomically_no_strays(tmp_path):
+    path = str(tmp_path / "a.bin")
+    atomic.write_artifact(path, b"one", "k")
+    atomic.write_artifact(path, b"two", "k")
+    assert atomic.read_artifact(path, "k") == b"two"
+    assert [p for p in os.listdir(tmp_path) if p.endswith(".tmp")] == []
+
+
+def test_failed_write_leaves_previous_artifact_and_no_temp(tmp_path):
+    from consensus_specs_tpu import faults
+
+    path = str(tmp_path / "a.bin")
+    atomic.write_artifact(path, b"good", "k")
+    plan = faults.FaultPlan([faults.Fault("persist.replace", nth=1)])
+    with faults.inject(plan):
+        with pytest.raises(faults.InjectedFault):
+            atomic.write_artifact(path, b"torn", "k")
+    assert atomic.read_artifact(path, "k") == b"good"
+    assert [p for p in os.listdir(tmp_path) if p.endswith(".tmp")] == []
+
+
+def test_quarantine_moves_the_evidence_aside(tmp_path):
+    path = str(tmp_path / "a.bin")
+    atomic.write_artifact(path, b"damaged-later", "k")
+    dest = atomic.quarantine(path)
+    assert dest == path + ".corrupt"
+    assert not os.path.exists(path)
+    assert os.path.exists(dest)
+    assert atomic.quarantine(str(tmp_path / "gone.bin")) is None
+
+
+def test_verify_buffer_accepts_mmap(tmp_path):
+    import mmap
+
+    path = str(tmp_path / "a.bin")
+    payload = os.urandom(8192)
+    atomic.write_artifact(path, payload, "k", "t")
+    with open(path, "rb") as f:
+        with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
+            assert atomic.verify_buffer(path, mm, "k", "t") == payload

@@ -1,0 +1,224 @@
+"""Checkpoint-fast node recovery (ISSUE 14 acceptance, tier-1 scale):
+the fast path restores byte-identically; truncated, bit-flipped, and
+stale-ABI-tag artifacts are each detected at load, never crash the node
+or taint a served state (parity held via the fallback ladder), and are
+visible on the telemetry bus and in the flight recorder."""
+import os
+
+import pytest
+
+from consensus_specs_tpu import telemetry
+from consensus_specs_tpu.node import firehose, recover_node, service
+from consensus_specs_tpu.persist import store as persist_store
+from consensus_specs_tpu.persist.store import CheckpointStore
+from consensus_specs_tpu.telemetry import recorder
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    from consensus_specs_tpu.crypto import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+_SCAFFOLD = {}
+
+
+def _scaffold():
+    if not _SCAFFOLD:
+        from consensus_specs_tpu.specs.builder import get_spec
+
+        spec = get_spec("phase0", "minimal")
+        state = create_genesis_state(
+            spec, default_balances(spec), default_activation_threshold(spec))
+        corpus = firehose.build_corpus(
+            spec, state, n_epochs=2, gossip_target=100)
+        _SCAFFOLD["phase0"] = (spec, state, corpus)
+    return _SCAFFOLD["phase0"]
+
+
+def _serve(spec, state, corpus, store, max_items=None):
+    service.reset_stats()
+    persist_store.reset_stats()
+    node = service.Node(spec, state, corpus.anchor_block,
+                        checkpoint_store=store)
+    for signed in corpus.chain:
+        s = int(signed.message.slot)
+        node.enqueue_tick(int(state.genesis_time)
+                          + s * int(spec.config.SECONDS_PER_SLOT))
+        node.enqueue_block(signed)
+        for att in corpus.gossip.get(s - 1, ()):
+            node.enqueue_attestations([att])
+    last = int(corpus.chain[-1].message.slot)
+    node.enqueue_tick(int(state.genesis_time)
+                      + (last + 1) * int(spec.config.SECONDS_PER_SLOT))
+    node.queue.close()
+    node.run_apply_loop(max_items=max_items)
+    return node
+
+
+def _assert_byte_identical(node, recovered):
+    head = bytes(node.get_head())
+    assert bytes(recovered.get_head()) == head
+    assert bytes(recovered.store.block_states[head].hash_tree_root()) == \
+        bytes(node.store.block_states[head].hash_tree_root())
+    assert recovered.store.justified_checkpoint == \
+        node.store.justified_checkpoint
+    assert recovered.store.finalized_checkpoint == \
+        node.store.finalized_checkpoint
+    assert dict(recovered.store.latest_messages) == \
+        dict(node.store.latest_messages)
+
+
+def test_checkpoint_fast_path_is_byte_identical_and_literal_true(tmp_path):
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node = _serve(spec, state, corpus, store)
+    assert persist_store.stats["checkpoints_written"] >= 1
+    recovered = recover_node(spec, state, corpus.anchor_block, node.journal,
+                             checkpoint_store=store)
+    assert service.stats["checkpoint_recoveries"] == 1
+    assert persist_store.stats["restore_fallbacks"] == 0
+    _assert_byte_identical(node, recovered)
+    # the recovered node's journal is the crashed node's full history,
+    # so the literal spec replays it to the same world
+    assert recovered.journal == node.journal
+    ref = firehose.replay_journal_literal(
+        spec, state, corpus.anchor_block, recovered._journal)
+    firehose.assert_parity(spec, recovered, ref)
+
+
+def test_kill_mid_serve_recovers_from_checkpoint_plus_suffix(tmp_path):
+    """The crash drill: stop the loop mid-stream (max_items), recover
+    off the newest checkpoint + the journal suffix, resume serving the
+    remaining backlog on the recovered node, and end byte-identical to
+    an uninterrupted literal replay."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    crashed = _serve(spec, state, corpus, store, max_items=60)
+    if persist_store.stats["checkpoints_written"] == 0:
+        pytest.skip("no epoch fence before the kill at this scale")
+    journal = crashed.journal
+    recovered = recover_node(spec, state, corpus.anchor_block, journal,
+                             checkpoint_store=store)
+    assert service.stats["checkpoint_recoveries"] == 1
+    _assert_byte_identical(crashed, recovered)
+    # drain the backlog the crashed node never applied
+    while True:
+        item = crashed.queue.get(timeout=0.1)
+        if item is None:
+            break
+        recovered.queue.put(item.kind, item.payload)
+    recovered.queue.close()
+    recovered.run_apply_loop()
+    ref = firehose.replay_journal_literal(
+        spec, state, corpus.anchor_block, recovered._journal)
+    firehose.assert_parity(spec, recovered, ref)
+
+
+@pytest.mark.parametrize("damage", ["truncated", "bit_flipped", "stale_tag"])
+def test_damaged_artifacts_degrade_and_are_visible(tmp_path, damage,
+                                                   monkeypatch):
+    """Each corruption shape on EVERY artifact: detected at load, never
+    a crash, never a wrong state (full-replay fallback parity), and
+    visible on the bus + in the flight recorder."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node = _serve(spec, state, corpus, store)
+    paths = store.candidates()
+    assert paths
+    if damage == "stale_tag":
+        monkeypatch.setattr(persist_store, "FORMAT_TAG", "ckpt-v999")
+    else:
+        for path in paths:
+            data = open(path, "rb").read()
+            with open(path, "wb") as f:
+                if damage == "truncated":
+                    f.write(data[: len(data) // 3])
+                else:
+                    f.write(data[:64] + bytes([data[64] ^ 0x01])
+                            + data[65:])
+    was_recording = recorder.enabled()
+    recorder.reset()
+    recorder.enable()
+    try:
+        recovered = recover_node(spec, state, corpus.anchor_block,
+                                 node.journal, checkpoint_store=store)
+    finally:
+        if not was_recording:
+            recorder.disable()
+    # fell back to the full journal replay, parity held
+    assert service.stats["checkpoint_recoveries"] == 0
+    assert persist_store.stats["restore_fallbacks"] == 1
+    _assert_byte_identical(node, recovered)
+    # visible on the bus...
+    snap = telemetry.snapshot()["providers"]["persist"]
+    if damage == "stale_tag":
+        assert snap["stale_artifacts"] == len(paths)
+    else:
+        assert snap["corruptions"] == len(paths)
+    # ...and in the flight recorder, with the evidence quarantined
+    events = [e for e in recorder.timeline() if e["kind"] == "store_corrupt"]
+    assert len(events) == len(paths)
+    reasons = {e["reason"] for e in events}
+    assert reasons == ({"stale_tag"} if damage == "stale_tag"
+                       else {"corrupt"})
+    assert len([p for p in os.listdir(tmp_path)
+                if p.endswith(".corrupt")]) == len(paths)
+
+
+def test_foreign_journal_checkpoint_is_a_stale_miss(tmp_path):
+    """An intact checkpoint directory from a DIFFERENT run must not
+    splice onto this journal: the trigger-token check degrades it to a
+    miss and recovery replays the true history."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node = _serve(spec, state, corpus, store)
+    # a "foreign" journal: same length, different content ordering —
+    # drop the first gossip batch and pad with a duplicate tick
+    journal = node.journal
+    foreign = [e for e in journal if e[0] != "attestations"]
+    recovered = recover_node(spec, state, corpus.anchor_block, foreign,
+                             checkpoint_store=store)
+    assert service.stats["checkpoint_recoveries"] == 0
+    assert persist_store.stats["restore_fallbacks"] == 1
+    assert persist_store.stats["stale_artifacts"] >= 1
+    assert persist_store.stats["corruptions"] == 0  # nothing quarantined
+    assert store.candidates()  # the artifacts survive for THEIR journal
+
+
+def test_same_slot_schedule_foreign_run_is_a_stale_miss(tmp_path):
+    """The dangerous foreign-directory case: a checkpoint directory
+    reused across runs on the SAME slot schedule (identical tick times)
+    whose journals differ only in gossip density.  Trigger tokens alone
+    would collide on a tick fence; the recorded last-block anchor pins
+    (position, root) content and degrades the foreign checkpoint to a
+    stale miss — recovery then honestly replays THIS journal in full."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node_a = _serve(spec, state, corpus, store)
+    assert persist_store.stats["checkpoints_written"] >= 1
+    # run B: same anchor, same chain, same ticks — different gossip
+    # density, so every journal position shifts (a VALID history the
+    # fallback can replay, unlike run A's checkpoints' view of it)
+    corpus_b = firehose.build_corpus(spec, state, n_epochs=2,
+                                     gossip_target=40)
+    node_b = _serve(spec, state, corpus_b, None)
+    assert len(node_b.journal) != len(node_a.journal)
+    service.reset_stats()
+    persist_store.reset_stats()
+    recovered = recover_node(spec, state, corpus_b.anchor_block,
+                             node_b.journal, checkpoint_store=store)
+    assert service.stats["checkpoint_recoveries"] == 0
+    assert persist_store.stats["restore_fallbacks"] == 1
+    assert persist_store.stats["stale_artifacts"] >= 1
+    assert persist_store.stats["corruptions"] == 0
+    _assert_byte_identical(node_b, recovered)

@@ -1,0 +1,294 @@
+"""The checkpoint store (ISSUE 14): tree-codec fidelity, window
+serialization, the bounded on-disk ring, the restore ladder, and the
+async writer."""
+import os
+
+import pytest
+
+from consensus_specs_tpu.persist import store as persist_store
+from consensus_specs_tpu.persist.store import (
+    CheckpointError,
+    CheckpointStore,
+    decode_tree,
+    deserialize_checkpoint,
+    encode_tree,
+    serialize_checkpoint,
+)
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+_CACHE = {}
+
+
+def _spec_and_state():
+    if not _CACHE:
+        from consensus_specs_tpu.specs.builder import get_spec
+
+        spec = get_spec("phase0", "minimal")
+        state = create_genesis_state(
+            spec, default_balances(spec), default_activation_threshold(spec))
+        _CACHE["x"] = (spec, state)
+    return _CACHE["x"]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    persist_store.reset_stats()
+    yield
+
+
+def _roundtrip_tree(spec, view, typ):
+    out = bytearray()
+    index = {}
+    view.hash_tree_root()
+    encode_tree(view.get_backing(), out, index)
+    nodes = []
+    rebuilt, off = decode_tree(bytes(out), 0, nodes)
+    assert off == len(out)
+    return typ.view_from_backing(rebuilt)
+
+
+# -- tree codec ---------------------------------------------------------------
+
+
+def test_codec_roundtrips_a_genesis_state():
+    spec, state = _spec_and_state()
+    rebuilt = _roundtrip_tree(spec, state, spec.BeaconState)
+    assert bytes(rebuilt.hash_tree_root()) == bytes(state.hash_tree_root())
+    # roots install from the stream: the rebuilt tree is pre-memoized
+    assert rebuilt.get_backing()._root is not None
+    # and a deep field read agrees byte-for-byte
+    assert bytes(rebuilt.validators[3].pubkey) == \
+        bytes(state.validators[3].pubkey)
+    assert int(rebuilt.balances[7]) == int(state.balances[7])
+
+
+def test_codec_roundtrips_a_mutated_state():
+    spec, state = _spec_and_state()
+    st = state.copy()
+    st.slot = 17
+    st.balances[0] = 123456789
+    st.genesis_validators_root = b"\x42" * 32
+    rebuilt = _roundtrip_tree(spec, st, spec.BeaconState)
+    assert bytes(rebuilt.hash_tree_root()) == bytes(st.hash_tree_root())
+    assert int(rebuilt.balances[0]) == 123456789
+
+
+def test_codec_leaf_content_equal_to_a_subtree_root_does_not_alias():
+    """The genesis_validators_root LEAF stores the registry subtree's
+    digest as CONTENT — shape-aware dedup must keep them distinct (the
+    bug the (is_leaf, root) key exists for)."""
+    spec, state = _spec_and_state()
+    st = state.copy()
+    st.genesis_validators_root = st.validators.hash_tree_root()
+    rebuilt = _roundtrip_tree(spec, st, spec.BeaconState)
+    assert bytes(rebuilt.genesis_validators_root) == \
+        bytes(st.validators.hash_tree_root())
+    assert bytes(rebuilt.hash_tree_root()) == bytes(st.hash_tree_root())
+
+
+def test_codec_dedups_shared_subtrees_across_states():
+    """Two consecutive states share almost everything: the second tree's
+    marginal encoding must be a small fraction of the first's."""
+    spec, state = _spec_and_state()
+    st2 = state.copy()
+    st2.slot = int(state.slot) + 1
+    out1, index = bytearray(), {}
+    state.hash_tree_root()
+    st2.hash_tree_root()
+    encode_tree(state.get_backing(), out1, index)
+    first = len(out1)
+    encode_tree(st2.get_backing(), out1, index)
+    marginal = len(out1) - first
+    assert marginal < first // 4, (first, marginal)
+    nodes = []
+    a, off = decode_tree(bytes(out1), 0, nodes)
+    b, off = decode_tree(bytes(out1), off, nodes)
+    assert bytes(spec.BeaconState.view_from_backing(a).hash_tree_root()) \
+        == bytes(state.hash_tree_root())
+    assert bytes(spec.BeaconState.view_from_backing(b).hash_tree_root()) \
+        == bytes(st2.hash_tree_root())
+
+
+def test_codec_rejects_unknown_tags_and_forward_refs():
+    with pytest.raises(CheckpointError):
+        decode_tree(bytes([0x7F]), 0, [])
+    with pytest.raises(CheckpointError):
+        # a REF to a node that was never emitted
+        decode_tree(bytes([0x05, 9, 0, 0, 0]), 0, [None] * 20)
+
+
+# -- checkpoint payload -------------------------------------------------------
+
+
+def _payload(spec, state, journal_pos=5):
+    from consensus_specs_tpu.node.service import default_anchor_block
+
+    anchor_block = default_anchor_block(spec, state)
+    state.hash_tree_root()
+    root = bytes(anchor_block.hash_tree_root())
+    lm = {spec.ValidatorIndex(2): spec.LatestMessage(
+        epoch=spec.Epoch(1), root=spec.Root(b"\x07" * 32))}
+    return persist_store.CheckpointPayload(
+        journal_pos=journal_pos, trigger=("tick", 1234),
+        time=int(state.genesis_time),
+        justified=(0, root), best_justified=(0, root), finalized=(0, root),
+        proposer_boost_root=b"\x00" * 32,
+        latest_messages=lm, equivocating=frozenset({11, 3}),
+        anchor_root=root,
+        window=((root, anchor_block, state),),
+        head_state_root=bytes(state.hash_tree_root()))
+
+
+def test_checkpoint_payload_roundtrip():
+    spec, state = _spec_and_state()
+    payload = _payload(spec, state)
+    restored = deserialize_checkpoint(spec, serialize_checkpoint(payload))
+    assert restored.journal_pos == 5
+    assert tuple(restored.trigger) == ("tick", 1234)
+    assert restored.meta["equivocating"] == [3, 11]
+    assert restored.anchor_root == payload.anchor_root
+    st = restored.states[payload.anchor_root]
+    assert bytes(st.hash_tree_root()) == bytes(state.hash_tree_root())
+    store = restored.as_store(spec)
+    assert dict(store.latest_messages) == dict(payload.latest_messages)
+    assert store.equivocating_indices == {3, 11}
+
+
+def test_checkpoint_block_state_pairing_is_cross_checked():
+    spec, state = _spec_and_state()
+    payload = _payload(spec, state)
+    raw = bytearray(serialize_checkpoint(payload))
+    # damage one byte of the tree stream (the artifact digest normally
+    # catches this first; the codec's own cross-checks are the backstop)
+    raw[-5] ^= 0xFF
+    with pytest.raises(CheckpointError):
+        deserialize_checkpoint(spec, bytes(raw))
+
+
+# -- the store ----------------------------------------------------------------
+
+
+def test_store_write_prune_and_scan_adopt(tmp_path):
+    spec, state = _spec_and_state()
+    store = CheckpointStore(str(tmp_path), cap=2, asynchronous=False)
+    for pos in (10, 20, 30):
+        store.write_checkpoint(spec, _payload(spec, state, journal_pos=pos))
+    assert store.depth() == 2  # pruned past the cap
+    assert persist_store.stats["pruned"] == 1
+    positions = sorted(m["journal_pos"] for m in store.entries().values())
+    assert positions == [20, 30]
+    assert store.bytes_on_disk() > 0
+    # a fresh store instance adopts the survivors from disk
+    persist_store.reset_index()
+    again = CheckpointStore(str(tmp_path), cap=2, asynchronous=False)
+    assert sorted(m["journal_pos"]
+                  for m in again.entries().values()) == [20, 30]
+    restored = again.restore(spec, again.candidates()[0])
+    assert restored.journal_pos == 30
+
+
+def test_store_restore_ladder_quarantines_damage(tmp_path):
+    spec, state = _spec_and_state()
+    store = CheckpointStore(str(tmp_path), cap=3, asynchronous=False)
+    store.write_checkpoint(spec, _payload(spec, state, journal_pos=7))
+    path = store.candidates()[0]
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 2])  # truncation
+    with pytest.raises(CheckpointError):
+        store.restore(spec, path)
+    assert persist_store.stats["corruptions"] == 1
+    assert store.candidates() == []
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_store_stale_format_tag_walks_the_ladder(tmp_path, monkeypatch):
+    spec, state = _spec_and_state()
+    store = CheckpointStore(str(tmp_path), cap=3, asynchronous=False)
+    store.write_checkpoint(spec, _payload(spec, state, journal_pos=7))
+    monkeypatch.setattr(persist_store, "FORMAT_TAG", "ckpt-v999")
+    with pytest.raises(CheckpointError):
+        store.restore(spec, store.candidates()[0])
+    assert persist_store.stats["stale_artifacts"] == 1
+    assert persist_store.stats["corruptions"] == 0
+
+
+def test_store_async_writer_flush_and_newest_wins(tmp_path):
+    spec, state = _spec_and_state()
+    store = CheckpointStore(str(tmp_path), cap=5, asynchronous=True)
+    try:
+        for pos in (10, 20):
+            store.submit(spec, _payload(spec, state, journal_pos=pos))
+        assert store.flush(timeout=30.0)
+        # at least the newest landed (an earlier pending may be
+        # superseded before its write starts: newest-wins by design)
+        positions = {m["journal_pos"] for m in store.entries().values()}
+        assert 20 in positions
+        assert persist_store.stats["write_failures"] == 0
+    finally:
+        store.close()
+    with pytest.raises(RuntimeError):
+        store.submit(spec, _payload(spec, state, journal_pos=30))
+
+
+def test_telemetry_provider_reports_the_store():
+    from consensus_specs_tpu import telemetry
+
+    snap = telemetry.snapshot()["providers"]["persist"]
+    for key in ("checkpoints_written", "checkpoints_restored",
+                "corruptions", "stale_artifacts", "restore_fallbacks",
+                "pruned", "size", "cap", "bytes_on_disk"):
+        assert key in snap, key
+
+
+def test_store_missing_candidate_is_a_miss_not_corruption(tmp_path):
+    spec, state = _spec_and_state()
+    store = CheckpointStore(str(tmp_path), cap=3, asynchronous=False)
+    store.write_checkpoint(spec, _payload(spec, state, journal_pos=7))
+    path = store.candidates()[0]
+    os.unlink(path)  # out-of-band cleanup between candidates() and restore()
+    with pytest.raises(CheckpointError):
+        store.restore(spec, path)
+    assert persist_store.stats["corruptions"] == 0
+    assert persist_store.stats["stale_artifacts"] == 0
+    assert store.candidates() == []  # index entry dropped
+    assert not os.path.exists(path + ".corrupt")
+
+
+def test_async_writer_insert_survives_a_foreign_block_rollback(tmp_path):
+    """The writer thread must never record its index insert in another
+    thread's open block transaction: a routine block rollback would then
+    delete the entry of a checkpoint that IS durably on disk."""
+    import threading
+
+    from consensus_specs_tpu.stf import staging
+
+    spec, state = _spec_and_state()
+    store = CheckpointStore(str(tmp_path), cap=3, asynchronous=True)
+    try:
+        txn = staging.begin_block()  # the apply thread is mid-block
+        try:
+            done = threading.Event()
+
+            def _writer():
+                store.submit(spec, _payload(spec, state, journal_pos=9))
+                store.flush(timeout=30.0)
+                done.set()
+
+            t = threading.Thread(target=_writer)
+            t.start()
+            t.join(timeout=30.0)
+            assert done.is_set()
+            assert store.depth() == 1
+        finally:
+            staging.rollback_block(txn)  # the block fails — routine
+        # the durable checkpoint's index entry survives the rollback
+        assert store.depth() == 1
+        assert [m["journal_pos"]
+                for m in store.entries().values()] == [9]
+    finally:
+        store.close()

@@ -139,6 +139,19 @@ def test_counters_quarantined_items_block():
     assert bench.check_counter_invariants(_e2e_row()) is None
 
 
+def test_counters_store_corruptions_block():
+    # ISSUE 14: a corrupt checkpoint artifact in a fault-free bench run
+    # means the write path tore or the codec drifted — the degradation
+    # ladder absorbs it silently, so the counter gate must not
+    msg = bench.check_counter_invariants(_e2e_row(store_corruptions=2))
+    assert msg is not None and "2 corrupt checkpoint" in msg
+    msg = bench.check_counter_invariants(_e2e_row(restore_fallbacks=1))
+    assert msg is not None and "full journal replay" in msg
+    # zero counters (the healthy recovery row) stay silent
+    assert bench.check_counter_invariants(
+        _e2e_row(store_corruptions=0, restore_fallbacks=0)) is None
+
+
 def test_counters_hit_rate_floor_breach_blocks():
     # the exit-4 path the driver sees: a keying regression zeroes the
     # plan hit ratio while wall-time may still look fine

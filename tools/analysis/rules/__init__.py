@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     engine_rules,
     host_sync,
     hygiene,
+    io_safety,
     jit_purity,
     key_coverage,
     observability,

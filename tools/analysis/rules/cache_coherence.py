@@ -222,6 +222,20 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         invalidators=frozenset({"reset_state"}),
         observational=True,
     ),
+    # the durable checkpoint store's in-memory index (ISSUE 14): path ->
+    # {journal_pos, bytes} over the artifacts on disk.  Inserts happen
+    # only through the owner's ``_index_put`` (riding the cache
+    # transaction via staging.note_insert); quarantining a corrupt entry
+    # and pruning past the cap are the registered invalidations — an
+    # outside insert could offer recovery a path the write discipline
+    # never blessed
+    CacheSpec(
+        name="persist checkpoint index",
+        owner=("persist",),
+        module="consensus_specs_tpu.persist.store",
+        module_globals=frozenset({"_INDEX"}),
+        invalidators=frozenset({"reset_index"}),
+    ),
     # telemetry-owned structures (ISSUE 9): the provider registry and the
     # flight-recorder ring are mutated only through their owner module's
     # API (register_provider / record) — a direct poke from a producer

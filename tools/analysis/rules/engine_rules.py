@@ -59,7 +59,13 @@ class LatestMessagesMutationRule(Rule):
     summary = "direct store vote-state mutation outside specs/+forkchoice/+node/"
 
     def check(self, ctx):
-        if ctx.tree is None or ctx.in_dir("specs", "forkchoice", "node"):
+        # persist/ is sanctioned alongside node/ (ISSUE 14): checkpoint
+        # restore rebuilds a Store from a digest-verified artifact BEFORE
+        # any handler runs on it — installing the persisted vote state is
+        # the deserializer's one legitimate job, and the engine re-adopts
+        # the store through its warm-start path immediately after
+        if ctx.tree is None or ctx.in_dir("specs", "forkchoice", "node",
+                                          "persist"):
             return
         msg = ("direct store.{} mutation (route through spec handlers, "
                "forkchoice/batch.py, or the node's engine-backed handler)")

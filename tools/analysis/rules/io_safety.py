@@ -1,0 +1,110 @@
+"""IO01 — durable-artifact IO goes through ``persist/atomic.py``.
+
+ISSUE 14 consolidated every torn-write-safe disk write behind ONE
+implementation (unique temp + ``os.replace`` promotion + trailing
+SHA-256 + kind/ABI tag).  The guarantee only holds if it stays the only
+write path: a module that calls ``os.replace``/``os.rename`` itself, or
+opens a file for BINARY writing, is minting a durable artifact outside
+the discipline — no digest, no tag, and usually a bespoke temp-file
+dance whose failure modes nobody chaos-tests.  The MSM-table cache
+lived exactly there for four PRs before migrating.
+
+IO01 flags, in production modules (``consensus_specs_tpu/`` outside
+``persist/`` itself):
+
+* ``os.replace`` / ``os.rename`` / ``os.link`` calls — the promotion
+  half of a hand-rolled atomic write (deletions — ``os.unlink``/
+  ``os.remove`` — stay legal: removal is an invalidation, it cannot
+  mint a torn artifact);
+* ``open``/``os.fdopen`` with a BINARY write mode (``"wb"``, ``"ab"``,
+  ``"r+b"``, ``"xb"``…) — the payload half.  Text-mode writes stay
+  legal: JSON post-mortems and reports are human-readable output, not
+  integrity-checked artifacts.
+
+Like HD01, a sanctioned escape is a positive annotation — ``#
+durable-io: <why>`` on the flagged line (or a standalone comment line
+directly above) with a non-empty justification.  The live tree carries
+exactly the bespoke writers that cannot route through the envelope (the
+compiler-produced ``.so`` promotion, the telemetry JSON report dumps).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule, register
+
+_PROMOTIONS = {"replace", "rename", "link"}
+_BOUNDARY_RE = re.compile(r"#\s*durable-io:\s*\S")
+_MODE_RE = re.compile(r"[wax+]")
+
+
+def _is_binary_write_mode(mode: str) -> bool:
+    return "b" in mode and bool(_MODE_RE.search(mode))
+
+
+@register
+class IoSafetyRule(Rule):
+    """Raw artifact promotion (os.replace/rename) or binary
+    open-for-write outside persist/, without a declared boundary."""
+
+    code = "IO01"
+    summary = "durable-artifact IO outside persist/atomic.py"
+
+    def check(self, ctx):
+        if ctx.tree is None or "consensus_specs_tpu" not in ctx.parts:
+            return
+        if ctx.in_dir("persist", "specs", "tests", "testing", "vendor",
+                      "gen", "debug"):
+            return
+        sym = ctx.symbols
+        declared = set()
+        for i, line in enumerate(ctx.lines, 1):
+            if not _BOUNDARY_RE.search(line):
+                continue
+            declared.add(i)
+            if line.lstrip().startswith("#"):
+                # standalone annotation: covers the first statement
+                # after its comment block (the HD01 shape)
+                j = i + 1
+                while (j <= len(ctx.lines)
+                       and ctx.lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                declared.add(j)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = None
+            dotted = (sym.resolve(node.func) or "").lstrip(".")
+            tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+            if tail in _PROMOTIONS and dotted.startswith("os."):
+                hit = (f"os.{tail}() promotes a durable artifact by hand: "
+                       "no digest, no tag, bespoke torn-write handling")
+            elif (tail == "open"
+                    or (tail == "fdopen" and dotted.startswith("os."))):
+                mode = self._literal_mode(node)
+                if mode is not None and _is_binary_write_mode(mode):
+                    hit = (f"binary {tail}(mode={mode!r}) writes a durable "
+                           "artifact outside the envelope")
+            if hit is None or node.lineno in declared:
+                continue
+            yield (node.lineno,
+                   f"{hit} — route it through persist/atomic.py "
+                   "(write_artifact/read_artifact) or declare the "
+                   "boundary with `# durable-io: <why>`")
+
+    @staticmethod
+    def _literal_mode(call: ast.Call):
+        """The call's mode string when given literally (positional arg 1
+        for ``open``/``fdopen``, or ``mode=`` keyword); None otherwise —
+        a computed mode is opaque and flagging it would be guessing."""
+        candidates = []
+        if len(call.args) >= 2:
+            candidates.append(call.args[1])
+        candidates += [kw.value for kw in call.keywords
+                       if kw.arg == "mode"]
+        for c in candidates:
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                return c.value
+        return None

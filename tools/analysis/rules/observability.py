@@ -56,10 +56,16 @@ _RING_APPENDERS = {"append", "appendleft", "extend", "extendleft", "insert"}
 # node_quarantine / node_recovered (ISSUE 13) join them: the first
 # asserts a poison item LANDED in the dead-letter ring, the second that
 # a journal replay fully rebuilt the store — logged early, either would
-# put a containment action in the post-mortem that never settled
+# put a containment action in the post-mortem that never settled.
+# checkpoint_written / checkpoint_restored (ISSUE 14) likewise: the
+# first asserts a durable artifact was atomically PROMOTED (recorded
+# before the os.replace settles, a kill would leave the timeline
+# claiming a checkpoint that is not on disk), the second that a restore
+# plus its suffix replay fully rebuilt the store
 _COMMIT_KINDS = {"cache_commit", "block_fast", "mirror_flush",
                  "memo_commit", "node_block", "node_gossip",
-                 "node_quarantine", "node_recovered"}
+                 "node_quarantine", "node_recovered",
+                 "checkpoint_written", "checkpoint_restored"}
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
