@@ -2192,9 +2192,18 @@ def main():
             if blocking:
                 for line in blocking:
                     print(line, file=sys.stderr)
+                # name the first offender so the refusal is actionable
+                # from the one-line summary alone (ISSUE 15 drive-by)
+                if a_result.findings:
+                    f0 = a_result.findings[0]
+                    first = f"first: {f0.code} in {f0.file}:{f0.line}"
+                else:
+                    first = ("first: stale baseline entry in "
+                             f"{a_result.stale_baseline[0]['file']}")
                 print(f"refusing to print the headline row: "
-                      f"{len(blocking)} unbaselined analyzer finding(s) — "
-                      f"see ANALYSIS.json / `make analyze`", file=sys.stderr)
+                      f"{len(blocking)} unbaselined analyzer finding(s) "
+                      f"({first}) — see ANALYSIS.json / `make analyze`",
+                      file=sys.stderr)
                 sys.exit(3)
 
     # the driver parses the LAST JSON line: that must be the north star —

@@ -263,6 +263,9 @@ class Node:
 
     @contextlib.contextmanager
     def _single_writer(self):
+        # thread-safe: a deliberately NON-blocking probe — contention
+        # means a second writer, which must raise, not wait; released
+        # in the finally below (the with-form cannot express try-acquire)
         if not self._writer_lock.acquire(blocking=False):
             raise RuntimeError(
                 "concurrent node apply: fork choice is single-writer — "

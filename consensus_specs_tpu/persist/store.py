@@ -120,6 +120,9 @@ def _index_put(path: str, meta: dict) -> None:
     with _INDEX_LOCK:
         _INDEX[path] = meta
     if not getattr(_WRITER_THREAD, "active", False):
+        # thread-safe: the _WRITER_THREAD.active flag above gates this
+        # off the background writer — only same-thread (synchronous)
+        # callers ride the apply thread's own open transaction
         staging.note_insert(_INDEX, path)
 
 

@@ -176,3 +176,55 @@ def test_analyzer_version_digests_rule_sources():
     v = analysis.runner.analyzer_version()
     assert v == analysis.runner.analyzer_version()  # deterministic
     assert len(v) == 64
+
+
+def test_role_seed_edit_rederives_role_dependents(tmp_path, monkeypatch):
+    """The role-seed salt (ISSUE 15): role facts flow AGAINST import
+    direction, so a spawn-seam edit must re-derive files whose bytes and
+    import closure never changed — while unrelated leaf edits keep the
+    warm path warm."""
+    from analysis import concurrency_registry as creg
+    from analysis.concurrency_registry import RoleSeed
+
+    pkg = tmp_path / "consensus_specs_tpu"
+    pkg.mkdir()
+    monkeypatch.setattr(creg, "SHARED", ())
+    monkeypatch.setattr(creg, "LOCKS", ())
+    monkeypatch.setattr(creg, "ROLE_SEEDS", (
+        RoleSeed("consensus_specs_tpu.spawn.worker", "producer", "fixture"),))
+    (pkg / "helper.py").write_text(
+        "_SHARED = []\n"
+        "def touch(v):\n"
+        "    _SHARED.append(v)\n")
+    (pkg / "spawn.py").write_text(
+        "import threading\n"
+        "from consensus_specs_tpu.helper import touch\n"
+        "def worker():\n"
+        "    touch(1)\n"
+        "def launch():\n"
+        "    threading.Thread(target=worker).start()\n")
+    (pkg / "other.py").write_text("x = 1\n")
+
+    first = _run(tmp_path)
+    # the producer role reaches helper.touch: its unguarded global is red
+    assert [(f.file, f.code) for f in first.findings] == \
+        [("consensus_specs_tpu/helper.py", "TH01")], first.findings
+    assert _run(tmp_path).cache_hits == 3  # warm and stable
+
+    # retire the seeded entry function: helper.py's bytes are untouched
+    # and spawn.py is NOT in its import closure, but helper's roles (and
+    # finding) change — the role salt must force the re-derive
+    (pkg / "spawn.py").write_text(
+        "from consensus_specs_tpu.helper import touch\n"
+        "def direct():\n"
+        "    touch(1)\n")
+    third = _run(tmp_path)
+    assert third.findings == []
+    fourth = _run(tmp_path)
+    assert fourth.cache_hits == 3
+
+    # an edit that leaves the role map alone keeps everyone else warm
+    (pkg / "other.py").write_text("x = 2\n")
+    fifth = _run(tmp_path)
+    assert fifth.cache_hits == 2
+    assert fifth.findings == []

@@ -196,3 +196,113 @@ def test_tuple_unpack_shares_the_producing_call_origin():
     info = table.scope_info(fn)
     assert info.origin_of("r") == "_k"
     assert info.origin_of("p") == "_k"
+
+
+def test_methods_summarized_with_self_calls_resolved(monkeypatch):
+    from analysis import concurrency_registry as creg
+    monkeypatch.setattr(creg, "LOCKS", ())
+    s = _summ("consensus_specs_tpu/node/q.py",
+              "import threading\n"
+              "class Box:\n"
+              "    def start(self, pool):\n"
+              "        pool.submit(self.run)\n"
+              "    def run(self):\n"
+              "        self._emit()\n"
+              "    def _emit(self):\n"
+              "        pass\n")
+    assert set(s.methods) == {"Box.start", "Box.run", "Box._emit"}
+    assert "consensus_specs_tpu.node.q.Box._emit" in s.methods["Box.run"].calls
+    assert s.spawn_sites == [[4, "submit",
+                              "consensus_specs_tpu.node.q.Box.run"]]
+
+
+def test_spawn_sites_thread_partial_and_nested(monkeypatch):
+    from analysis import concurrency_registry as creg
+    monkeypatch.setattr(creg, "LOCKS", ())
+    s = _summ("consensus_specs_tpu/node/s.py",
+              "import threading\n"
+              "from functools import partial\n"
+              "def run():\n"
+              "    def inner():\n"
+              "        pass\n"
+              "    threading.Thread(target=inner).start()\n"
+              "    threading.Thread(target=partial(run, 1)).start()\n")
+    assert s.spawn_sites == [
+        [6, "Thread", "consensus_specs_tpu.node.s.inner"],
+        [7, "Thread", "consensus_specs_tpu.node.s.run"]]
+
+
+def test_plain_submit_methods_are_not_spawn_sites(monkeypatch):
+    # any class may name a method `submit`: only verifiable function
+    # references count (the CheckpointStore.submit false-positive shape)
+    from analysis import concurrency_registry as creg
+    monkeypatch.setattr(creg, "LOCKS", ())
+    s = _summ("consensus_specs_tpu/node/t.py",
+              "import threading\n"
+              "def schedule(store, spec, payload):\n"
+              "    store.submit(spec, payload)\n")
+    assert s.spawn_sites == []
+
+
+def test_lock_edges_record_nesting_with_threading_origins(monkeypatch):
+    from analysis import concurrency_registry as creg
+    monkeypatch.setattr(creg, "LOCKS", ())
+    s = _summ("consensus_specs_tpu/node/l.py",
+              "import threading\n"
+              "_A = threading.Lock()\n"
+              "_B = threading.Lock()\n"
+              "def f():\n"
+              "    with _A:\n"
+              "        with _B:\n"
+              "            pass\n")
+    assert s.lock_edges == [["consensus_specs_tpu.node.l:_A",
+                             "consensus_specs_tpu.node.l:_B", 6]]
+
+
+def test_nested_defs_summarized_and_lock_stack_resets(monkeypatch):
+    from analysis import concurrency_registry as creg
+    monkeypatch.setattr(creg, "LOCKS", ())
+    s = _summ("consensus_specs_tpu/node/n.py",
+              "import threading\n"
+              "_A = threading.Lock()\n"
+              "_B = threading.Lock()\n"
+              "def helper():\n"
+              "    pass\n"
+              "def run():\n"
+              "    def worker():\n"
+              "        helper()\n"
+              "    with _A:\n"
+              "        def cb():\n"
+              "            with _B:\n"
+              "                pass\n")
+    # nested defs join the flat module.name key space with their calls
+    # qualified, so role propagation can follow them
+    assert set(s.nested) == {"worker", "cb"}
+    assert "consensus_specs_tpu.node.n.helper" in s.nested["worker"].calls
+    # cb runs later, not under _A: no phantom cross-def lock edge
+    assert s.lock_edges == []
+
+
+def test_role_propagation_reaches_fixed_point(monkeypatch):
+    from analysis import concurrency_registry as creg
+    from analysis.concurrency_registry import RoleSeed
+    monkeypatch.setattr(creg, "LOCKS", ())
+    monkeypatch.setattr(creg, "ROLE_SEEDS", (
+        RoleSeed("consensus_specs_tpu.a.worker", "producer", "t"),))
+    proj = build_project({
+        "consensus_specs_tpu/a.py": (
+            "from consensus_specs_tpu.b import helper\n"
+            "def worker():\n"
+            "    helper()\n"),
+        "consensus_specs_tpu/b.py": (
+            "def helper():\n"
+            "    leaf()\n"
+            "def leaf():\n"
+            "    pass\n")})
+    assert "producer" in proj.roles.get("consensus_specs_tpu.b.leaf", {})
+    chain = proj.role_chain("consensus_specs_tpu.b.leaf", "producer")
+    assert chain == ["consensus_specs_tpu.a.worker",
+                     "consensus_specs_tpu.b.helper",
+                     "consensus_specs_tpu.b.leaf"]
+    # the salt is deterministic and sensitive to the role map
+    assert proj.role_salt() == proj.role_salt()

@@ -70,6 +70,17 @@ def test_per_rule_budget_and_observability(gate):
     report = gate.to_json()["rule_stats"]
     assert set(report) == set(gate.rule_stats)
     assert all("time_s" in v and "findings" in v for v in report.values())
+    # the thread-role fixed point (ISSUE 15) reports its own wall time
+    # and stays a rounding error of the run — it executes warm AND cold
+    assert gate.to_json()["role_pass_s"] == round(gate.role_pass_s, 4)
+    assert 0.0 <= gate.role_pass_s < 1.0, gate.role_pass_s
+
+
+def test_warm_run_keeps_the_role_pass_cheap(gate):
+    # the role pass is the one project-level pass a warm run cannot
+    # skip; its budget is what keeps `make analyze` interactive
+    warm = run(cache_path=gate._cache_path)
+    assert warm.role_pass_s < 0.5, warm.role_pass_s
 
 
 # -- seeded mutations: the gate must turn red --------------------------------
@@ -235,8 +246,80 @@ def test_dt01_cross_file_callsite_mutation_turns_red(gate):
                and "total_of" in f.message for f in found), found
 
 
+def test_th01_pr9_span_stack_race_mutation_turns_red(gate):
+    # PR 9's historical race, reintroduced: the span nesting stack as a
+    # shared module global instead of thread-local — TH01 must flag the
+    # mutation with the spawned roles that reach span() named
+    rel = "consensus_specs_tpu/telemetry/metrics.py"
+    found = _mutated(gate, {rel: lambda t: t.replace(
+        "_tls = threading.local()  # per-thread span nesting stack",
+        "_NEST: list = []\n"
+        "_tls = threading.local()  # per-thread span nesting stack",
+    ).replace("    stack = _stack()\n", "    stack = _NEST\n")})
+    hits = [f for f in found if f.code == "TH01"]
+    assert hits, found
+    assert any("_NEST" in f.message and "pipeline-worker" in f.message
+               and "metrics.span" in f.message for f in hits), hits
+
+
+def test_th01_pr14_writer_staging_leak_mutation_turns_red(gate):
+    # PR 14's historical race, reintroduced: the background checkpoint
+    # writer riding the apply thread's open block transaction (the
+    # _WRITER_THREAD gate and its justification removed)
+    rel = "consensus_specs_tpu/persist/store.py"
+    found = _mutated(gate, {rel: lambda t: t.replace(
+        "    if not getattr(_WRITER_THREAD, \"active\", False):\n"
+        "        # thread-safe: the _WRITER_THREAD.active flag above gates this\n"
+        "        # off the background writer — only same-thread (synchronous)\n"
+        "        # callers ride the apply thread's own open transaction\n"
+        "        staging.note_insert(_INDEX, path)",
+        "    staging.note_insert(_INDEX, path)")})
+    hits = [f for f in found if f.code == "TH01"]
+    assert hits, found
+    assert any("block cache transaction" in f.message
+               and "persist-writer" in f.message
+               and "CheckpointStore._drain -> "
+                   "persist.store.CheckpointStore.write_checkpoint"
+                   in f.message for f in hits), hits
+
+
+def test_th01_lock_free_requeue_front_mutation_turns_red(gate):
+    # the ingest deque's registered lock dropped from requeue_front
+    rel = "consensus_specs_tpu/node/ingest.py"
+    found = _mutated(gate, {rel: lambda t: t.replace(
+        "        with self._lock:\n"
+        "            if len(self._items) >= self._cap:",
+        "        if True:\n"
+        "            if len(self._items) >= self._cap:")})
+    assert any(f.code == "TH01" and "ingest queue deque" in f.message
+               and "IngestQueue._lock" in f.message for f in found), found
+
+
+def test_th01_undeclared_spawn_site_mutation_turns_red(gate):
+    # registry completeness: a new production thread without a declared
+    # role turns the gate red (the chaos COVERED_SITES pattern)
+    rel = "consensus_specs_tpu/node/firehose.py"
+    found = _mutated(gate, {rel: lambda t: t + (
+        "\n\ndef _orphan_worker():\n"
+        "    pass\n"
+        "def _spawn_orphan():\n"
+        "    threading.Thread(target=_orphan_worker).start()\n")})
+    assert any(f.code == "TH01" and "no declared role" in f.message
+               for f in found), found
+
+
+def test_lk01_undeclared_lock_mutation_turns_red(gate):
+    # registry completeness: a new production lock without a LockSpec
+    rel = "consensus_specs_tpu/stf/pipeline.py"
+    found = _mutated(gate, {rel: lambda t: t + (
+        "\n\nimport threading\n"
+        "_SIDE_LOCK = threading.Lock()\n")})
+    assert any(f.code == "LK01" and "_SIDE_LOCK" in f.message
+               for f in found), found
+
+
 def test_registry_covers_every_mutation_code():
     # every rule family proven red above is a registered plugin
     for code in ("FC01", "DT01", "CC01", "RB01", "JX01", "ST01",
-                 "HD01", "SH01", "EF01", "OB01", "IO01"):
+                 "HD01", "SH01", "EF01", "OB01", "IO01", "TH01", "LK01"):
         assert code in REGISTRY, code

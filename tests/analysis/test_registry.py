@@ -28,3 +28,54 @@ def test_every_rule_has_unique_code_summary_and_docs():
 def test_rule_subset_selection():
     subset = all_rules(codes=["FC01", "DT01"])
     assert [r.code for r in subset] == ["FC01", "DT01"]
+
+
+def test_concurrency_registry_is_duplicate_free():
+    from analysis.concurrency_registry import registry_errors
+
+    assert registry_errors() == []
+
+
+def test_concurrency_registry_duplicates_detected(monkeypatch):
+    from analysis import concurrency_registry as creg
+    from analysis.concurrency_registry import (LockSpec, RoleSeed,
+                                               SharedSpec, registry_errors)
+
+    monkeypatch.setattr(creg, "LOCKS", (
+        LockSpec("dup", "m", frozenset({"_L"})),
+        LockSpec("dup", "m", frozenset({"_L"})),      # name AND spelling
+    ))
+    monkeypatch.setattr(creg, "SHARED", (
+        SharedSpec("s1", "m", module_globals=frozenset({"_G"})),
+        SharedSpec("s2", "m", module_globals=frozenset({"_G"})),  # global
+        SharedSpec("s3", "m", module_globals=frozenset({"_H"}),
+                   lock="missing"),                   # unknown lock
+    ))
+    monkeypatch.setattr(creg, "ROLE_SEEDS", (
+        RoleSeed("m.f", "producer"),
+        RoleSeed("m.f", "producer"),                  # seed twice
+        RoleSeed("m.g", "no-such-role"),              # unknown role
+    ))
+    errors = registry_errors()
+    assert len(errors) == 6, errors
+    joined = "\n".join(errors)
+    for needle in ("'dup' declared twice", "spelling '_L'", "'_G'",
+                   "unknown lock 'missing'", "seed 'm.f'",
+                   "unknown role 'no-such-role'"):
+        assert needle in joined, (needle, errors)
+
+
+def test_lint_cli_refuses_duplicate_registry(monkeypatch, capsys):
+    # `make analyze` (tools/lint.py) exits 2 before analyzing anything
+    import lint
+    from analysis import concurrency_registry as creg
+    from analysis.concurrency_registry import LockSpec
+
+    monkeypatch.setattr(creg, "LOCKS", (
+        LockSpec("dup", "m", frozenset({"_L"})),
+        LockSpec("dup", "m", frozenset({"_L"})),
+    ))
+    assert lint.main([]) == 2
+    out = capsys.readouterr().out
+    assert "concurrency registry error" in out
+    assert "concurrency_registry.py" in out

@@ -1,0 +1,372 @@
+"""TH01 thread-role dataflow: registered shared structures demand their
+lock, role-confined structures reject foreign roles (with the
+propagation chain named), undeclared globals may not be mutated from
+spawned-role code, and every spawn site maps to a declared role
+(ISSUE 15)."""
+import pytest
+
+from analysis import analyze_text
+from analysis import concurrency_registry as creg
+from analysis.concurrency_registry import LockSpec, RoleSeed, SharedSpec
+from analysis.dataflow import build_project
+
+MOD = "consensus_specs_tpu.stf.x"
+PATH = "consensus_specs_tpu/stf/x.py"
+
+
+@pytest.fixture
+def registry(monkeypatch):
+    """A minimal fixture registry: one lock-guarded global, one
+    instance-attr structure sharing a condition alias, one confined
+    structure with an entry point, one declared worker role, one seam."""
+    monkeypatch.setattr(creg, "LOCKS", (
+        LockSpec("x lock", MOD, frozenset({"_LOCK"})),
+        LockSpec("box lock", MOD,
+                 frozenset({"Box._lock", "Box._not_full", "Box._guard"})),
+    ))
+    monkeypatch.setattr(creg, "SHARED", (
+        SharedSpec("x table", MOD, module_globals=frozenset({"_TABLE"}),
+                   lock="x lock", lock_holders=frozenset({"_table_put"})),
+        SharedSpec("box items", MOD,
+                   instance_attrs=frozenset({"Box._items"}),
+                   lock="box lock"),
+        SharedSpec("x journal", MOD, module_globals=frozenset({"_JOURNAL"}),
+                   entrypoints=frozenset({f"{MOD}.journal_append"})),
+    ))
+    monkeypatch.setattr(creg, "ROLE_SEEDS", (
+        RoleSeed(f"{MOD}.run_worker", "producer", "fixture worker"),
+        RoleSeed(f"{MOD}.Box.run", "pipeline-worker", "fixture method"),
+    ))
+    monkeypatch.setattr(creg, "HANDOFF_SEAMS",
+                        frozenset({f"{MOD}.enqueue"}))
+
+
+def th01(path, src, project=None):
+    return [f for f in analyze_text(path, src, project=project)
+            if f.code == "TH01"]
+
+
+def check(src, project=None):
+    return th01(PATH, src, project=project)
+
+
+# -- lock-guarded structures ---------------------------------------------------
+
+def test_unguarded_write_to_registered_global_flagged(registry):
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n"
+           "def put(k, v):\n"
+           "    _TABLE[k] = v\n")
+    found = check(src)
+    assert [f.line for f in found] == [5]
+    assert "x table" in found[0].message and "_LOCK" in found[0].message
+
+
+def test_with_lock_guards_the_write(registry):
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n"
+           "def put(k, v):\n"
+           "    with _LOCK:\n"
+           "        _TABLE[k] = v\n")
+    assert check(src) == []
+
+
+def test_condition_alias_spelling_guards_instance_attr(registry):
+    # _not_full is a Condition sharing _lock: ONE registered identity
+    src = ("import threading\n"
+           "class Box:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._not_full = threading.Condition(self._lock)\n"
+           "        self._items = []\n"
+           "    def put(self, v):\n"
+           "        with self._not_full:\n"
+           "            self._items.append(v)\n"
+           "    def bad_put(self, v):\n"
+           "        self._items.append(v)\n")
+    found = check(src)
+    assert [f.line for f in found] == [11]
+    assert "box items" in found[0].message
+
+
+def test_init_constructs_unshared_and_lock_holders_pardoned(registry):
+    # __init__ writes before the object is shared; _table_put is the
+    # registered caller-holds-lock helper
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n"
+           "def _table_put(k, v):\n"
+           "    _TABLE[k] = v\n"
+           "class Box:\n"
+           "    def __init__(self):\n"
+           "        self._items = []\n")
+    assert check(src) == []
+
+
+def test_removal_also_races(registry):
+    # unlike CC01, pop/clear are concurrency mutations too
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n"
+           "def drop(k):\n"
+           "    _TABLE.pop(k, None)\n")
+    assert [f.line for f in check(src)] == [5]
+
+
+def test_closure_under_outer_with_is_not_guarded(registry):
+    # a callback DEFINED inside `with _LOCK:` runs later, without the
+    # lock: the guard walk must stop at the def boundary
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n"
+           "def register(bus):\n"
+           "    with _LOCK:\n"
+           "        def cb(k, v):\n"
+           "            _TABLE[k] = v\n"
+           "        bus.subscribe(cb)\n")
+    found = check(src)
+    assert [f.line for f in found] == [7]
+    assert "x table" in found[0].message
+
+
+def test_init_pardon_covers_only_self_attrs(registry):
+    # constructors may run on any thread: a registered module global
+    # written in __init__ stays checked (only self/cls attrs pardon)
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n"
+           "class Box:\n"
+           "    def __init__(self, k, v):\n"
+           "        _TABLE[k] = v\n")
+    found = check(src)
+    assert [f.line for f in found] == [6]
+    assert "x table" in found[0].message
+
+
+def test_module_alias_lock_spelling_guards_cross_file_write(registry):
+    # the owner's registered lock held through a module alias: a
+    # correctly-guarded foreign-file write must not be flagged
+    other_path = "consensus_specs_tpu/node/z.py"
+    other_src = ("from consensus_specs_tpu.stf import x\n"
+                 "def put(k, v):\n"
+                 "    with x._LOCK:\n"
+                 "        x._TABLE[k] = v\n"
+                 "def bad_put(k, v):\n"
+                 "    x._TABLE[k] = v\n")
+    found = th01(other_path, other_src)
+    assert [f.line for f in found] == [6]
+
+
+# -- role confinement + propagation --------------------------------------------
+
+_WORKER_HEADER = ("import threading\n"
+                  "_JOURNAL = []\n"
+                  "def journal_append(entry):\n"
+                  "    _JOURNAL.append(entry)\n"
+                  "def enqueue(item):\n"
+                  "    pass\n")
+
+
+def test_confined_entrypoint_from_foreign_role_names_chain(registry):
+    src = _WORKER_HEADER + (
+        "def helper(entry):\n"
+        "    journal_append(entry)\n"
+        "def run_worker():\n"
+        "    helper(1)\n"
+        "def spawn():\n"
+        "    threading.Thread(target=run_worker).start()\n")
+    found = check(src)
+    assert len(found) == 1
+    assert found[0].line == 8
+    assert "producer" in found[0].message
+    assert "run_worker -> stf.x.helper" in found[0].message
+
+
+def test_role_propagates_through_partial(registry):
+    src = _WORKER_HEADER + (
+        "from functools import partial\n"
+        "def run_worker(q):\n"
+        "    journal_append(q)\n"
+        "def spawn(q):\n"
+        "    threading.Thread(target=partial(run_worker, q)).start()\n")
+    found = check(src)
+    assert [f.line for f in found] == [9]
+    assert "producer" in found[0].message
+
+
+def test_role_propagates_through_method_refs(registry):
+    # pool.submit(self.run) seeds the declared pipeline-worker role on
+    # the method; its self-call chain carries the role to the write
+    src = _WORKER_HEADER + (
+        "class Box:\n"
+        "    def start(self, pool):\n"
+        "        pool.submit(self.run)\n"
+        "    def run(self):\n"
+        "        self._emit()\n"
+        "    def _emit(self):\n"
+        "        journal_append(1)\n")
+    found = check(src)
+    assert [f.line for f in found] == [13]
+    assert "pipeline-worker" in found[0].message
+    assert "Box.run -> " in found[0].message
+
+
+def test_handoff_seam_is_sanctioned(registry):
+    src = _WORKER_HEADER + (
+        "def run_worker(item):\n"
+        "    enqueue(item)\n"
+        "def spawn(item):\n"
+        "    threading.Thread(target=run_worker, args=(item,)).start()\n")
+    assert check(src) == []
+
+
+def test_confined_write_from_foreign_role_flagged(registry):
+    src = _WORKER_HEADER + (
+        "def run_worker(entry):\n"
+        "    _JOURNAL.append(entry)\n")
+    found = check(src)
+    assert [f.line for f in found] == [8]
+    assert "role-confined" in found[0].message
+
+
+def test_role_propagates_from_nested_spawn_target(registry):
+    # the live firehose/adversary producers are NESTED defs inside
+    # their runner: the seed must not be a dead end — its calls carry
+    # the role onward (the code-review soundness hole, pinned)
+    src = _WORKER_HEADER + (
+        "def helper(entry):\n"
+        "    journal_append(entry)\n"
+        "def run_all():\n"
+        "    def run_worker():\n"
+        "        helper(1)\n"
+        "    threading.Thread(target=run_worker).start()\n")
+    found = check(src)
+    assert [f.line for f in found] == [8]
+    assert "producer" in found[0].message
+    assert "run_worker -> stf.x.helper" in found[0].message
+
+
+def test_lock_holder_pardon_is_module_qualified(registry):
+    # a same-named function in a FOREIGN module earns no lock-holder
+    # exemption; only the owner module's documented helper does
+    other_path = "consensus_specs_tpu/node/y.py"
+    other_src = ("from consensus_specs_tpu.stf import x\n"
+                 "def _table_put(k, v):\n"
+                 "    x._TABLE[k] = v\n")
+    found = th01(other_path, other_src)
+    assert [f.line for f in found] == [3]
+    assert "x table" in found[0].message
+
+
+def test_cross_file_role_propagation(registry):
+    # the spawn seam lives a file away from the write it taints
+    spawn_path = "consensus_specs_tpu/node/spawn.py"
+    spawn_src = ("import threading\n"
+                 "from consensus_specs_tpu.stf.x import run_worker\n"
+                 "def launch():\n"
+                 "    threading.Thread(target=run_worker).start()\n")
+    x_src = _WORKER_HEADER + ("def run_worker():\n"
+                              "    journal_append(1)\n")
+    proj = build_project({spawn_path: spawn_src, PATH: x_src})
+    found = th01(PATH, x_src, project=proj)
+    assert [f.line for f in found] == [8]
+    assert "producer" in found[0].message
+
+
+# -- undeclared shared state ---------------------------------------------------
+
+def test_undeclared_global_mutated_in_spawned_role_flagged(registry):
+    src = _WORKER_HEADER + (
+        "_NEST = []\n"
+        "def run_worker(name):\n"
+        "    stack = _NEST\n"
+        "    stack.append(name)\n")
+    found = check(src)
+    assert [f.line for f in found] == [10]
+    assert "_NEST" in found[0].message and "producer" in found[0].message
+
+
+def test_undeclared_global_under_a_lock_is_tolerated(registry):
+    src = _WORKER_HEADER + (
+        "_NEST = []\n"
+        "_L = threading.Lock()\n"
+        "def run_worker(name):\n"
+        "    with _L:\n"
+        "        _NEST.append(name)\n")
+    assert check(src) == []
+
+
+def test_locals_and_main_only_globals_are_not_flagged(registry):
+    src = _WORKER_HEADER + (
+        "_MAIN_ONLY = []\n"
+        "def run_worker(name):\n"
+        "    mine = []\n"
+        "    mine.append(name)\n"
+        "def main_path(name):\n"
+        "    _MAIN_ONLY.append(name)\n")
+    assert check(src) == []
+
+
+# -- spawn-site completeness ---------------------------------------------------
+
+def test_spawn_target_without_declared_role_flagged(registry):
+    src = ("import threading\n"
+           "def orphan_worker():\n"
+           "    pass\n"
+           "def spawn():\n"
+           "    threading.Thread(target=orphan_worker).start()\n")
+    found = check(src)
+    assert [f.line for f in found] == [5]
+    assert "no declared role" in found[0].message
+
+
+def test_unresolvable_spawn_target_flagged(registry):
+    src = ("import threading\n"
+           "def spawn(fn):\n"
+           "    threading.Thread(target=fn()).start()\n")
+    found = check(src)
+    assert [f.line for f in found] == [3]
+    assert "cannot resolve" in found[0].message
+
+
+# -- escapes -------------------------------------------------------------------
+
+def test_thread_safe_annotation_sanctions_with_justification(registry):
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n"
+           "def put(k, v):\n"
+           "    # thread-safe: single-writer by documented contract\n"
+           "    _TABLE[k] = v\n"
+           "def put2(k, v):\n"
+           "    _TABLE[k] = v  # thread-safe: ditto, trailing form\n")
+    assert check(src) == []
+
+
+def test_bare_annotation_does_not_sanction(registry):
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n"
+           "def put(k, v):\n"
+           "    _TABLE[k] = v  # thread-safe:\n")
+    assert [f.line for f in check(src)] == [5]
+
+
+def test_noqa_suppresses(registry):
+    src = ("import threading\n"
+           "_LOCK = threading.Lock()\n"
+           "_TABLE = {}\n"
+           "def put(k, v):\n"
+           "    _TABLE[k] = v  # noqa: TH01\n")
+    assert check(src) == []
+
+
+def test_tests_and_specs_are_exempt(registry):
+    src = ("import threading\n"
+           "_TABLE = {}\n"
+           "def put(k, v):\n"
+           "    _TABLE[k] = v\n")
+    assert th01("tests/test_x.py", src) == []
+    assert th01("consensus_specs_tpu/specs/src/x.py", src) == []

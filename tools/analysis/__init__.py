@@ -8,7 +8,8 @@ incremental cache.  ``python tools/lint.py`` remains the CLI; the rule
 catalog lives in docs/architecture.md ("Static analysis").
 
 Hygiene rules: E501 E999 W191 W291 W605 F401 B001 B006
-Engine-invariant rules: FC01 ST01 CC01 RB01 JX01 DT01
+Engine-invariant rules: FC01 ST01 CC01 CC02 RB01 JX01 DT01
+Interprocedural rules: HD01 SH01 EF01 OB01 IO01 TH01 LK01
 """
 from .core import FileContext, Finding, REGISTRY, Rule, all_rules, register
 from .runner import (

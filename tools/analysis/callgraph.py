@@ -24,7 +24,17 @@ file):
 * module-level facts: names bound to ``faults.site(...)`` probes, names
   passed to ``staging.defer`` (deferred commit functions), mesh-axis
   string names (for the sharding-contract rule), and module-scope call
-  origins (``_jit_kernel = jax.jit(_deltas_kernel)``).
+  origins (``_jit_kernel = jax.jit(_deltas_kernel)``);
+* concurrency facts (ISSUE 15): **methods** summarized like functions
+  (keyed ``Class.method``, with ``self.x(...)``/``cls.x(...)`` resolved
+  to ``module.Class.x`` — the thread-role propagation follows call
+  chains through classes), **thread-spawn sites**
+  (``threading.Thread(target=...)`` / pool ``submit``, targets resolved
+  through ``functools.partial`` and bound-method references), and
+  **lock-nesting edges** (``with B:`` lexically inside ``with A:`` —
+  LK01's cross-file acquisition-order graph; identities canonicalize
+  through the concurrency registry so a ``Condition`` sharing a lock is
+  ONE identity).
 
 ``dataflow.Project`` consumes these summaries and runs the fixed-point
 propagation; rules never touch this module directly.
@@ -35,7 +45,7 @@ import ast
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
-from .symbols import SymbolTable, name_matches
+from .symbols import SymbolTable, module_matches, name_matches
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -167,6 +177,15 @@ class FileSummary:
     defer_targets: List[str] = field(default_factory=list)
     mesh_axes: List[str] = field(default_factory=list)
     module_origins: Dict[str, str] = field(default_factory=dict)
+    # ISSUE 15 concurrency facts: methods keyed "Class.method",
+    # nested defs keyed by bare name (the firehose producers are nested
+    # in their runner — role propagation must not stop at the seed),
+    # spawn sites as [lineno, api, resolved-target-or-None], lock-order
+    # edges as [outer-identity, inner-identity, lineno]
+    methods: Dict[str, FuncSummary] = field(default_factory=dict)
+    nested: Dict[str, FuncSummary] = field(default_factory=dict)
+    spawn_sites: List[list] = field(default_factory=list)
+    lock_edges: List[list] = field(default_factory=list)
 
     def to_json(self) -> dict:
         return {"display": self.display, "module": self.module,
@@ -176,7 +195,13 @@ class FileSummary:
                 "probe_names": self.probe_names,
                 "defer_targets": self.defer_targets,
                 "mesh_axes": self.mesh_axes,
-                "module_origins": self.module_origins}
+                "module_origins": self.module_origins,
+                "methods": {n: f.to_json()
+                            for n, f in self.methods.items()},
+                "nested": {n: f.to_json()
+                           for n, f in self.nested.items()},
+                "spawn_sites": self.spawn_sites,
+                "lock_edges": self.lock_edges}
 
     @classmethod
     def from_json(cls, d: dict) -> "FileSummary":
@@ -187,7 +212,13 @@ class FileSummary:
                    probe_names=d.get("probe_names", []),
                    defer_targets=d.get("defer_targets", []),
                    mesh_axes=d.get("mesh_axes", []),
-                   module_origins=d.get("module_origins", {}))
+                   module_origins=d.get("module_origins", {}),
+                   methods={n: FuncSummary.from_json(f)
+                            for n, f in d.get("methods", {}).items()},
+                   nested={n: FuncSummary.from_json(f)
+                           for n, f in d.get("nested", {}).items()},
+                   spawn_sites=d.get("spawn_sites", []),
+                   lock_edges=d.get("lock_edges", []))
 
 
 def _registered_cache_globals() -> Set[str]:
@@ -197,6 +228,190 @@ def _registered_cache_globals() -> Set[str]:
     for spec in CACHE_REGISTRY:
         names |= spec.module_globals
     return names
+
+
+# -- concurrency facts (ISSUE 15) ----------------------------------------------
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_SPAWN_TAILS = {"Thread"}
+
+
+def is_lock_factory(dotted: Optional[str]) -> bool:
+    """A resolved dotted name that constructs a lock-like object."""
+    return (bool(dotted) and name_matches(dotted, _LOCK_FACTORIES)
+            and "threading" in dotted)
+
+
+def instance_lock_attrs(tree, sym: SymbolTable) -> Dict[str, Set[str]]:
+    """{Class: {attr}} for ``self.X = threading.Lock()``-style bindings
+    anywhere in the class body (the ``__init__``-constructed locks)."""
+    out: Dict[str, Set[str]] = {}
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs: Set[str] = set()
+        for n in ast.walk(node):
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Attribute)
+                    and isinstance(n.targets[0].value, ast.Name)
+                    and n.targets[0].value.id == "self"
+                    and isinstance(n.value, ast.Call)
+                    and is_lock_factory(sym.resolve(n.value.func))):
+                attrs.add(n.targets[0].attr)
+        if attrs:
+            out[node.name] = attrs
+    return out
+
+
+def _declared_lock_spellings() -> Dict[tuple, str]:
+    from .concurrency_registry import declared_lock_spellings
+
+    return declared_lock_spellings()
+
+
+def lock_identity(expr: ast.AST, module: str, class_name: Optional[str],
+                  inst_locks: Dict[str, Set[str]], sym: SymbolTable,
+                  scope, declared: Dict[tuple, str]) -> Optional[str]:
+    """Canonical identity of a ``with``-item when it acquires a lock:
+    the registry's lock name when the spelling is declared (so a
+    Condition sharing a Lock is ONE identity), else a raw
+    ``module:spelling`` for lock objects the origin tracking can see
+    (``threading.*`` constructions, instance locks) — fixture files work
+    without registry entries.  None for non-lock context managers."""
+    e = expr
+    if isinstance(e, ast.Call):
+        e = e.func  # context-manager helper: with self._single_writer():
+    if (isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name)
+            and e.value.id in ("self", "cls")):
+        spelling = f"{class_name}.{e.attr}" if class_name else e.attr
+        if (module, spelling) in declared:
+            return declared[(module, spelling)]
+        if class_name and e.attr in inst_locks.get(class_name, ()):
+            return f"{module}:{spelling}"
+        return None
+    if isinstance(e, ast.Attribute):
+        # a module-alias spelling (``with x._LOCK:``): the owner
+        # module's registered lock held from a foreign file
+        resolved = sym.resolve(e.value)
+        for (mod, spelling), name in declared.items():
+            if spelling == e.attr and module_matches(resolved, mod):
+                return name
+        return None
+    if isinstance(e, ast.Name):
+        if (module, e.id) in declared:
+            return declared[(module, e.id)]
+        origin = scope.origins.get(e.id) if scope is not None else None
+        if origin is None:
+            origin = sym.scope_info(None).origins.get(e.id)
+        if is_lock_factory(origin):
+            return f"{module}:{e.id}"
+    return None
+
+
+def _spawn_target(arg: ast.AST, module: str, class_name: Optional[str],
+                  resolve, class_methods: Dict[str, Set[str]],
+                  strict: bool = False) -> Optional[str]:
+    """Resolved qualname of a spawn target: plain/nested functions
+    (``module.name``), bound methods (``module.Class.name``), and
+    ``functools.partial(fn, ...)`` wrappers (the wrapped callable is the
+    target).  ``strict`` (the pool-``submit`` shape, where ANY method
+    may be named ``submit``) only accepts references that verifiably
+    name a function — a self-method of the class or a defined function —
+    so ordinary ``x.submit(value)`` calls are not mistaken for spawns."""
+    if isinstance(arg, ast.Call) and name_matches(resolve(arg.func),
+                                                  {"partial"}):
+        return (_spawn_target(arg.args[0], module, class_name, resolve,
+                              class_methods, strict)
+                if arg.args else None)
+    if (isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name)
+            and arg.value.id in ("self", "cls") and class_name):
+        if strict and arg.attr not in class_methods.get(class_name, ()):
+            return None
+        return f"{module}.{class_name}.{arg.attr}"
+    if strict and not (isinstance(arg, ast.Name)
+                       and arg.id in class_methods.get("", ())):
+        return None
+    dotted = resolve(arg)
+    if dotted and "." not in dotted.lstrip("."):
+        return f"{module}.{dotted}"  # local or nested function name
+    return dotted
+
+
+def _collect_concurrency(tree, sym: SymbolTable, module: str,
+                         out: "FileSummary", resolve) -> None:
+    """Spawn sites + lock-nesting edges (one scoped traversal carrying
+    class context and the lexical stack of held lock identities).
+    Skipped outright for files that can construct neither (no threading
+    or executor import, no registry-declared lock for the module) — the
+    traversal is the cost, not the facts."""
+    declared = _declared_lock_spellings()
+    if not (any("threading" in d or "concurrent" in d
+                for d in out.imports.values())
+            or any(m == module for m, _ in declared)):
+        return
+    inst_locks = instance_lock_attrs(tree, sym)
+    # class -> method names, plus (under "") every plain function name
+    # at any depth: the strict `submit` shape only trusts references
+    # that verifiably name a function defined in this file
+    class_methods: Dict[str, Set[str]] = {"": set()}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ClassDef):
+            class_methods[n.name] = {m.name for m in n.body
+                                     if isinstance(m, _FUNC_NODES)}
+        elif isinstance(n, _FUNC_NODES):
+            class_methods[""].add(n.name)
+
+    def visit(node, class_name, lock_stack, scope_node):
+        for child in ast.iter_child_nodes(node):
+            cname, snode = class_name, scope_node
+            stack = lock_stack
+            if isinstance(child, ast.ClassDef):
+                cname = child.name
+            elif isinstance(child, _FUNC_NODES):
+                snode = child
+                stack = []  # a nested def runs later, not under the lock
+            if isinstance(child, ast.Call):
+                dotted = resolve(child.func) or ""
+                tail = dotted.lstrip(".").rsplit(".", 1)[-1]
+                target = api = None
+                if tail in _SPAWN_TAILS and "threading" in dotted:
+                    api = "Thread"
+                    for kw in child.keywords:
+                        if kw.arg == "target":
+                            target = _spawn_target(kw.value, module, cname,
+                                                   resolve, class_methods)
+                elif (isinstance(child.func, ast.Attribute)
+                        and child.func.attr == "submit" and child.args):
+                    # any class may name a method `submit`; only a
+                    # verifiable function reference makes this a spawn
+                    target = _spawn_target(child.args[0], module, cname,
+                                           resolve, class_methods,
+                                           strict=True)
+                    if target is not None:
+                        api = "submit"
+                if api is not None:
+                    out.spawn_sites.append([child.lineno, api, target])
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                scope = sym.scope_info(snode)
+                held = list(lock_stack)
+                for item in child.items:
+                    ident = lock_identity(item.context_expr, module, cname,
+                                          inst_locks, sym, scope, declared)
+                    if ident is None:
+                        continue
+                    for outer in held:
+                        if outer != ident:
+                            out.lock_edges.append(
+                                [outer, ident, child.lineno])
+                    held.append(ident)
+                stack = held
+            visit(child, cname, stack, snode)
+
+    if tree is not None:
+        visit(tree, None, [], None)
 
 
 def summarize(display: str, tree: Optional[ast.AST],
@@ -209,7 +424,10 @@ def summarize(display: str, tree: Optional[ast.AST],
     if tree is None:
         return out
     sym = sym or SymbolTable(tree)
-    local_funcs = {n.name for n in tree.body if isinstance(n, _FUNC_NODES)}
+    # any-depth: a nested def calling a nested sibling must qualify to
+    # module.name, or the role propagation cannot follow the call
+    local_funcs = {n.name for n in ast.walk(tree)
+                   if isinstance(n, _FUNC_NODES)}
 
     def resolve_dotted(dotted: Optional[str]) -> Optional[str]:
         dotted = absolutize(dotted, anchor)
@@ -262,6 +480,53 @@ def summarize(display: str, tree: Optional[ast.AST],
         if isinstance(node, _FUNC_NODES):
             out.functions[node.name] = _summarize_func(
                 node, sym, resolve, resolve_dotted, cache_globals)
+
+    # methods, keyed "Class.method": ``self.x(...)``/``cls.x(...)``
+    # resolves into the class so role propagation follows method chains
+    # (ISSUE 15); other facts piggyback on the same summary shape
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        method_names = {m.name for m in node.body
+                        if isinstance(m, _FUNC_NODES)}
+
+        def resolve_in_class(n: ast.AST, _cls=node.name,
+                             _names=method_names):
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in ("self", "cls") and n.attr in _names):
+                return f"{module}.{_cls}.{n.attr}"
+            return resolve(n)
+
+        for m in node.body:
+            if isinstance(m, _FUNC_NODES):
+                out.methods[f"{node.name}.{m.name}"] = _summarize_func(
+                    m, sym, resolve_in_class, resolve_dotted, cache_globals)
+
+    # nested defs, keyed by bare name under the flat module.name key
+    # space — the firehose/adversary producers (role seeds) are nested
+    # in their runner, and propagation must not stop at the seed.
+    # Top-level names win a collision; duplicate nested names merge
+    # their call sets (a conservative over-approximation).
+    covered = {n for n in tree.body if isinstance(n, _FUNC_NODES)}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            covered.update(m for m in node.body
+                           if isinstance(m, _FUNC_NODES))
+    for node in ast.walk(tree):
+        if not isinstance(node, _FUNC_NODES) or node in covered:
+            continue
+        if node.name in out.functions:
+            continue
+        s = _summarize_func(node, sym, resolve, resolve_dotted,
+                            cache_globals)
+        prev = out.nested.get(node.name)
+        if prev is None:
+            out.nested[node.name] = s
+        else:
+            prev.calls = sorted(set(prev.calls) | set(s.calls))
+
+    _collect_concurrency(tree, sym, module, out, resolve)
     return out
 
 

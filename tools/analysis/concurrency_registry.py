@@ -1,0 +1,382 @@
+"""The concurrency registry: thread roles, locks, and shared structures
+(ISSUE 15).
+
+The repo's threading contract has always been prose — "the apply loop is
+single-writer", "the checkpoint writer never rides staging", "telemetry
+may be called from any thread".  This module turns it into data the
+analyzer checks (the CC01 ``CacheSpec`` pattern, applied to concurrency):
+
+* **roles** — the lattice of executing threads.  ``main`` is implicit
+  everywhere (any importable function can run on the caller's thread);
+  ``apply-writer`` is the single-writer apply loop (usually the main
+  thread wearing its serving hat); the *spawned* roles —pipeline-worker,
+  producer, persist-writer, native-pool — run CONCURRENTLY with it.
+  ``ROLE_SEEDS`` pins each role to its entry functions: the thread-spawn
+  targets pass 1 learns (``threading.Thread(target=...)``, pool
+  ``submit``), the producer-facing APIs, and the telemetry substrate
+  (declared callable from ANY role).  ``dataflow.Project`` propagates
+  the seeds over the call graph to a fixed point, so TH01 can name the
+  chain that carries a role to a write site.  ``native-pool`` is
+  declared for completeness: the BLS thread pool lives in C++ and never
+  executes Python, so it has no seeds — a future Python callback from
+  that pool must add one here.
+* **locks** — every ``threading.Lock``/``RLock``/``Condition`` the
+  production tree constructs, with every spelling that acquires it
+  (a ``Condition(self._lock)`` shares its lock: ``_lock``/``_not_full``/
+  ``_not_empty`` are ONE identity; ``Node._single_writer`` is the
+  context-manager helper spelling of ``Node._writer_lock``).  LK01's
+  completeness check turns a new undeclared lock gate-red.
+* **shared structures** — every cross-thread mutable, either
+  **lock-guarded** (``lock=`` names the LockSpec a write must lexically
+  hold) or **role-confined** (``lock=None``: only the declared spawned
+  ``roles`` — plus the implicit main/apply writer — may touch it; a
+  foreign spawned role reaching a write, or calling a confined
+  ``entrypoint`` like ``staging.note_insert``, is TH01-red with the
+  role chain named).
+* **handoff seams** — the sanctioned ways work crosses roles: the ingest
+  queue's put/get/requeue and the telemetry entry points.  Calls to a
+  seam are never flagged; everything else that moves state across roles
+  must be declared or annotated ``# thread-safe: <why>``.
+
+``registry_errors()`` reports duplicate declarations (a lock spelling or
+structure global declared twice) — ``make analyze`` refuses the tree on
+any (tools/lint.py exits 2).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+_PKG = "consensus_specs_tpu"
+
+# the role lattice.  main is implicit and never propagated; apply-writer
+# is the single-writer loop (not concurrent with itself); the SPAWNED
+# roles run concurrently with everything else and drive the hazards.
+ROLES = ("main", "apply-writer", "pipeline-worker", "producer",
+         "persist-writer", "native-pool")
+SPAWNED_ROLES = frozenset({"pipeline-worker", "producer", "persist-writer",
+                           "native-pool"})
+
+
+@dataclass(frozen=True)
+class LockSpec:
+    """One lock identity and every spelling that acquires it.  ``binds``
+    entries are spellings relative to ``module``: a module-global name
+    (``_LOCK``), an instance attribute (``IngestQueue._not_full``), a
+    context-manager helper (``Node._single_writer``), or a function-local
+    binding (``fence``)."""
+
+    name: str
+    module: str
+    binds: FrozenSet[str]
+    description: str = ""
+
+
+@dataclass(frozen=True)
+class SharedSpec:
+    """One shared mutable structure.  ``lock`` names the LockSpec a
+    write must hold (lock-guarded); ``lock=None`` makes it role-confined
+    to ``roles`` (spawned roles sanctioned to touch it — main and the
+    apply writer are always implicit).  ``lock_holders`` are functions
+    documented to run with the lock already held by their caller;
+    ``entrypoints`` are callables whose mere CALL from a foreign role is
+    the hazard (the staging transaction API)."""
+
+    name: str
+    module: str
+    module_globals: FrozenSet[str] = frozenset()
+    instance_attrs: FrozenSet[str] = frozenset()  # "Class.attr"
+    lock: Optional[str] = None
+    roles: FrozenSet[str] = frozenset()
+    # spellings relative to the OWNER module ("fn" or "Class.fn"); a
+    # same-named function in any other module earns no pardon
+    lock_holders: FrozenSet[str] = frozenset()
+    entrypoints: FrozenSet[str] = frozenset()
+
+
+@dataclass(frozen=True)
+class RoleSeed:
+    """One role entry point: a spawn target, a producer-facing API, or a
+    telemetry substrate function (``role="any"`` = every spawned role)."""
+
+    qualname: str
+    role: str
+    why: str = ""
+
+
+LOCKS: Tuple[LockSpec, ...] = (
+    LockSpec("metrics lock", f"{_PKG}.telemetry.metrics",
+             frozenset({"_LOCK"}),
+             "span/counter aggregates (PR 9's race fix)"),
+    LockSpec("timeline lock", f"{_PKG}.telemetry.timeline",
+             frozenset({"_LOCK"}), "causal-timeline ring"),
+    LockSpec("recorder lock", f"{_PKG}.telemetry.recorder",
+             frozenset({"_LOCK"}), "flight-recorder ring"),
+    LockSpec("histogram lock", f"{_PKG}.telemetry.histogram",
+             frozenset({"_LOCK"}), "latency-histogram registry"),
+    LockSpec("bus lock", f"{_PKG}.telemetry.registry",
+             frozenset({"_LOCK"}), "provider registry"),
+    LockSpec("ingest stats lock", f"{_PKG}.node.ingest",
+             frozenset({"_STATS_LOCK"}),
+             "module-wide queue counters (two live queues may race)"),
+    LockSpec("ingest queue lock", f"{_PKG}.node.ingest",
+             frozenset({"IngestQueue._lock", "IngestQueue._not_full",
+                        "IngestQueue._not_empty"}),
+             "the bounded deque; both conditions share the one lock"),
+    LockSpec("admission lock", f"{_PKG}.node.admission",
+             frozenset({"_LOCK"}),
+             "pools/scores vs. bus snapshots from arbitrary threads"),
+    LockSpec("persist index lock", f"{_PKG}.persist.store",
+             frozenset({"_INDEX_LOCK"}),
+             "checkpoint index: apply loop, writer thread, recovery"),
+    LockSpec("checkpoint writer condition", f"{_PKG}.persist.store",
+             frozenset({"CheckpointStore._cond"}),
+             "newest-wins depth-1 write queue"),
+    LockSpec("node writer lock", f"{_PKG}.node.service",
+             frozenset({"Node._writer_lock", "Node._single_writer"}),
+             "single-writer contract (non-blocking probe, raises on "
+             "contention)"),
+    LockSpec("node clock condition", f"{_PKG}.node.service",
+             frozenset({"Node._clock_cond"}),
+             "producers pace against the apply loop's clock"),
+    LockSpec("firehose epoch fence", f"{_PKG}.node.firehose",
+             frozenset({"fence"}),
+             "per-run local Condition gating producers per epoch"),
+    LockSpec("adversarial epoch fence", f"{_PKG}.node.adversary",
+             frozenset({"fence"}),
+             "per-run local Condition gating producers per epoch"),
+)
+
+
+SHARED: Tuple[SharedSpec, ...] = (
+    # -- lock-guarded structures ---------------------------------------------
+    SharedSpec("metrics aggregates", f"{_PKG}.telemetry.metrics",
+               module_globals=frozenset({"_spans", "_counters"}),
+               lock="metrics lock"),
+    SharedSpec("timeline ring", f"{_PKG}.telemetry.timeline",
+               module_globals=frozenset({"_EVENTS", "_SEQ", "_INSTANTS",
+                                         "_LINKS", "_DROPPED", "_CAP"}),
+               lock="timeline lock",
+               # _append is documented caller-holds-lock (begin/end/
+               # instant take it); a new caller without the lock is on
+               # the hook for its own `with _LOCK`
+               lock_holders=frozenset({"_append"})),
+    SharedSpec("flight-recorder ring", f"{_PKG}.telemetry.recorder",
+               module_globals=frozenset({"_EVENTS", "_SEQ", "_DROPPED",
+                                         "_CAP"}),
+               lock="recorder lock"),
+    SharedSpec("latency-histogram registry", f"{_PKG}.telemetry.histogram",
+               module_globals=frozenset({"_HISTOGRAMS"}),
+               lock="histogram lock"),
+    SharedSpec("telemetry provider registry", f"{_PKG}.telemetry.registry",
+               module_globals=frozenset({"_PROVIDERS"}),
+               lock="bus lock"),
+    SharedSpec("ingest queue counters", f"{_PKG}.node.ingest",
+               module_globals=frozenset({"stats"}),
+               lock="ingest stats lock"),
+    SharedSpec("ingest queue deque", f"{_PKG}.node.ingest",
+               instance_attrs=frozenset({"IngestQueue._items",
+                                         "IngestQueue._closed"}),
+               lock="ingest queue lock"),
+    SharedSpec("admission pools and scores", f"{_PKG}.node.admission",
+               module_globals=frozenset({"stats", "_SEEN", "_ORPHANS",
+                                         "_ORPHAN_COUNT", "_PARKED",
+                                         "_DEAD_LETTERS", "_SCORES",
+                                         "_QUARANTINED"}),
+               lock="admission lock",
+               # the *_locked helpers run under admit/charge/on_clock's
+               # acquisition by documented contract
+               lock_holders=frozenset({"_charge_locked", "_forget_locked",
+                                       "_shed_oldest_orphan_locked"})),
+    SharedSpec("persist checkpoint index", f"{_PKG}.persist.store",
+               module_globals=frozenset({"_INDEX"}),
+               lock="persist index lock"),
+    SharedSpec("checkpoint writer queue", f"{_PKG}.persist.store",
+               instance_attrs=frozenset({"CheckpointStore._pending",
+                                         "CheckpointStore._busy",
+                                         "CheckpointStore._closed",
+                                         "CheckpointStore._worker"}),
+               lock="checkpoint writer condition"),
+    SharedSpec("node clock slot", f"{_PKG}.node.service",
+               instance_attrs=frozenset({"Node._clock_slot"}),
+               lock="node clock condition"),
+    # -- role-confined structures --------------------------------------------
+    # verify's batch/bisection/timing counters: single-writer per key by
+    # design — the dispatch worker owns them while the pipeline is on,
+    # the serial path (main) when it is off (stf/verify.py:217-221)
+    SharedSpec("verify counters", f"{_PKG}.stf.verify",
+               module_globals=frozenset({"stats"}),
+               roles=frozenset({"pipeline-worker"})),
+    # the verified-triple memo commits only at block settlement on the
+    # apply thread (staging-deferred); the worker verifies pure data
+    SharedSpec("verified-triple memo", f"{_PKG}.stf.verify",
+               module_globals=frozenset({"_VERIFIED_MEMO"})),
+    SharedSpec("pipeline in-flight queue", f"{_PKG}.stf.pipeline",
+               module_globals=frozenset({"_INFLIGHT", "stats"})),
+    # THE role-confinement contract the PR 14 race broke: the block
+    # cache transaction belongs to the apply thread; a spawned thread
+    # calling any entry point lands its effects in some unrelated
+    # block's undo log (persist/store.py:96-104 tells the story)
+    SharedSpec("block cache transaction", f"{_PKG}.stf.staging",
+               module_globals=frozenset({"_TXN"}),
+               entrypoints=frozenset({
+                   f"{_PKG}.stf.staging.note_insert",
+                   f"{_PKG}.stf.staging.defer",
+                   f"{_PKG}.stf.staging.begin_block",
+                   f"{_PKG}.stf.staging.deactivate",
+                   f"{_PKG}.stf.staging.commit_block",
+                   f"{_PKG}.stf.staging.rollback_block",
+                   f"{_PKG}.stf.staging.block_transaction",
+               })),
+    SharedSpec("node apply journal", f"{_PKG}.node.service",
+               instance_attrs=frozenset({"Node._journal",
+                                         "Node._journal_last_block"})),
+    SharedSpec("node service counters", f"{_PKG}.node.service",
+               module_globals=frozenset({"stats"})),
+    # written by the writer thread (write_checkpoint) AND the apply/main
+    # thread (submit failures, restore ladder) — sanctioned both ways
+    SharedSpec("persist store counters", f"{_PKG}.persist.store",
+               module_globals=frozenset({"stats"}),
+               roles=frozenset({"persist-writer"})),
+)
+
+
+ROLE_SEEDS: Tuple[RoleSeed, ...] = (
+    # spawn targets pass 1 discovers (the completeness check requires
+    # every production spawn site's target to appear here)
+    RoleSeed(f"{_PKG}.stf.pipeline.SigBatchHandle._run", "pipeline-worker",
+             "the one-thread signature dispatch worker (ISSUE 10)"),
+    RoleSeed(f"{_PKG}.persist.store.CheckpointStore._drain", "persist-writer",
+             "the background checkpoint writer (ISSUE 14)"),
+    RoleSeed(f"{_PKG}.node.firehose.chain_driver", "producer",
+             "firehose block/tick producer thread"),
+    RoleSeed(f"{_PKG}.node.firehose.gossip_producer", "producer",
+             "firehose gossip producer threads"),
+    RoleSeed(f"{_PKG}.node.firehose.closer", "producer",
+             "firehose end-of-stream closer thread"),
+    RoleSeed(f"{_PKG}.node.adversary.chain_driver", "producer",
+             "adversarial firehose honest chain driver"),
+    RoleSeed(f"{_PKG}.node.adversary.gossip_producer", "producer",
+             "adversarial firehose gossip producers"),
+    RoleSeed(f"{_PKG}.node.adversary.adv_chain", "producer",
+             "adversarial fork-branch producer"),
+    RoleSeed(f"{_PKG}.node.adversary.adv_junk", "producer",
+             "adversarial junk flood producer"),
+    RoleSeed(f"{_PKG}.node.adversary.closer", "producer",
+             "adversarial firehose closer thread"),
+    # producer-facing API: gossip readers enqueue from their own threads
+    RoleSeed(f"{_PKG}.node.ingest.IngestQueue.put", "producer",
+             "the multi-producer enqueue surface (node/ingest.py)"),
+    # the single-writer loop itself (usually the main thread serving)
+    RoleSeed(f"{_PKG}.node.service.Node.run_apply_loop", "apply-writer",
+             "THE single writer: fork choice + stf mutations"),
+    # telemetry substrate: declared callable from every role — counters,
+    # spans, and ring appends are the cross-thread instrumentation plane
+    RoleSeed(f"{_PKG}.telemetry.metrics.span", "any",
+             "spans time work on whichever thread runs it"),
+    RoleSeed(f"{_PKG}.telemetry.metrics.count", "any",
+             "counters increment from any thread"),
+    RoleSeed(f"{_PKG}.telemetry.timeline.begin", "any",
+             "timeline events carry their emitting thread's identity"),
+    RoleSeed(f"{_PKG}.telemetry.timeline.end", "any",
+             "timeline events carry their emitting thread's identity"),
+    RoleSeed(f"{_PKG}.telemetry.timeline.instant", "any",
+             "point events from any thread"),
+    RoleSeed(f"{_PKG}.telemetry.timeline.span", "any",
+             "context-manager spans from any thread"),
+    RoleSeed(f"{_PKG}.telemetry.timeline.next_link", "any",
+             "producers mint causality links at enqueue"),
+    RoleSeed(f"{_PKG}.telemetry.timeline.cancel_links", "any",
+             "drain paths cancel links from the unwinding thread"),
+    RoleSeed(f"{_PKG}.telemetry.recorder.record", "any",
+             "flight events from any thread"),
+    RoleSeed(f"{_PKG}.telemetry.histogram.observe", "any",
+             "latency observations from any thread"),
+)
+
+
+# the sanctioned ways work crosses a role boundary: producers hand items
+# to the apply loop through the queue, and any role reports through the
+# telemetry entry points.  Calls to a seam are never a TH01 hazard.
+HANDOFF_SEAMS: FrozenSet[str] = frozenset({
+    f"{_PKG}.node.ingest.IngestQueue.put",
+    f"{_PKG}.node.ingest.IngestQueue.get",
+    f"{_PKG}.node.ingest.IngestQueue.requeue_front",
+    f"{_PKG}.telemetry.metrics.span",
+    f"{_PKG}.telemetry.metrics.count",
+    f"{_PKG}.telemetry.timeline.begin",
+    f"{_PKG}.telemetry.timeline.end",
+    f"{_PKG}.telemetry.timeline.instant",
+    f"{_PKG}.telemetry.timeline.span",
+    f"{_PKG}.telemetry.timeline.next_link",
+    f"{_PKG}.telemetry.recorder.record",
+    f"{_PKG}.telemetry.histogram.observe",
+})
+
+
+# -- queries (rules and dataflow consult these dynamically) --------------------
+
+
+def role_for(qualname: Optional[str]) -> Optional[str]:
+    """The declared role of a spawn-target/entry qualname, if any."""
+    if not qualname:
+        return None
+    for seed in ROLE_SEEDS:
+        if seed.qualname == qualname:
+            return seed.role
+    return None
+
+
+def declared_lock_spellings() -> Dict[Tuple[str, str], str]:
+    """{(module, spelling): canonical lock name} over every bind."""
+    out: Dict[Tuple[str, str], str] = {}
+    for lock in LOCKS:
+        for b in lock.binds:
+            out[(lock.module, b)] = lock.name
+    return out
+
+
+def registry_errors() -> List[str]:
+    """Duplicate declarations: a lock name or spelling declared twice, a
+    structure global/attr claimed by two SharedSpecs of one module, or a
+    role qualname seeded twice.  ``make analyze`` exits non-zero on any."""
+    errors: List[str] = []
+    seen_locks: Dict[str, str] = {}
+    seen_binds: Dict[Tuple[str, str], str] = {}
+    for lock in LOCKS:
+        if lock.name in seen_locks:
+            errors.append(f"lock {lock.name!r} declared twice")
+        seen_locks[lock.name] = lock.module
+        for b in lock.binds:
+            key = (lock.module, b)
+            if key in seen_binds:
+                errors.append(
+                    f"lock spelling {b!r} in {lock.module} bound to both "
+                    f"{seen_binds[key]!r} and {lock.name!r}")
+            seen_binds[key] = lock.name
+    lock_names = {lock.name for lock in LOCKS}
+    seen_structs: Dict[Tuple[str, str], str] = {}
+    seen_spec_names: Dict[str, str] = {}
+    for spec in SHARED:
+        if spec.name in seen_spec_names:
+            errors.append(f"shared structure {spec.name!r} declared twice")
+        seen_spec_names[spec.name] = spec.module
+        if spec.lock is not None and spec.lock not in lock_names:
+            errors.append(f"shared structure {spec.name!r} names unknown "
+                          f"lock {spec.lock!r}")
+        for g in spec.module_globals | spec.instance_attrs:
+            key = (spec.module, g)
+            if key in seen_structs:
+                errors.append(
+                    f"structure {g!r} in {spec.module} claimed by both "
+                    f"{seen_structs[key]!r} and {spec.name!r}")
+            seen_structs[key] = spec.name
+    seen_seeds: Dict[str, str] = {}
+    for seed in ROLE_SEEDS:
+        if seed.qualname in seen_seeds:
+            errors.append(f"role seed {seed.qualname!r} declared twice")
+        seen_seeds[seed.qualname] = seed.role
+        if seed.role != "any" and seed.role not in ROLES:
+            errors.append(f"role seed {seed.qualname!r} names unknown "
+                          f"role {seed.role!r}")
+    return errors

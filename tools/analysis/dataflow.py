@@ -27,6 +27,21 @@ Plus two flat facts EF01 needs: which functions (transitively) route
 inserts through ``stf/staging`` (``note_insert``/``defer``), and which
 raw-insert into registered cache globals.
 
+ISSUE 15 adds the fifth family, **thread roles**: every function's
+executing-role set (pipeline-worker / producer / persist-writer /
+apply-writer; ``main`` is implicit everywhere), seeded at the
+concurrency registry's declared entries and the spawn targets pass 1
+discovered, and propagated DOWN the call graph (a role executes
+everything its entry function transitively calls) — through methods
+(``Class.method`` summaries) as well as plain functions.  Each
+(function, role) keeps its propagation parent, so TH01 names the chain
+that carried a role to a write site.  ``role_salt()`` digests the whole
+role assignment plus the lock-order edge set: the incremental cache
+folds it into every file's dependency digest, because role facts flow
+AGAINST import direction (a spawn site in ``stf/pipeline.py`` changes
+``telemetry/timeline.py``'s role set without being in its import
+closure).
+
 The graph also answers **dependencies(display)**: the transitive set of
 project files whose summaries can influence a file's findings — the
 incremental cache keys each file's findings on its own content hash AND
@@ -35,6 +50,8 @@ dependent file's findings (and nothing else).
 """
 from __future__ import annotations
 
+import hashlib
+import time
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .callgraph import FileSummary
@@ -78,7 +95,11 @@ class Project:
         self.staging_routers: Set[str] = set()
         self.raw_inserters: Dict[str, Set[str]] = {}
         self._deps_memo: Dict[str, Set[str]] = {}
+        # thread roles (ISSUE 15): key -> {role: parent key or None (seed)}
+        self.roles: Dict[str, Dict[str, Optional[str]]] = {}
+        self.role_pass_s: float = 0.0
         self._propagate()
+        self._propagate_roles()
 
     # -- resolution ----------------------------------------------------------
 
@@ -296,6 +317,129 @@ class Project:
                             mine |= new
                             changed = True
         self.raw_inserters = {k: v for k, v in self.raw_inserters.items() if v}
+
+    # -- thread roles (ISSUE 15) ---------------------------------------------
+
+    def resolve_callable(self, display: str, dotted: Optional[str]) -> Optional[str]:
+        """Canonical key for a call target that names a project function
+        (top-level OR nested — the firehose producers are nested in
+        their runner) or method (``pkg.node.ingest.IngestQueue.put``);
+        None otherwise."""
+        key = self.resolve_function(self.qualify(display, dotted))
+        if key is not None:
+            return key
+        dotted = self.qualify(display, dotted)
+        if not dotted:
+            return None
+        d = dotted.lstrip(".")
+        head, _, meth = d.rpartition(".")
+        summary = self.modules.get(head)
+        if summary is not None and meth in summary.nested:
+            return d
+        mod, _, cls = head.rpartition(".")
+        if not mod:
+            return None
+        summary = self.modules.get(mod)
+        if summary is not None and f"{cls}.{meth}" in summary.methods:
+            return d
+        return None
+
+    def _callable_summary(self, key: str):
+        """The FuncSummary behind a canonical function/method/nested-def
+        key."""
+        mod, _, func = key.rpartition(".")
+        summary = self.modules.get(mod)
+        if summary is not None:
+            if func in summary.functions:
+                return summary, summary.functions[func]
+            if func in summary.nested:
+                return summary, summary.nested[func]
+        mod2, _, cls = mod.rpartition(".")
+        summary = self.modules.get(mod2)
+        if summary is not None and f"{cls}.{func}" in summary.methods:
+            return summary, summary.methods[f"{cls}.{func}"]
+        return None, None
+
+    def roles_of(self, display: str, qualname: Optional[str]) -> Dict[str, Optional[str]]:
+        """{role: parent key} for a function/method qualname (empty when
+        no role reaches it — implicitly main-only)."""
+        if not qualname:
+            return {}
+        key = self.qualify(display, qualname) or qualname
+        return self.roles.get(key.lstrip("."), {})
+
+    def role_chain(self, key: str, role: str) -> List[str]:
+        """Seed-to-sink key chain that carried ``role`` to ``key``."""
+        chain = [key]
+        seen = {key}
+        while True:
+            parent = self.roles.get(chain[0], {}).get(role)
+            if parent is None or parent in seen:
+                return chain
+            seen.add(parent)
+            chain.insert(0, parent)
+
+    def role_salt(self) -> str:
+        """Digest of the whole role assignment (keys, roles, parents)
+        plus the lock-order edge set — the facts that flow against
+        import direction, folded into every file's cache digest."""
+        h = hashlib.sha256()
+        for key in sorted(self.roles):
+            for role in sorted(self.roles[key]):
+                parent = self.roles[key][role] or ""
+                h.update(f"{key}|{role}|{parent};".encode())
+        edges = set()
+        for summary in self.files.values():
+            for outer, inner, _ in summary.lock_edges:
+                edges.add((outer, inner))
+        for outer, inner in sorted(edges):
+            h.update(f"{outer}->{inner};".encode())
+        return h.hexdigest()
+
+    def _propagate_roles(self) -> None:
+        from . import concurrency_registry as creg
+
+        t0 = time.perf_counter()
+
+        def add(key: Optional[str], role: str,
+                parent: Optional[str]) -> bool:
+            if not key:
+                return False
+            key = key.lstrip(".")
+            holders = self.roles.setdefault(key, {})
+            if role in holders:
+                return False
+            holders[role] = parent
+            return True
+
+        work: List[str] = []
+        for seed in creg.ROLE_SEEDS:
+            roles = (sorted(creg.SPAWNED_ROLES) if seed.role == "any"
+                     else [seed.role])
+            for role in roles:
+                if add(seed.qualname, role, None):
+                    work.append(seed.qualname)
+        for summary in self.files.values():
+            for _, _, target in summary.spawn_sites:
+                role = creg.role_for(target)
+                if role is not None and add(target, role, None):
+                    work.append(target)
+
+        while work:
+            key = work.pop().lstrip(".")
+            pair = self._callable_summary(key)
+            summary, fn = pair
+            if fn is None:
+                continue
+            for call in fn.calls:
+                callee = self.resolve_callable(summary.display, call)
+                if callee is None or callee == key:
+                    continue
+                for role, _ in list(self.roles.get(key, {}).items()):
+                    if add(callee, role, key):
+                        if callee not in work:
+                            work.append(callee)
+        self.role_pass_s = time.perf_counter() - t0
 
     @staticmethod
     def _is_staging_call(dotted: str) -> bool:

@@ -12,7 +12,9 @@ from . import (  # noqa: F401
     io_safety,
     jit_purity,
     key_coverage,
+    lock_discipline,
     observability,
+    thread_roles,
     rollback,
     sharding_contract,
 )

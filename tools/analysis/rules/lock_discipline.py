@@ -1,0 +1,219 @@
+"""LK01 — lock discipline over the registered locks.
+
+TH01 checks *what* the locks protect; this rule checks *how* the locks
+themselves are used.  The hazards are the classic lockset ones:
+
+* **acquire outside ``with``** — a bare ``lock.acquire()`` splits the
+  acquire from its release across control flow the analyzer (and the
+  next reader) cannot pair; every registered lock is taken with a
+  ``with`` statement, or carries a ``# thread-safe: <why>`` annotation
+  naming why not (the node's non-blocking single-writer probe is the
+  one sanctioned live case);
+* **a blocking call while holding a registered lock** — queue
+  ``put``/``join``/``sleep``/future ``result`` and the native batch
+  entries can wait indefinitely; under a lock they stall every other
+  thread that needs it (and a blocked ``put`` under the lock its
+  consumer needs is a deadlock, not a stall).  ``Condition.wait`` is
+  NOT flagged — waiting releases the lock, that is the idiom.  The
+  check is lexical (the ``with`` body), matching how the tree takes
+  locks: short critical sections, never across calls that block;
+* **an acquisition order that inverts an observed order** — pass 1
+  records every lexical ``with B:`` inside ``with A:`` as an edge
+  A -> B, identities canonicalized through the registry (a Condition
+  sharing a Lock is ONE identity).  A file whose edge B -> A inverts an
+  edge A -> B observed anywhere in the tree is a static deadlock smell,
+  flagged at the inner acquisition with the other site named;
+* **an undeclared lock construction** — the completeness half: every
+  ``threading.Lock``/``RLock``/``Condition`` built in production code
+  (module global, ``self.X`` in ``__init__``, or function-local) must
+  map to a LockSpec in ``tools/analysis/concurrency_registry.py``, so
+  the registry stays the one true map of the tree's locks.
+
+``# thread-safe: <why>`` (non-empty justification) sanctions a line,
+``# noqa: LK01`` suppresses as everywhere.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Tuple
+
+from ..core import Rule, register
+from ..dataflow import project_for as _project_for
+from .thread_roles import annotated_lines, enclosing_class
+
+# calls that can block indefinitely: thread/queue waits and the native
+# multi-pairing entries.  `.get`/`.wait` stay legal: dict.get is
+# everywhere, and Condition.wait RELEASES the held lock (the idiom).
+_BLOCKING_TAILS = {"join", "sleep", "put", "result", "first_invalid",
+                   "settle", "BatchFastAggregateVerify",
+                   "BatchFastAggregateVerifyFlat", "G2MSM"}
+
+
+@register
+class LockDisciplineRule(Rule):
+    """Registered locks acquired outside ``with``, blocking calls under
+    a held lock, inverted acquisition orders, undeclared locks."""
+
+    code = "LK01"
+    summary = "lock-discipline violation on a registered lock"
+
+    def check(self, ctx):
+        if ctx.tree is None or "consensus_specs_tpu" not in ctx.parts:
+            return
+        if ctx.in_dir("specs", "tests", "testing", "vendor", "gen",
+                      "debug"):
+            return
+        from .. import concurrency_registry as creg
+        from ..callgraph import (instance_lock_attrs, is_lock_factory,
+                                 lock_identity, module_name_for)
+
+        sym = ctx.symbols
+        module = module_name_for(ctx.display)
+        declared = creg.declared_lock_spellings()
+        # a file that neither imports threading nor owns a declared lock
+        # can construct no lock identity: nothing here to check
+        if not (any("threading" in d for d in sym.imports.values())
+                or any(m == module for m, _ in declared)):
+            return
+        proj = _project_for(ctx)
+        inst_locks = instance_lock_attrs(ctx.tree, sym)
+        annotated = annotated_lines(ctx.lines)
+
+        yield from self._undeclared_constructions(
+            ctx, sym, module, declared, annotated, is_lock_factory)
+        yield from self._acquire_outside_with(
+            ctx, sym, module, inst_locks, declared, annotated,
+            lock_identity)
+        yield from self._blocking_under_lock(
+            ctx, sym, module, inst_locks, declared, annotated,
+            lock_identity)
+        yield from self._order_inversions(ctx, proj, annotated)
+
+    # -- completeness: every lock construction is declared --------------------
+
+    def _undeclared_constructions(self, ctx, sym, module, declared,
+                                  annotated, is_lock_factory):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            if not (isinstance(node.value, ast.Call)
+                    and is_lock_factory(sym.resolve(node.value.func))):
+                continue
+            if node.lineno in annotated:
+                continue
+            spelling = self._binding_spelling(node.targets[0], sym, node)
+            if spelling is None:
+                continue
+            if (module, spelling) in declared:
+                continue
+            yield (node.lineno,
+                   f"lock {spelling!r} is not in the concurrency "
+                   "registry — add a LockSpec (with every acquiring "
+                   "spelling) to tools/analysis/concurrency_registry.py "
+                   "so TH01/LK01 can check its discipline")
+
+    @staticmethod
+    def _binding_spelling(target, sym, node) -> Optional[str]:
+        if isinstance(target, ast.Name):
+            return target.id  # module global or function-local
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id in ("self", "cls")):
+            cur = sym.parent.get(node)
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    return f"{cur.name}.{target.attr}"
+                cur = sym.parent.get(cur)
+        return None
+
+    # -- acquire outside with -------------------------------------------------
+
+    def _acquire_outside_with(self, ctx, sym, module, inst_locks, declared,
+                              annotated, lock_identity):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                continue
+            if node.lineno in annotated:
+                continue
+            fn = sym.enclosing_function(node)
+            scope = sym.scope_info(fn)
+            cls = enclosing_class(sym, node)
+            ident = lock_identity(node.func.value, module, cls, inst_locks,
+                                  sym, scope, declared)
+            if ident is None:
+                continue
+            yield (node.lineno,
+                   f"lock '{ident}' acquired outside `with` — a bare "
+                   "acquire splits lock and release across control flow; "
+                   "use the with-statement or annotate "
+                   "`# thread-safe: <why>`")
+
+    # -- blocking calls while holding a lock ----------------------------------
+
+    def _blocking_under_lock(self, ctx, sym, module, inst_locks, declared,
+                             annotated, lock_identity):
+        def visit(node, cls, scope_node, held):
+            for child in ast.iter_child_nodes(node):
+                c, s, h = cls, scope_node, held
+                if isinstance(child, ast.ClassDef):
+                    c = child.name
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    s = child
+                    h = ()  # a nested def runs later, not under the lock
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    scope = sym.scope_info(s)
+                    ids = [lock_identity(i.context_expr, module, c,
+                                         inst_locks, sym, scope, declared)
+                           for i in child.items]
+                    ids = [i for i in ids if i is not None]
+                    if ids:
+                        h = h + tuple(ids)
+                elif (isinstance(child, ast.Call) and h
+                        and child.lineno not in annotated):
+                    tail = self._call_tail(child, sym)
+                    if tail in _BLOCKING_TAILS:
+                        yield (child.lineno,
+                               f"blocking call .{tail}() while holding "
+                               f"lock '{h[-1]}' — every thread needing "
+                               "the lock stalls behind this wait; move "
+                               "the call outside the critical section "
+                               "or annotate `# thread-safe: <why>`")
+                yield from visit(child, c, s, h)
+
+        yield from visit(ctx.tree, None, None, ())
+
+    @staticmethod
+    def _call_tail(call, sym) -> Optional[str]:
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        dotted = sym.resolve(call.func)
+        return dotted.rsplit(".", 1)[-1] if dotted else None
+
+    # -- cross-file acquisition-order inversions ------------------------------
+
+    def _order_inversions(self, ctx, proj, annotated):
+        if proj is None or not hasattr(proj, "files"):
+            return
+        summary = proj.files.get(ctx.display)
+        if summary is None:
+            return
+        observed: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for s in proj.files.values():
+            for outer, inner, lineno in s.lock_edges:
+                observed.setdefault((outer, inner), (s.display, lineno))
+        reported = set()
+        for outer, inner, lineno in summary.lock_edges:
+            if lineno in annotated or (outer, inner) in reported:
+                continue
+            other = observed.get((inner, outer))
+            if other is None:
+                continue
+            reported.add((outer, inner))
+            yield (lineno,
+                   f"lock order '{outer}' -> '{inner}' inverts the order "
+                   f"observed at {other[0]}:{other[1]} — two threads "
+                   "taking these locks in opposite orders can deadlock; "
+                   "pick one order tree-wide")

@@ -1,0 +1,430 @@
+"""TH01 — thread-role dataflow: shared state is written only by the
+roles and locks the concurrency registry declares.
+
+Three of the last six PRs shipped a hand-found cross-thread bug: PR 9's
+shared span-nesting stack cross-contaminated under concurrent threads,
+and PR 14's background checkpoint writer recorded its index insert into
+the *apply thread's* open block transaction.  The threading contract
+those fixes restored ("single-writer apply loop", "the writer thread
+never rides staging", "telemetry takes its lock") lived in prose; this
+rule checks it.  Pass 1 learns the thread-spawn seams, ``dataflow``
+propagates each function's executing-role set to a fixed point, and the
+registry (``tools/analysis/concurrency_registry.py``) declares every
+shared mutable structure.  TH01 flags, in production modules:
+
+* **an unguarded write to a lock-guarded structure** — any mutation
+  (subscript/augmented assign, rebind, delete, append/pop/update/...)
+  of a registered structure outside a ``with`` of its declared lock
+  (condition aliases and context-manager helpers count; functions the
+  registry documents as caller-holds-lock are pardoned, as is
+  ``__init__`` — the object is not shared yet);
+* **a role-confined structure touched from a foreign role** — the block
+  cache transaction, the apply journal, the in-flight speculation queue
+  belong to the apply thread; a write (or a call to a confined entry
+  point like ``staging.note_insert``) from a function a spawned role
+  reaches is flagged with the role-propagation chain named;
+* **an undeclared module-global mutated in spawned-role code** — a
+  function a spawned role reaches that mutates a module global the
+  registry does not know, outside any lock: exactly PR 9's shared-stack
+  shape, caught before it has a name;
+* **a thread-spawn site whose target has no declared role** — the
+  registry-completeness half: a new ``threading.Thread``/pool ``submit``
+  in production code must map to a declared role or the gate turns red.
+
+The escape hatch is a positive annotation — ``# thread-safe: <why>`` on
+the flagged line (or a standalone comment directly above) with a
+non-empty justification, the OB01/HD01 shape; ``# noqa: TH01`` works as
+everywhere.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Optional, Set
+
+from ..core import Rule, register
+from ..dataflow import project_for as _project_for
+from ..symbols import module_matches, root_name, written_targets
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_ANNOT_RE = re.compile(r"#\s*thread-safe:\s*\S")
+_PKG_PREFIX = "consensus_specs_tpu."
+
+# every container mutation counts: unlike CC01, removal also races —
+# a concurrent pop against an unguarded append corrupts the structure
+_MUTATING_METHODS = {"append", "appendleft", "extend", "extendleft",
+                     "insert", "update", "setdefault", "pop", "popleft",
+                     "popitem", "clear", "remove", "discard", "add",
+                     "move_to_end"}
+
+
+def _short(key: str) -> str:
+    return key[len(_PKG_PREFIX):] if key.startswith(_PKG_PREFIX) else key
+
+
+def enclosing_class(sym, node) -> Optional[str]:
+    """Name of the lexically enclosing class, if any (shared with
+    LK01)."""
+    cur = sym.parent.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = sym.parent.get(cur)
+    return None
+
+
+def annotated_lines(lines) -> Set[int]:
+    """Lines sanctioned by ``# thread-safe: <why>`` (trailing, or a
+    standalone comment block covering the first statement below — the
+    IO01/HD01 shape)."""
+    declared: Set[int] = set()
+    for i, line in enumerate(lines, 1):
+        if not _ANNOT_RE.search(line):
+            continue
+        declared.add(i)
+        if line.lstrip().startswith("#"):
+            j = i + 1
+            while j <= len(lines) and lines[j - 1].lstrip().startswith("#"):
+                j += 1
+            declared.add(j)
+    return declared
+
+
+@register
+class ThreadRolesRule(Rule):
+    """Shared-structure writes without the registered lock, confined
+    structures touched from a foreign role, undeclared shared globals
+    mutated in spawned-role code, and undeclared spawn targets."""
+
+    code = "TH01"
+    summary = "thread-role / shared-state discipline violation"
+
+    def check(self, ctx):
+        if ctx.tree is None or "consensus_specs_tpu" not in ctx.parts:
+            return
+        if ctx.in_dir("specs", "tests", "testing", "vendor", "gen",
+                      "debug"):
+            return
+        from .. import concurrency_registry as creg
+        from ..callgraph import (instance_lock_attrs, lock_identity,
+                                 module_name_for)
+
+        sym = ctx.symbols
+        proj = _project_for(ctx)
+        module = module_name_for(ctx.display)
+        declared = creg.declared_lock_spellings()
+        inst_cache: list = []
+
+        def inst_locks_lazy():
+            if not inst_cache:
+                inst_cache.append(instance_lock_attrs(ctx.tree, sym))
+            return inst_cache[0]
+
+        annotated = annotated_lines(ctx.lines)
+        mod_scope = sym.scope_info(None)
+        specs = list(creg.SHARED)
+        lock_by_name = {lk.name: lk for lk in creg.LOCKS}
+        fn_keys = self._function_keys(ctx.tree, module)
+        # fast-path vocab: a receiver that can't name ANY spec skips the
+        # per-node scope/global machinery entirely
+        owned_globals = {g for s in specs if s.module == module
+                         for g in s.module_globals}
+        alias_globals = {g for s in specs for g in s.module_globals}
+        attr_tails = {a.rsplit(".", 1)[-1] for s in specs
+                      for a in s.instance_attrs}
+        self._global_decl_memo = {}
+        summary = (proj.files.get(ctx.display)
+                   if proj is not None and hasattr(proj, "files") else None)
+
+        def roles_at(node) -> Dict[str, str]:
+            """{role: carrying key} merged over the enclosing functions
+            (a nested def executes in its outer function's role too)."""
+            merged: Dict[str, str] = {}
+            if proj is None or not hasattr(proj, "roles"):
+                return merged
+            for fn in sym.enclosing_functions(node):
+                key = fn_keys.get(fn, f"{module}.{fn.name}")
+                for role in proj.roles.get(key, {}):
+                    merged.setdefault(role, key)
+            return merged
+
+        def guarded_by(node, lock_name: str) -> bool:
+            # the walk stops at the enclosing def: a `with` in an OUTER
+            # function does not guard a closure that runs later
+            cur = sym.parent.get(node)
+            fn = sym.enclosing_function(node)
+            scope = sym.scope_info(fn)
+            cls = enclosing_class(sym, node)
+            while cur is not None and not isinstance(cur, _FUNC_NODES):
+                if isinstance(cur, (ast.With, ast.AsyncWith)):
+                    for item in cur.items:
+                        if lock_identity(item.context_expr, module, cls,
+                                         inst_locks_lazy(), sym, scope,
+                                         declared) == lock_name:
+                            return True
+                cur = sym.parent.get(cur)
+            return False
+
+        def under_any_lock(node) -> bool:
+            cur = sym.parent.get(node)
+            fn = sym.enclosing_function(node)
+            scope = sym.scope_info(fn)
+            cls = enclosing_class(sym, node)
+            while cur is not None and not isinstance(cur, _FUNC_NODES):
+                if isinstance(cur, (ast.With, ast.AsyncWith)):
+                    for item in cur.items:
+                        if lock_identity(item.context_expr, module, cls,
+                                         inst_locks_lazy(), sym, scope,
+                                         declared) is not None:
+                            return True
+                cur = sym.parent.get(cur)
+            return False
+
+        def chain_text(roles: Dict[str, str]) -> str:
+            parts = []
+            for role in sorted(roles):
+                chain = proj.role_chain(roles[role], role)
+                parts.append(f"{role}: "
+                             + " -> ".join(_short(k) for k in chain))
+            return "; ".join(parts)
+
+        # -- writes ----------------------------------------------------------
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign, ast.Delete, ast.Call)):
+                continue
+            fn = sym.enclosing_function(node)
+            if fn is None:
+                continue  # module-scope statements initialize, not race
+            if node.lineno in annotated:
+                continue
+            for kind, expr, method in written_targets(node):
+                if kind == "method" and method not in _MUTATING_METHODS:
+                    continue
+                receiver, is_mutation = self._receiver(kind, expr, method)
+                if receiver is None:
+                    continue
+                if isinstance(receiver, ast.Attribute):
+                    if (receiver.attr not in alias_globals
+                            and receiver.attr not in attr_tails):
+                        continue  # can't name any spec; undeclared path
+                        # never looks at attributes either
+                elif isinstance(receiver, ast.Name):
+                    if not is_mutation and receiver.id not in owned_globals:
+                        continue  # a rebind can only hit an owned global
+                else:
+                    continue
+                spec = self._match_spec(receiver, sym, module, specs,
+                                        mod_scope, node, is_mutation, fn)
+                if spec is not None:
+                    if (fn.name == "__init__"
+                            and isinstance(receiver, ast.Attribute)
+                            and isinstance(receiver.value, ast.Name)
+                            and receiver.value.id in ("self", "cls")):
+                        # construction: THIS object is not shared yet —
+                        # registered module globals stay checked even
+                        # inside an __init__ (any thread may construct)
+                        continue
+                    fn_key = fn_keys.get(fn, f"{module}.{fn.name}")
+                    yield from self._check_registered(
+                        node, fn, fn_key, spec, lock_by_name, guarded_by,
+                        roles_at, chain_text, creg)
+                elif is_mutation:
+                    yield from self._check_undeclared(
+                        node, fn, receiver, sym, mod_scope, roles_at,
+                        under_any_lock, chain_text, creg)
+
+        # -- confined entry points (the PR 14 writer/staging shape) ----------
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or node.lineno in annotated:
+                continue
+            dotted = sym.resolve(node.func)
+            if dotted is None:
+                continue
+            qualified = (proj.qualify(ctx.display, dotted)
+                         if proj is not None and hasattr(proj, "qualify")
+                         else dotted) or dotted
+            qualified = qualified.lstrip(".")
+            if qualified in creg.HANDOFF_SEAMS:
+                continue
+            for spec in specs:
+                if qualified not in spec.entrypoints:
+                    continue
+                roles = roles_at(node)
+                foreign = (set(roles) & creg.SPAWNED_ROLES) - spec.roles
+                if not foreign:
+                    continue
+                yield (node.lineno,
+                       f"call into the {spec.name} "
+                       f"({_short(qualified)}) from foreign role(s) "
+                       f"{'/'.join(sorted(foreign))} — it belongs to the "
+                       f"apply thread ({chain_text({r: roles[r] for r in foreign})}); "
+                       "hand work across roles through a declared seam "
+                       "or annotate `# thread-safe: <why>`")
+
+        # -- spawn-site completeness -----------------------------------------
+        if summary is not None:
+            for lineno, api, target in summary.spawn_sites:
+                if lineno in annotated:
+                    continue
+                if target is None:
+                    yield (lineno,
+                           f"thread-spawn site ({api}) whose target the "
+                           "analyzer cannot resolve — name the role: "
+                           "declare the target in concurrency_registry."
+                           "ROLE_SEEDS or annotate `# thread-safe: <why>`")
+                elif creg.role_for(target) is None:
+                    yield (lineno,
+                           f"thread-spawn target {_short(target)} has no "
+                           "declared role — add a RoleSeed to tools/"
+                           "analysis/concurrency_registry.py so the "
+                           "role dataflow can follow this thread")
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _function_keys(tree, module: str):
+        keys = {}
+        for n in tree.body:
+            if isinstance(n, _FUNC_NODES):
+                keys[n] = f"{module}.{n.name}"
+            elif isinstance(n, ast.ClassDef):
+                for m in n.body:
+                    if isinstance(m, _FUNC_NODES):
+                        keys[m] = f"{module}.{n.name}.{m.name}"
+        return keys
+
+    @staticmethod
+    def _receiver(kind, expr, method):
+        """(receiver expression, is_container_mutation) for one write
+        shape; rebinds return the target itself with is_mutation False
+        (a plain global rebind is only checked when registered)."""
+        if kind == "method":
+            return expr, True
+        if isinstance(expr, ast.Subscript):
+            return expr.value, True
+        if kind == "augassign":
+            return expr, True
+        if kind == "delete":
+            return (expr.value, True) if isinstance(expr, ast.Subscript) \
+                else (expr, False)
+        return expr, False
+
+    def _match_spec(self, receiver, sym, module, specs, mod_scope, node,
+                    is_mutation, fn):
+        """The SharedSpec a receiver denotes: owner-module bare name
+        (through local alias chains for container mutations), a
+        module-alias attribute from any file, or a registered instance
+        attribute.  A plain Name REBIND only matches the global itself
+        under a ``global`` declaration — ``txn = _TXN`` binds a local
+        alias, it does not write the structure."""
+        if isinstance(receiver, ast.Name):
+            scope = sym.scope_of(node)
+            if is_mutation:
+                resolved = scope.resolve_root(receiver.id)
+            else:
+                if not self._declared_global(fn, receiver.id):
+                    return None
+                resolved = receiver.id
+            for spec in specs:
+                if module == spec.module and resolved in spec.module_globals:
+                    return spec
+            return None
+        if not isinstance(receiver, ast.Attribute):
+            return None
+        for spec in specs:
+            if (receiver.attr in spec.module_globals and module_matches(
+                    sym.resolve(receiver.value), spec.module)):
+                return spec
+            attr_tails = {a.rsplit(".", 1)[-1] for a in spec.instance_attrs}
+            if receiver.attr in attr_tails:
+                if (isinstance(receiver.value, ast.Name)
+                        and receiver.value.id in ("self", "cls")):
+                    cls = enclosing_class(sym, node)
+                    if (module == spec.module and cls
+                            and f"{cls}.{receiver.attr}"
+                            in spec.instance_attrs):
+                        return spec
+                elif module == spec.module:
+                    # non-self receiver in the owner module (the
+                    # recover path's ``node._journal`` shape)
+                    return spec
+        return None
+
+    def _check_registered(self, node, fn, fn_key, spec, lock_by_name,
+                          guarded_by, roles_at, chain_text, creg):
+        if spec.lock is not None:
+            # the pardon is qualified: holders are spellings relative to
+            # the spec's OWNER module — a same-named function elsewhere
+            # (or on another class) earns no exemption
+            if any(fn_key == f"{spec.module}.{h}"
+                   for h in spec.lock_holders):
+                return
+            if guarded_by(node, spec.lock):
+                return
+            lock = lock_by_name.get(spec.lock)
+            spellings = "/".join(sorted(lock.binds)) if lock else spec.lock
+            roles = roles_at(node)
+            role_note = (f" (reachable from {chain_text(roles)})"
+                         if set(roles) & creg.SPAWNED_ROLES else "")
+            yield (node.lineno,
+                   f"write to the {spec.name} without holding its "
+                   f"registered lock ({spellings}){role_note} — wrap it "
+                   "in `with` of that lock, register the function as a "
+                   "lock-holder, or annotate `# thread-safe: <why>`")
+        else:
+            if fn_key in spec.entrypoints:
+                return  # the boundary CALL is flagged, not the interior
+            roles = roles_at(node)
+            foreign = (set(roles) & creg.SPAWNED_ROLES) - spec.roles
+            if foreign:
+                yield (node.lineno,
+                       f"the {spec.name} is role-confined but this write "
+                       f"is reachable from foreign role(s) "
+                       f"{'/'.join(sorted(foreign))} "
+                       f"({chain_text({r: roles[r] for r in foreign})}) — "
+                       "route the handoff through a declared seam or "
+                       "annotate `# thread-safe: <why>`")
+
+    def _check_undeclared(self, node, fn, receiver, sym, mod_scope,
+                          roles_at, under_any_lock, chain_text, creg):
+        base = (receiver.id if isinstance(receiver, ast.Name)
+                else root_name(receiver))
+        if base is None or isinstance(receiver, ast.Attribute):
+            return
+        scope = sym.scope_of(node)
+        resolved = scope.resolve_root(base)
+        if resolved in scope.params:
+            return
+        if resolved not in mod_scope.assigned:
+            return  # not a module global of this file
+        if resolved in scope.assigned and resolved == base \
+                and not self._declared_global(fn, resolved):
+            return  # a local shadowing the module name
+        origin = mod_scope.origins.get(resolved)
+        if origin and "threading" in origin:
+            return  # thread-local / lock objects are safe by nature
+        roles = roles_at(node)
+        spawned = set(roles) & creg.SPAWNED_ROLES
+        if not spawned:
+            return
+        if under_any_lock(node):
+            return
+        yield (node.lineno,
+               f"mutation of undeclared module global '{resolved}' in "
+               f"code reachable from spawned role(s) "
+               f"{'/'.join(sorted(spawned))} "
+               f"({chain_text({r: roles[r] for r in spawned})}) — declare "
+               "it in concurrency_registry.SHARED with a lock or owning "
+               "role, make it thread-local, or annotate "
+               "`# thread-safe: <why>`")
+
+    _global_decl_memo: dict = {}
+
+    def _declared_global(self, fn, name: str) -> bool:
+        names = self._global_decl_memo.get(fn)
+        if names is None:
+            names = self._global_decl_memo[fn] = {
+                n for g in ast.walk(fn) if isinstance(g, ast.Global)
+                for n in g.names}
+        return name in names

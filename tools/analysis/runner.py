@@ -128,6 +128,9 @@ class Result:
     n_files: int = 0
     cache_hits: int = 0
     duration_s: float = 0.0
+    # wall time of the thread-role fixed point (ISSUE 15): the one pass
+    # that runs warm or cold, so its budget is watched separately
+    role_pass_s: float = 0.0
     # per-rule wall time + unsuppressed finding counts over the files
     # actually analyzed this run (cache hits skip rule execution)
     rule_stats: Dict[str, dict] = field(default_factory=dict)
@@ -141,6 +144,7 @@ class Result:
             "files_analyzed": self.n_files,
             "cache_hits": self.cache_hits,
             "duration_s": round(self.duration_s, 3),
+            "role_pass_s": round(self.role_pass_s, 4),
             "rule_stats": {
                 code: {"time_s": round(s["time_s"], 4),
                        "findings": s["findings"]}
@@ -247,9 +251,14 @@ def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
     # the dependency digest folds in everything outside the file's own
     # bytes that can influence its findings: the shas of its transitive
     # import closure, plus the project-wide mesh-axis vocabulary SH01
-    # reads regardless of imports
+    # reads regardless of imports, plus the thread-role assignment and
+    # lock-order edges (ISSUE 15) — role facts flow AGAINST import
+    # direction (a spawn site in a caller changes the callee's role
+    # set), so they must salt every file's key
     shas = {e.display: e.digest for e in entries}
-    axis_salt = ",".join(sorted(project.mesh_axis_names()))
+    axis_salt = (",".join(sorted(project.mesh_axis_names()))
+                 + "|" + project.role_salt())
+    result.role_pass_s = project.role_pass_s
 
     def deps_digest(display: str) -> str:
         h = hashlib.sha256(axis_salt.encode())

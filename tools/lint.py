@@ -6,8 +6,9 @@ installs are barred).
 All checking lives in ``tools/analysis/``: a rule-plugin registry
 (hygiene codes E501/E999/W191/W291/W605/F401/B001/B006 plus the
 engine-invariant rules FC01/ST01/CC01/CC02/RB01/JX01/DT01 and the
-interprocedural device-boundary rules HD01/SH01/EF01 riding on the
-two-pass call-graph core), per-code ``# noqa`` suppression, a reviewed
+interprocedural rules HD01/SH01/EF01/OB01/IO01 plus the concurrency
+pair TH01/LK01 riding on the two-pass call-graph core with its
+thread-role fact family), per-code ``# noqa`` suppression, a reviewed
 baseline for grandfathered findings (tools/analysis/baseline.json), and
 a dependency-aware content-hash incremental cache.
 This wrapper keeps the historical interface: ``python tools/lint.py
@@ -51,6 +52,18 @@ def main(argv):
     no_cache = "--no-cache" in args
     if no_cache:
         args.remove("--no-cache")
+
+    # a duplicate lock/role/structure declaration means two rules could
+    # disagree about the same object: refuse the whole run (exit 2)
+    from analysis.concurrency_registry import registry_errors
+
+    errors = registry_errors()
+    if errors:
+        for e in errors:
+            print(f"concurrency registry error: {e}")
+        print(f"lint: {len(errors)} duplicate/invalid concurrency-registry "
+              "declaration(s) — fix tools/analysis/concurrency_registry.py")
+        return 2
 
     result = _runner.run(
         [Path(a) for a in args] if args else None,
