@@ -215,7 +215,8 @@ def assert_parity(spec, node: Node, ref) -> dict:
 def run_firehose(spec, anchor_state, corpus: FirehoseCorpus,
                  n_gossip_producers: int = 3, queue_cap: int = 64,
                  gossip_batch: int = 512,
-                 producer_timeout: float = 300.0, **node_kwargs) -> dict:
+                 producer_timeout: float = 300.0, on_node=None,
+                 **node_kwargs) -> dict:
     """Serve ``corpus`` through a fresh ``Node`` under concurrent load:
     1 chain driver + ``n_gossip_producers`` gossip threads enqueue, the
     calling thread runs the single-writer apply loop.  Extra keyword
@@ -228,6 +229,10 @@ def run_firehose(spec, anchor_state, corpus: FirehoseCorpus,
     sps = int(spec.config.SECONDS_PER_SLOT)
     node = Node(spec, anchor_state, corpus.anchor_block,
                 queue_cap=queue_cap, **node_kwargs)
+    if on_node is not None:
+        # observer hook, invoked before any producer starts: the
+        # query-load harness attaches its reader threads here
+        on_node(node)
 
     slots = sorted(corpus.gossip)
     remaining_by_epoch: Dict[int, int] = {}

@@ -68,6 +68,7 @@ from typing import Optional, Sequence
 from consensus_specs_tpu import faults, telemetry
 from consensus_specs_tpu.forkchoice import ForkChoiceEngine
 from consensus_specs_tpu.persist import store as persist_store
+from consensus_specs_tpu.query.engine import QueryEngine
 from consensus_specs_tpu.stf import apply_signed_blocks
 from consensus_specs_tpu.telemetry import recorder, timeline
 
@@ -251,6 +252,13 @@ class Node:
         self._spe = int(spec.SLOTS_PER_EPOCH)
         self._ckpt_epoch_seen = \
             int(spec.get_current_slot(store)) // self._spe
+        # the historical read path (ISSUE 16): a query engine over the
+        # same store's artifacts, exposed beside the apply loop —
+        # reader threads serve off verified artifact mmaps and
+        # engine-owned caches, never off this node's fork-choice store
+        # (the TH01 "query-reader" role wall)
+        self.query_engine = (QueryEngine(spec, checkpoint_store)
+                             if checkpoint_store is not None else None)
         if adopt_admission:
             admission.reset_state()
 

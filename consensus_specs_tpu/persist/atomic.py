@@ -157,16 +157,8 @@ def verify_buffer(path: str, raw, kind: str, tag: str = "",
     """Verify one envelope held in any buffer (bytes, or an mmap so the
     digest pass streams over mapped pages without a heap copy) and
     return its payload as bytes.  Same ladder as ``read_artifact``."""
-    if faults.active_plan() is not None:
-        # the disk-damage probe: under an armed plan, materialize the
-        # buffer so a `corrupt` rule can flip a byte the way bit rot
-        # would — disabled (the normal path) this costs nothing
-        raw = _SITE_READ(bytes(raw))
-    kind_found, tag_found, payload = _split(path, raw)
-    if kind_found != kind or tag_found != tag:
-        raise ArtifactStaleTag(
-            f"{path}: kind/tag ({kind_found!r}, {tag_found!r}) != "
-            f"expected ({kind!r}, {tag!r})")
+    buf, start, stop = payload_bounds(path, raw, kind, tag)
+    payload = bytes(buf[start:stop])
     if (expected_payload_len is not None
             and len(payload) != expected_payload_len):
         raise ArtifactCorrupt(
@@ -175,8 +167,29 @@ def verify_buffer(path: str, raw, kind: str, tag: str = "",
     return payload
 
 
-def _split(path: str, raw) -> Tuple[str, str, bytes]:
-    """Parse + digest-verify one envelope; (kind, tag, payload bytes)."""
+def payload_bounds(path: str, raw, kind: str, tag: str = ""):
+    """``verify_buffer`` without the payload copy: verify the envelope
+    and return ``(buf, start, stop)`` so the caller can serve straight
+    off ``buf[start:stop]`` — the query engine's zero-copy read path
+    over an mmap'd artifact.  ``buf`` is ``raw`` itself except under an
+    armed fault plan, where the damage probe materializes the buffer
+    first (the returned bounds always index the returned buffer)."""
+    if faults.active_plan() is not None:
+        # the disk-damage probe: under an armed plan, materialize the
+        # buffer so a `corrupt` rule can flip a byte the way bit rot
+        # would — disabled (the normal path) this costs nothing
+        raw = _SITE_READ(bytes(raw))
+    kind_found, tag_found, start, stop = _split_bounds(path, raw)
+    if kind_found != kind or tag_found != tag:
+        raise ArtifactStaleTag(
+            f"{path}: kind/tag ({kind_found!r}, {tag_found!r}) != "
+            f"expected ({kind!r}, {tag!r})")
+    return raw, start, stop
+
+
+def _split_bounds(path: str, raw) -> Tuple[str, str, int, int]:
+    """Parse + digest-verify one envelope; (kind, tag, payload start,
+    payload stop) — bounds into ``raw``, no payload copy."""
     if len(raw) < _HDR_FIXED + 4 + 8 + _DIGEST_LEN:
         raise ArtifactCorrupt(f"{path}: truncated ({len(raw)} bytes)")
     if raw[:4] != MAGIC:
@@ -202,12 +215,12 @@ def _split(path: str, raw) -> Tuple[str, str, bytes]:
         # format generation is STALE; a damaged one is corrupt
         raise ArtifactStaleTag(
             f"{path}: format version {version} != {FORMAT_VERSION}")
-    payload = bytes(raw[off:len(raw) - _DIGEST_LEN])
-    if len(payload) != payload_len:
+    start, stop = off, len(raw) - _DIGEST_LEN
+    if stop - start != payload_len:
         raise ArtifactCorrupt(
-            f"{path}: payload {len(payload)} bytes, header says "
+            f"{path}: payload {stop - start} bytes, header says "
             f"{payload_len}")
-    return kind, tag, payload
+    return kind, tag, start, stop
 
 
 def _read_str(raw: bytes, off: int) -> Tuple[str, int]:

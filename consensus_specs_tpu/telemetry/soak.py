@@ -132,6 +132,7 @@ def _stf_sites() -> List[str]:
 def _build_corpus(fork: str, epochs: int):
     """(spec, pre_state, signed_blocks, per-block literal roots) for an
     ``epochs``-long full-block walk (the chaos corpus pattern, longer)."""
+    from consensus_specs_tpu.query import coldstart
     from consensus_specs_tpu.testing.context import spec_state_test, with_phases
     from consensus_specs_tpu.testing.helpers.attestations import (
         next_slots_with_attestations,
@@ -144,9 +145,14 @@ def _build_corpus(fork: str, epochs: int):
     @spec_state_test
     def build(spec, state):
         next_epoch(spec, state)
-        pre = state.copy()
+        # ISSUE 16: the soak's pre-state rides the universal cold-start
+        # seam — restored byte-identical from the snapshot artifact when
+        # one matches (CSTPU_NO_CHECKPOINT_SYNC=1 forces the literal
+        # build), built and snapshotted otherwise
+        pre = coldstart.restore_or_build(
+            spec, len(state.validators), state.copy, label="soak")
         _, signed, _ = next_slots_with_attestations(
-            spec, state.copy(), epochs * int(spec.SLOTS_PER_EPOCH),
+            spec, pre.copy(), epochs * int(spec.SLOTS_PER_EPOCH),
             True, True)
         s = pre.copy()
         roots = []
@@ -170,6 +176,7 @@ def bounded_cache_sizes() -> List[dict]:
     """(name, size, cap) of every bounded structure the telemetry bus
     reports — the memory-flatness sample."""
     import consensus_specs_tpu.node.admission  # noqa: F401  (registers provider)
+    import consensus_specs_tpu.query  # noqa: F401  (registers provider)
 
     from . import snapshot
 
@@ -235,6 +242,18 @@ def bounded_cache_sizes() -> List[dict]:
     samples.append({"name": "persist.checkpoints",
                     "size": persist.get("size", 0),
                     "cap": persist.get("cap", 0)})
+    # the historical read path (ISSUE 16): the live query engine's
+    # artifact index, proof cache, and resident-state set are bounded
+    # LRUs on the bus — flatness-asserted like every other cache (when
+    # no engine is live the gauges are absent and cap=0 skips the check)
+    q = providers.get("query", {})
+    for name, size_key, cap_key in (
+            ("query.artifact_index", "artifact_index_size",
+             "artifact_index_cap"),
+            ("query.proof_cache", "proof_cache_size", "proof_cache_cap"),
+            ("query.resident", "resident_size", "resident_cap")):
+        samples.append({"name": name, "size": q.get(size_key, 0),
+                        "cap": q.get(cap_key, 0)})
     return samples
 
 

@@ -1,0 +1,222 @@
+"""Historical-read-path chaos (ISSUE 16): injected failures at the
+query seams must surface as ``QueryError`` / a clean fallback — never a
+wrong answer, never a perturbed apply loop.
+
+* ``query.proof`` — a poisoned serving buffer is caught by the
+  in-engine verification before the proof leaves the engine;
+* ``persist.read`` mid-query — a rotted artifact rides the PR 14
+  corruption ladder (count, quarantine, next candidate) and degrades to
+  "unserved", with the apply loop's world untouched;
+* ``query.restore`` — a cold start whose snapshot restore dies
+  quarantines the artifact and falls back to the literal build;
+* ``persist.refault`` — an eviction re-fault that dies leaves the
+  resident set exactly as it was (coherent), and the next query
+  re-faults honestly.
+
+``COVERED_SITES`` is closed over by test_registry_complete.py.
+"""
+import os
+
+import pytest
+
+from consensus_specs_tpu import faults, query
+from consensus_specs_tpu.node import firehose, recover_node, service
+from consensus_specs_tpu.persist import store as persist_store
+from consensus_specs_tpu.persist.store import CheckpointStore
+from consensus_specs_tpu.query import coldstart
+from consensus_specs_tpu.query.engine import QueryError
+from consensus_specs_tpu.query.streamproof import verify_proof
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+F = faults.Fault
+
+COVERED_SITES = {"query.proof", "query.restore", "persist.refault"}
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    from consensus_specs_tpu.crypto import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+_SCAFFOLD = {}
+
+
+def _scaffold():
+    """(spec, genesis_state, corpus): the persist-chaos scaffold — three
+    epochs of full blocks, enough for several epoch-fence checkpoints."""
+    if not _SCAFFOLD:
+        from consensus_specs_tpu.specs.builder import get_spec
+
+        spec = get_spec("phase0", "minimal")
+        state = create_genesis_state(
+            spec, default_balances(spec), default_activation_threshold(spec))
+        corpus = firehose.build_corpus(
+            spec, state, n_epochs=3, gossip_target=120)
+        _SCAFFOLD["phase0"] = (spec, state, corpus)
+    return _SCAFFOLD["phase0"]
+
+
+def _serve(spec, state, corpus, ckpt_store):
+    """Run the whole corpus through a fresh node with a SYNCHRONOUS
+    checkpoint store on the caller's thread (deterministic fence
+    writes); returns the node, its query engine live and artifact-fed."""
+    service.reset_stats()
+    persist_store.reset_stats()
+    query.reset_stats()
+    node = service.Node(spec, state, corpus.anchor_block,
+                        checkpoint_store=ckpt_store)
+    for signed in corpus.chain:
+        s = int(signed.message.slot)
+        node.enqueue_tick(int(state.genesis_time)
+                          + s * int(spec.config.SECONDS_PER_SLOT))
+        node.enqueue_block(signed)
+        for att in corpus.gossip.get(s - 1, ()):
+            node.enqueue_attestations([att])
+    last = int(corpus.chain[-1].message.slot)
+    node.enqueue_tick(int(state.genesis_time)
+                      + (last + 1) * int(spec.config.SECONDS_PER_SLOT))
+    node.queue.close()
+    node.run_apply_loop()
+    return node
+
+
+def test_proof_fault_is_queryerror_never_a_wrong_proof(tmp_path):
+    """``query.proof`` corrupting the serving buffer: the in-engine
+    verification catches the poisoned leaf (QueryError, ``faults_in``
+    counted) and the NEXT query serves a clean, verifying proof — the
+    fault can delay an answer but never falsify one."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node = _serve(spec, state, corpus, store)
+    engine = node.query_engine
+    assert engine is not None
+
+    plan = faults.FaultPlan([F("query.proof", nth=1, kind="corrupt")])
+    with faults.inject(plan):
+        with pytest.raises(QueryError):
+            engine.proof_of_validator(0)
+    assert ("query.proof", 1, "corrupt") in plan.fired
+    assert query.stats["faults_in"] == 1
+
+    # clean retry: the cache holds the UNpoisoned proof, and it verifies
+    # against the checkpoint's own head state root
+    proof = engine.proof_of_validator(0)
+    assert proof is not None
+    summ = engine.summary()
+    ref = node.store.block_states[bytes.fromhex(summ["head_block_root"])]
+    assert proof["state_root"] == bytes(ref.hash_tree_root())
+    assert verify_proof(proof["leaf"], proof["branch"], proof["gindex"],
+                        proof["state_root"])
+    # the read path never touched the apply loop's world
+    assert service.stats["blocks_applied"] == len(corpus.chain)
+    assert persist_store.stats["corruptions"] == 0
+
+
+def test_read_corruption_mid_query_rides_the_ladder(tmp_path):
+    """Sticky ``persist.read`` corruption while the engine faults its
+    artifacts in: every candidate walks the PR 14 ladder (counted,
+    quarantined by the store) and the query degrades to UNSERVED — no
+    crash, no wrong answer, and the apply loop's journal still replays
+    to byte-identical parity afterwards."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node = _serve(spec, state, corpus, store)
+    engine = node.query_engine
+    n_finals = len(store.candidates())
+    assert n_finals >= 2
+
+    persist_store.reset_stats()
+    query.reset_stats()
+    plan = faults.FaultPlan([F("persist.read", nth=1, kind="corrupt",
+                               sticky=True)])
+    with faults.inject(plan):
+        assert engine.summary() is None
+    assert any(site == "persist.read" for site, _n, _k in plan.fired)
+    assert persist_store.stats["corruptions"] == n_finals
+    assert query.stats["artifact_corrupt"] == n_finals
+    assert query.stats["queries_unserved"] == 1
+    assert store.candidates() == []  # index invalidated
+    quarantined = [p for p in os.listdir(tmp_path)
+                   if p.endswith(".corrupt")]
+    assert len(quarantined) == n_finals
+
+    # the apply world is untouched: the journal replays to the same head
+    recovered = recover_node(spec, state, corpus.anchor_block, node.journal)
+    head = bytes(node.get_head())
+    assert bytes(recovered.get_head()) == head
+    assert bytes(recovered.store.block_states[head].hash_tree_root()) == \
+        bytes(node.store.block_states[head].hash_tree_root())
+
+
+def test_restore_fault_falls_back_to_the_literal_build(tmp_path):
+    """``query.restore`` dying mid-restore: the snapshot artifact is
+    quarantined (counted, flight-recorded) and the cold start falls
+    through to the literal build — the caller always gets a correct
+    state, and the rebuild re-snapshots for the next process."""
+    spec, state, _corpus = _scaffold()
+    snap_dir = str(tmp_path)
+    query.reset_stats()
+
+    built = coldstart.restore_or_build(
+        spec, len(state.validators), state.copy, label="chaos",
+        cache_dir=snap_dir)
+    assert query.stats["coldstart_builds"] == 1
+    assert query.stats["coldstart_writes"] == 1
+    coldstart.forget_verified()
+
+    plan = faults.FaultPlan([F("query.restore", nth=1)])
+    with faults.inject(plan):
+        restored = coldstart.restore_or_build(
+            spec, len(state.validators), state.copy, label="chaos",
+            cache_dir=snap_dir)
+    assert ("query.restore", 1, "error") in plan.fired
+    assert query.stats["coldstart_corrupt"] == 1
+    assert query.stats["coldstart_builds"] == 2
+    assert bytes(restored.hash_tree_root()) == bytes(built.hash_tree_root())
+    assert any(p.endswith(".corrupt") for p in os.listdir(snap_dir))
+
+    # the rebuild re-wrote the snapshot: the next cold start restores
+    query.reset_stats()
+    again = coldstart.restore_or_build(
+        spec, len(state.validators), state.copy, label="chaos",
+        cache_dir=snap_dir)
+    assert query.stats["coldstart_restores"] == 1
+    assert bytes(again.hash_tree_root()) == bytes(built.hash_tree_root())
+
+
+def test_refault_fault_leaves_the_resident_set_coherent(tmp_path):
+    """``persist.refault`` dying on an eviction re-fault: the query
+    fails (QueryError, counted), NOTHING is installed in the resident
+    set, and the next ``state_at_root`` re-faults honestly to a
+    root-verified state."""
+    spec, state, corpus = _scaffold()
+    store = CheckpointStore(str(tmp_path), asynchronous=False)
+    node = _serve(spec, state, corpus, store)
+    engine = node.query_engine
+    query.reset_stats()
+
+    plan = faults.FaultPlan([F("persist.refault", nth=1)])
+    with faults.inject(plan):
+        with pytest.raises(QueryError):
+            engine.state_at_root()
+    assert ("persist.refault", 1, "error") in plan.fired
+    assert query.stats["faults_in"] == 1
+    assert engine.cache_gauges()["resident_size"] == 0  # nothing installed
+
+    served = engine.state_at_root()
+    assert served is not None
+    summ = engine.summary()
+    assert bytes(served.hash_tree_root()) == \
+        bytes.fromhex(summ["head_state_root"])
+    # every resident entry is root-coherent by construction
+    gauges = engine.cache_gauges()
+    assert 0 < gauges["resident_size"] <= gauges["resident_cap"]

@@ -14,12 +14,16 @@ from consensus_specs_tpu import faults
 # importing the instrumented modules registers their sites
 import consensus_specs_tpu.forkchoice.engine  # noqa: F401
 import consensus_specs_tpu.node.service  # noqa: F401  (registers ingest's too)
+import consensus_specs_tpu.query.coldstart  # noqa: F401
+import consensus_specs_tpu.query.engine  # noqa: F401
+import consensus_specs_tpu.query.resident  # noqa: F401
 import consensus_specs_tpu.stf.engine  # noqa: F401
 
 from . import (
     test_forkchoice_chaos,
     test_node_chaos,
     test_persist_chaos,
+    test_query_chaos,
     test_stf_chaos,
 )
 
@@ -35,7 +39,8 @@ def test_every_site_has_a_chaos_case():
     covered = (set(test_stf_chaos.COVERED_SITES)
                | set(test_forkchoice_chaos.COVERED_SITES)
                | set(test_node_chaos.COVERED_SITES)
-               | set(test_persist_chaos.COVERED_SITES))
+               | set(test_persist_chaos.COVERED_SITES)
+               | set(test_query_chaos.COVERED_SITES))
     missing = registered - covered
     assert not missing, (
         f"fault sites with no chaos case: {sorted(missing)} — add a case to "
@@ -78,8 +83,24 @@ def test_persist_sites_are_registered_and_covered():
     persist_sites = {n for n in _production_sites()
                      if n.startswith("persist.")}
     assert expected <= persist_sites, sorted(expected - persist_sites)
-    assert persist_sites <= set(test_persist_chaos.COVERED_SITES), \
-        sorted(persist_sites - set(test_persist_chaos.COVERED_SITES))
+    # persist.refault (the eviction re-fault seam) lives with the query
+    # chaos cases — the read path owns that probe
+    persist_covered = (set(test_persist_chaos.COVERED_SITES)
+                       | set(test_query_chaos.COVERED_SITES))
+    assert persist_sites <= persist_covered, \
+        sorted(persist_sites - persist_covered)
+
+
+def test_query_sites_are_registered_and_covered():
+    """ISSUE 16: the historical read path's seams exist AND each carries
+    a chaos case — an uncovered query site turns this red independently
+    of the generic completeness sweep above."""
+    expected = {"query.proof", "query.restore", "persist.refault"}
+    query_sites = {n for n in _production_sites()
+                   if n.startswith("query.")} | {"persist.refault"}
+    assert expected <= query_sites, sorted(expected - query_sites)
+    assert query_sites <= set(test_query_chaos.COVERED_SITES), \
+        sorted(query_sites - set(test_query_chaos.COVERED_SITES))
 
 
 def test_site_names_are_unique_and_dotted():

@@ -1,0 +1,83 @@
+"""Concurrent query load against the live firehose (ISSUE 16).
+
+Reader threads hammer the node's ``QueryEngine`` — summary, balances,
+statuses, proofs, votes, full states — WHILE the producer/apply
+machinery runs the corpus and the asynchronous checkpoint store writes
+artifacts under them.  Zero reader errors, real latency percentiles,
+bounded caches, and the apply loop's journal-replay parity untouched:
+the read path must be an observer, never a participant."""
+import pytest
+
+from consensus_specs_tpu import query
+from consensus_specs_tpu.node import firehose
+from consensus_specs_tpu.persist.store import CheckpointStore
+from consensus_specs_tpu.query import harness
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    from consensus_specs_tpu.crypto import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+def test_query_load_rides_the_live_firehose(tmp_path):
+    from consensus_specs_tpu.specs.builder import get_spec
+
+    spec = get_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    corpus = firehose.build_corpus(spec, state, n_epochs=3, gossip_target=200)
+
+    query.reset_stats()
+    store = CheckpointStore(str(tmp_path))  # asynchronous, like the real node
+    try:
+        run = harness.run_query_load(spec, state, corpus, n_query_threads=2,
+                                     checkpoint_store=store)
+        node = run["node"]
+        ql = run["query_load"]
+
+        # readers really ran, really served, and never errored
+        assert ql["threads"] == 2
+        assert ql["ops"] > 0
+        assert ql["served"] > 0, "no queries served against the live firehose"
+        assert ql["errors"] == 0, ql
+        assert ql["p50_ms"] is not None and ql["p99_ms"] is not None
+        assert ql["p50_ms"] <= ql["p99_ms"]
+
+        # the engine's caches stayed bounded under concurrent load
+        gauges = node.query_engine.cache_gauges()
+        assert gauges["artifact_index_size"] <= gauges["artifact_index_cap"]
+        assert gauges["proof_cache_size"] <= gauges["proof_cache_cap"]
+        assert gauges["resident_size"] <= gauges["resident_cap"]
+
+        # the read path never perturbed the apply loop: byte-identical
+        # journal-replay parity vs the literal spec
+        ref = firehose.replay_journal_literal(
+            spec, state, corpus.anchor_block, node.journal)
+        parity = firehose.assert_parity(spec, node, ref)
+        assert parity["head_root"]
+    finally:
+        store.close()
+
+
+def test_query_load_requires_an_engine():
+    """A node without a checkpoint store has no read path — the harness
+    refuses instead of silently measuring nothing."""
+    from consensus_specs_tpu.specs.builder import get_spec
+
+    spec = get_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    corpus = firehose.build_corpus(spec, state, n_epochs=2, gossip_target=60)
+    with pytest.raises(RuntimeError):
+        harness.run_query_load(spec, state, corpus, n_query_threads=1,
+                               checkpoint_store=None)

@@ -1,0 +1,117 @@
+"""Checkpoint-sync cold starts (ISSUE 16): ``restore_or_build`` is the
+universal state-build seam — first call builds and snapshots, the next
+process restores byte-identically (verified once per artifact), the
+``CSTPU_NO_CHECKPOINT_SYNC=1`` escape hatch forces the literal build,
+and a rotted snapshot quarantines and falls back."""
+import os
+
+import pytest
+
+from consensus_specs_tpu.query import coldstart, reset_stats, stats
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+
+@pytest.fixture()
+def scaffold():
+    from consensus_specs_tpu.specs.builder import get_spec
+
+    spec = get_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    reset_stats()
+    coldstart.forget_verified()
+    return spec, state
+
+
+def test_build_then_restore_is_byte_identical(scaffold, tmp_path):
+    spec, state = scaffold
+    root = bytes(state.hash_tree_root())
+    calls = []
+
+    def build():
+        calls.append(1)
+        return state
+
+    s1 = coldstart.restore_or_build(spec, len(state.validators), build,
+                                    label="t", cache_dir=str(tmp_path))
+    assert len(calls) == 1
+    assert stats["coldstart_builds"] == 1
+    assert stats["coldstart_writes"] == 1
+    assert bytes(s1.hash_tree_root()) == root
+
+    # a fresh process (the verified-memo forgotten) restores, not rebuilds
+    coldstart.forget_verified()
+    s2 = coldstart.restore_or_build(spec, len(state.validators), build,
+                                    label="t", cache_dir=str(tmp_path))
+    assert len(calls) == 1, "should restore, not rebuild"
+    assert stats["coldstart_restores"] == 1
+    assert bytes(s2.hash_tree_root()) == root
+
+
+def test_opt_out_env_forces_the_literal_build(scaffold, tmp_path,
+                                              monkeypatch):
+    spec, state = scaffold
+    calls = []
+
+    def build():
+        calls.append(1)
+        return state
+
+    coldstart.restore_or_build(spec, len(state.validators), build,
+                               label="t", cache_dir=str(tmp_path))
+    monkeypatch.setenv("CSTPU_NO_CHECKPOINT_SYNC", "1")
+    coldstart.forget_verified()
+    coldstart.restore_or_build(spec, len(state.validators), build,
+                               label="t", cache_dir=str(tmp_path))
+    assert len(calls) == 2, "opt-out must bypass the snapshot entirely"
+    assert stats["coldstart_restores"] == 0
+
+
+def test_corrupt_snapshot_quarantines_and_rebuilds(scaffold, tmp_path):
+    spec, state = scaffold
+    root = bytes(state.hash_tree_root())
+    calls = []
+
+    def build():
+        calls.append(1)
+        return state
+
+    coldstart.restore_or_build(spec, len(state.validators), build,
+                               label="t", cache_dir=str(tmp_path))
+    path = coldstart.snapshot_path(spec, len(state.validators), "t",
+                                   str(tmp_path))
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+
+    coldstart.forget_verified()
+    s = coldstart.restore_or_build(spec, len(state.validators), build,
+                                   label="t", cache_dir=str(tmp_path))
+    assert len(calls) == 2, "damage must fall back to the literal build"
+    assert stats["coldstart_corrupt"] == 1
+    assert os.path.exists(path + ".corrupt")
+    assert bytes(s.hash_tree_root()) == root
+
+    # the rebuild re-snapshotted: the NEXT cold start restores again
+    reset_stats()
+    coldstart.forget_verified()
+    again = coldstart.restore_or_build(spec, len(state.validators), build,
+                                       label="t", cache_dir=str(tmp_path))
+    assert stats["coldstart_restores"] == 1
+    assert len(calls) == 2
+    assert bytes(again.hash_tree_root()) == root
+
+
+def test_label_and_count_key_distinct_snapshots(scaffold, tmp_path):
+    spec, state = scaffold
+    p1 = coldstart.snapshot_path(spec, len(state.validators), "a",
+                                 str(tmp_path))
+    p2 = coldstart.snapshot_path(spec, len(state.validators), "b",
+                                 str(tmp_path))
+    p3 = coldstart.snapshot_path(spec, len(state.validators) + 1, "a",
+                                 str(tmp_path))
+    assert len({p1, p2, p3}) == 3

@@ -1,0 +1,171 @@
+"""The historical query engine over a really-served node (ISSUE 16).
+
+A three-epoch corpus runs through a ``Node`` with a synchronous
+checkpoint store; the engine then serves summaries, balances, statuses,
+proofs, votes and full states straight off the newest mmap'd artifact.
+Every answer is differentially checked against the node's OWN copy of
+the checkpoint-head state (artifacts lag the live head — they are
+written at epoch fences), and walking every historical root through the
+cap-2 resident set exercises spill + re-fault coherence."""
+import pytest
+
+from consensus_specs_tpu import query
+from consensus_specs_tpu.node import firehose, service
+from consensus_specs_tpu.persist.store import CheckpointStore
+from consensus_specs_tpu.query import streamproof
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    from consensus_specs_tpu.crypto import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+_SCAFFOLD = {}
+
+
+def _corpus():
+    if not _SCAFFOLD:
+        from consensus_specs_tpu.specs.builder import get_spec
+
+        spec = get_spec("phase0", "minimal")
+        state = create_genesis_state(
+            spec, default_balances(spec), default_activation_threshold(spec))
+        corpus = firehose.build_corpus(
+            spec, state, n_epochs=3, gossip_target=120)
+        _SCAFFOLD["phase0"] = (spec, state, corpus)
+    return _SCAFFOLD["phase0"]
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """(spec, node): the corpus fully applied with a synchronous store —
+    the engine is live and artifact-fed by the time the fixture yields."""
+    from consensus_specs_tpu.crypto import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        spec, state, corpus = _corpus()
+        store = CheckpointStore(
+            str(tmp_path_factory.mktemp("query_store")), asynchronous=False)
+        service.reset_stats()
+        query.reset_stats()
+        node = service.Node(spec, state, corpus.anchor_block,
+                            checkpoint_store=store)
+        assert node.query_engine is not None
+        for signed in corpus.chain:
+            s = int(signed.message.slot)
+            node.enqueue_tick(int(state.genesis_time)
+                              + s * int(spec.config.SECONDS_PER_SLOT))
+            node.enqueue_block(signed)
+            for att in corpus.gossip.get(s - 1, ()):
+                node.enqueue_attestations([att])
+        last = int(corpus.chain[-1].message.slot)
+        node.enqueue_tick(int(state.genesis_time)
+                          + (last + 1) * int(spec.config.SECONDS_PER_SLOT))
+        node.queue.close()
+        node.run_apply_loop()
+        yield spec, node
+        store.close()
+    finally:
+        bls.bls_active = prev
+
+
+def _checkpoint_head(node):
+    """The artifact's head state — the node's own copy of it, the
+    differential reference for everything the engine serves."""
+    summ = node.query_engine.summary()
+    assert summ is not None, "no artifact served"
+    ref = node.store.block_states[bytes.fromhex(summ["head_block_root"])]
+    assert bytes.fromhex(summ["head_state_root"]) == \
+        bytes(ref.hash_tree_root())
+    return summ, ref
+
+
+def test_summary_serves_the_checkpoint_world(served):
+    _spec, node = served
+    summ, _ref = _checkpoint_head(node)
+    assert summ["window_depth"] >= 1
+    assert summ["journal_pos"] > 0
+    assert summ["n_latest_messages"] >= 0
+
+
+def test_point_queries_differential_vs_the_nodes_state(served):
+    _spec, node = served
+    eng = node.query_engine
+    _summ, ref = _checkpoint_head(node)
+    hsr = bytes(ref.hash_tree_root())
+    for i in (0, 3, 17, 63):
+        assert eng.balance_of(i) == int(ref.balances[i]), i
+        st = eng.validator_status(i)
+        assert st["exit_epoch"] == int(ref.validators[i].exit_epoch)
+        assert st["effective_balance"] == \
+            int(ref.validators[i].effective_balance)
+        assert st["slashed"] == bool(ref.validators[i].slashed)
+        pr = eng.proof_of_validator(i)
+        assert pr["state_root"] == hsr
+        assert streamproof.verify_proof(pr["leaf"], pr["branch"],
+                                        pr["gindex"], hsr)
+        v = eng.vote_of(i)  # votes as of checkpoint time; shape-check
+        assert v is None or (isinstance(v["epoch"], int)
+                             and len(v["root"]) == 32)
+
+
+def test_state_at_root_serves_head_and_history(served):
+    _spec, node = served
+    eng = node.query_engine
+    _summ, ref = _checkpoint_head(node)
+    hsr = bytes(ref.hash_tree_root())
+    assert bytes(eng.state_at_root().hash_tree_root()) == hsr
+    hist = eng.historical_roots()
+    assert hsr in hist
+    oldest = hist[0]
+    assert bytes(eng.state_at_root(oldest).hash_tree_root()) == oldest
+
+
+def test_resident_eviction_spills_and_refaults_coherently(served):
+    _spec, node = served
+    eng = node.query_engine
+    query.reset_stats()
+    hist = eng.historical_roots()
+    # two passes over every root through the cap-bounded resident set:
+    # the second pass re-faults whatever the first evicted
+    for _ in range(2):
+        for r in hist:
+            assert bytes(eng.state_at_root(r).hash_tree_root()) == r
+    gauges = eng.cache_gauges()
+    assert 0 < gauges["resident_size"] <= gauges["resident_cap"]
+    if len(hist) > gauges["resident_cap"]:
+        assert query.stats["spills"] > 0
+        assert query.stats["refaults"] > 0
+    assert query.stats["queries_served"] == 2 * len(hist)
+
+
+def test_cache_gauges_stay_bounded(served):
+    _spec, node = served
+    g = node.query_engine.cache_gauges()
+    assert g["artifact_index_size"] <= g["artifact_index_cap"]
+    assert g["proof_cache_size"] <= g["proof_cache_cap"]
+    assert g["resident_size"] <= g["resident_cap"]
+
+
+def test_unknown_root_and_unknown_validator_are_clean_misses(served):
+    _spec, node = served
+    eng = node.query_engine
+    query.reset_stats()
+    assert eng.state_at_root(b"\xee" * 32) is None
+    assert eng.balance_of(10 ** 9) is None
+    assert eng.validator_status(10 ** 9) is None
+    assert eng.proof_of_validator(10 ** 9) is None
+    assert query.stats["queries_unserved"] == 4
+    assert query.stats["queries_served"] == 0

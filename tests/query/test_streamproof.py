@@ -1,0 +1,94 @@
+"""Streaming Merkle proofs off the encoded subtree format (ISSUE 16).
+
+Differential ground truth is the ssz layer's own ``build_proof`` over
+the materialized backing: for every validator and a spread of paths
+(container, basic list element, field-within-container, list length
+mixin) the offset-walking ``proof_at`` must produce byte-identical
+branches, and every proof must verify against the state root.  A
+tampered leaf must NOT verify — the negative that keeps ``verify_proof``
+honest."""
+import pytest
+
+from consensus_specs_tpu.persist.store import encode_tree
+from consensus_specs_tpu.query import streamproof
+from consensus_specs_tpu.ssz.gindex import (
+    build_proof,
+    get_generalized_index,
+    get_subtree_at_gindex,
+)
+from consensus_specs_tpu.ssz.node import merkle_root
+from consensus_specs_tpu.testing.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
+
+
+@pytest.fixture(scope="module")
+def scaffold():
+    """(spec, state, buf, entries, eid, root): a minimal genesis state
+    run through the checkpoint codec's ``encode_tree``, then indexed by
+    the streaming parser — the exact shape the engine serves from."""
+    from consensus_specs_tpu.specs.builder import get_spec
+
+    spec = get_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    root = bytes(state.hash_tree_root())
+    out = bytearray()
+    encode_tree(state.get_backing(), out, {})
+    buf = bytes(out)
+    entries = []
+    eid, off = streamproof.parse_tree(buf, 0, entries)
+    assert off == len(buf)
+    return spec, state, buf, entries, eid, root
+
+
+def test_entry_root_matches_the_state_root(scaffold):
+    _spec, _state, buf, entries, eid, root = scaffold
+    assert streamproof.entry_root(buf, entries, eid) == root
+
+
+def test_proofs_differential_vs_build_proof_all_validators(scaffold):
+    spec, state, buf, entries, eid, root = scaffold
+    backing = state.get_backing()
+    n = len(state.validators)
+    assert n >= 64
+    for i in range(n):
+        for path in (("validators", i), ("balances", i),
+                     ("validators", i, "exit_epoch"),
+                     ("balances", "__len__")):
+            g = get_generalized_index(spec.BeaconState, *path)
+            ref = build_proof(backing, g)
+            leaf, branch = streamproof.proof_at(buf, entries, eid, g)
+            assert branch == ref, (path, "branch mismatch")
+            assert streamproof.verify_proof(leaf, branch, g, root), path
+            assert streamproof.node_root_at(buf, entries, eid, g) == \
+                merkle_root(get_subtree_at_gindex(backing, g))
+
+
+def test_leaf_chunks_carry_the_actual_content(scaffold):
+    spec, state, buf, entries, eid, _root = scaffold
+    n = len(state.validators)
+    g = get_generalized_index(spec.BeaconState, "balances", 3)
+    chunk = streamproof.node_root_at(buf, entries, eid, g)
+    bal = int.from_bytes(chunk[(3 % 4) * 8:(3 % 4) * 8 + 8], "little")
+    assert bal == int(state.balances[3])
+    g = get_generalized_index(spec.BeaconState, "balances", "__len__")
+    ln = int.from_bytes(streamproof.node_root_at(buf, entries, eid, g)[:8],
+                        "little")
+    assert ln == n
+
+
+def test_tampered_leaf_does_not_verify(scaffold):
+    spec, _state, buf, entries, eid, root = scaffold
+    g = get_generalized_index(spec.BeaconState, "validators", 0)
+    leaf, branch = streamproof.proof_at(buf, entries, eid, g)
+    assert streamproof.verify_proof(leaf, branch, g, root)
+    bad = bytes([leaf[0] ^ 1]) + leaf[1:]
+    assert not streamproof.verify_proof(bad, branch, g, root)
+    # a tampered branch node fails too
+    bad_branch = [branch[0]] if len(branch) == 1 else list(branch)
+    bad_branch[0] = bytes([bad_branch[0][0] ^ 1]) + bad_branch[0][1:]
+    assert not streamproof.verify_proof(leaf, type(branch)(bad_branch), g,
+                                        root)

@@ -292,3 +292,89 @@ def test_scale_rows_gate_counters_and_trend():
     # a 1M row never compares against a 2M row (metric mismatch)
     assert bench.check_perf_trend(
         _scale_row(1 << 20, 99.0), _scale_row(1 << 21, 10.0)) is None
+
+
+# -- cold-start + query-load row gates (ISSUE 16) -----------------------------
+
+_CS_ROW = {"metric": "cold_start_checkpoint_400000_validators", "value": 1.2,
+           "unit": "s", "vs_baseline": 25.0}
+
+
+def test_cold_start_error_row_blocks():
+    msg = bench.check_cold_start_trend({"error": "AssertionError('7x')"}, None)
+    assert msg is not None and "errored" in msg
+
+
+def test_cold_start_margin_floor_blocks():
+    msg = bench.check_cold_start_trend(dict(_CS_ROW, vs_baseline=9.9), None)
+    assert msg is not None and "10x floor" in msg
+    assert bench.check_cold_start_trend(dict(_CS_ROW, vs_baseline=10.0),
+                                        None) is None
+    # a row that lost its margin field entirely is refused, not ignored
+    row = dict(_CS_ROW)
+    del row["vs_baseline"]
+    msg = bench.check_cold_start_trend(row, None)
+    assert msg is not None and "vs_baseline" in msg
+
+
+def test_cold_start_restore_time_regression_flagged():
+    # value is restore seconds: LARGER is the regression direction
+    cur = dict(_CS_ROW, value=1.4)  # +16.7% vs 1.2
+    msg = bench.check_cold_start_trend(cur, _CS_ROW)
+    assert msg is not None and "perf-trend regression" in msg
+    assert _CS_ROW["metric"] in msg
+    assert bench.check_cold_start_trend(dict(_CS_ROW, value=1.35),
+                                        _CS_ROW) is None  # +12.5%: in budget
+
+
+def test_cold_start_not_comparable_is_silent():
+    assert bench.check_cold_start_trend(None, _CS_ROW) is None  # QUICK skip
+    assert bench.check_cold_start_trend(_CS_ROW, None) is None
+    assert bench.check_cold_start_trend(_CS_ROW, {"error": "x"}) is None
+    other = dict(_CS_ROW, metric="cold_start_checkpoint_1000_validators")
+    assert bench.check_cold_start_trend(dict(_CS_ROW, value=99.0),
+                                        other) is None
+    assert bench.check_cold_start_trend(
+        dict(_CS_ROW, value=99.0), dict(_CS_ROW, value=0.0)) is None
+
+
+_QL_ROW = {"metric": "node_query_load_2readers_400000_validators",
+           "value": 40.0, "unit": "ms", "query_errors": 0, "served": 5000}
+
+
+def test_query_trend_error_row_blocks():
+    msg = bench.check_query_trend({"error": "RuntimeError('no engine')"},
+                                  None)
+    assert msg is not None and "errored" in msg
+
+
+def test_query_trend_reader_errors_block():
+    # a fault-free bench run where readers errored means the read path
+    # broke under the firehose — refuse the headline
+    msg = bench.check_query_trend(dict(_QL_ROW, query_errors=3), None)
+    assert msg is not None and "3" in msg and "errors" in msg
+
+
+def test_query_trend_zero_served_blocks():
+    msg = bench.check_query_trend(dict(_QL_ROW, served=0), None)
+    assert msg is not None and "zero queries" in msg
+
+
+def test_query_trend_p99_regression_flagged():
+    # value is p99 ms: LARGER is the regression direction
+    cur = dict(_QL_ROW, value=47.0)  # +17.5% vs 40.0
+    msg = bench.check_query_trend(cur, _QL_ROW)
+    assert msg is not None and "perf-trend regression" in msg
+    assert _QL_ROW["metric"] in msg
+    assert bench.check_query_trend(dict(_QL_ROW, value=45.0),
+                                   _QL_ROW) is None  # +12.5%: in budget
+
+
+def test_query_trend_not_comparable_is_silent():
+    assert bench.check_query_trend(None, _QL_ROW) is None  # QUICK skip
+    assert bench.check_query_trend(_QL_ROW, None) is None
+    assert bench.check_query_trend(_QL_ROW, {"error": "x"}) is None
+    other = dict(_QL_ROW, metric="node_query_load_4readers_400000_validators")
+    assert bench.check_query_trend(dict(_QL_ROW, value=99.0), other) is None
+    assert bench.check_query_trend(
+        dict(_QL_ROW, value=99.0), dict(_QL_ROW, value=0.0)) is None

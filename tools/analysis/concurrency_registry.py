@@ -53,9 +53,9 @@ _PKG = "consensus_specs_tpu"
 # is the single-writer loop (not concurrent with itself); the SPAWNED
 # roles run concurrently with everything else and drive the hazards.
 ROLES = ("main", "apply-writer", "pipeline-worker", "producer",
-         "persist-writer", "native-pool")
+         "persist-writer", "native-pool", "query-reader")
 SPAWNED_ROLES = frozenset({"pipeline-worker", "producer", "persist-writer",
-                           "native-pool"})
+                           "native-pool", "query-reader"})
 
 
 @dataclass(frozen=True)
@@ -145,6 +145,17 @@ LOCKS: Tuple[LockSpec, ...] = (
     LockSpec("adversarial epoch fence", f"{_PKG}.node.adversary",
              frozenset({"fence"}),
              "per-run local Condition gating producers per epoch"),
+    # ISSUE 16: the historical read path
+    LockSpec("query engine lock", f"{_PKG}.query.engine",
+             frozenset({"QueryEngine._lock"}),
+             "artifact index + proof cache + resident set: any number of "
+             "query-reader threads serialize on it"),
+    LockSpec("query live-engine lock", f"{_PKG}.query",
+             frozenset({"_LIVE_LOCK"}),
+             "the telemetry provider's weakref to the live engine"),
+    LockSpec("snapshot verified lock", f"{_PKG}.query.coldstart",
+             frozenset({"_VERIFIED_LOCK"}),
+             "once-per-artifact byte-identity memo for cold starts"),
 )
 
 
@@ -234,10 +245,42 @@ SHARED: Tuple[SharedSpec, ...] = (
     SharedSpec("node service counters", f"{_PKG}.node.service",
                module_globals=frozenset({"stats"})),
     # written by the writer thread (write_checkpoint) AND the apply/main
-    # thread (submit failures, restore ladder) — sanctioned both ways
+    # thread (submit failures, restore ladder) — and, ISSUE 16, by
+    # query-reader threads walking the corruption ladder mid-query
+    # (map_payload / discard_corrupt)
     SharedSpec("persist store counters", f"{_PKG}.persist.store",
                module_globals=frozenset({"stats"}),
-               roles=frozenset({"persist-writer"})),
+               roles=frozenset({"persist-writer", "query-reader"})),
+    # -- the historical read path (ISSUE 16) ---------------------------------
+    # THE query-reader role wall: readers touch the engine's own caches
+    # (below, all under the engine lock) and store artifacts — never the
+    # apply writer's fork-choice structures.  The engine lock guards all
+    # three caches; the resident set's methods run with it already held
+    # by the engine (documented caller-holds-lock contract)
+    SharedSpec("query engine caches", f"{_PKG}.query.engine",
+               instance_attrs=frozenset({"QueryEngine._artifacts",
+                                         "QueryEngine._proof_cache"}),
+               lock="query engine lock",
+               # caller-holds-lock helper: every public entry takes the
+               # lock before walking the candidate ladder
+               lock_holders=frozenset({"QueryEngine._current"})),
+    SharedSpec("query resident states", f"{_PKG}.query.resident",
+               instance_attrs=frozenset({"ResidentStates._states"}),
+               lock="query engine lock",
+               lock_holders=frozenset({"ResidentStates.get",
+                                       "ResidentStates.clear"})),
+    SharedSpec("query live engine ref", f"{_PKG}.query",
+               module_globals=frozenset({"_LIVE_ENGINE"}),
+               lock="query live-engine lock"),
+    SharedSpec("snapshot verified memo", f"{_PKG}.query.coldstart",
+               module_globals=frozenset({"_VERIFIED"}),
+               lock="snapshot verified lock"),
+    # the query counters: bumped by reader threads (queries, proofs,
+    # refaults) and by main-thread cold starts — plain int adds on the
+    # instrumentation plane, the telemetry-counter pattern
+    SharedSpec("query counters", f"{_PKG}.query",
+               module_globals=frozenset({"stats"}),
+               roles=frozenset({"query-reader"})),
 )
 
 
@@ -264,6 +307,9 @@ ROLE_SEEDS: Tuple[RoleSeed, ...] = (
              "adversarial junk flood producer"),
     RoleSeed(f"{_PKG}.node.adversary.closer", "producer",
              "adversarial firehose closer thread"),
+    RoleSeed(f"{_PKG}.query.harness.query_reader", "query-reader",
+             "historical-query reader threads against the live engine "
+             "(ISSUE 16)"),
     # producer-facing API: gossip readers enqueue from their own threads
     RoleSeed(f"{_PKG}.node.ingest.IngestQueue.put", "producer",
              "the multi-producer enqueue surface (node/ingest.py)"),

@@ -273,6 +273,34 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         invalidators=frozenset({"reset"}),
         observational=True,
     ),
+    # ISSUE 16: the historical read path's caches.  The artifact index
+    # (mmap'd subtree windows), the proof LRU, and the resident-state
+    # set are coherent only while every insert goes through the engine's
+    # lock-guarded loaders — an outside insert could pin a stale mmap or
+    # serve a state whose root was never re-verified after a re-fault
+    CacheSpec(
+        name="query proof/artifact caches",
+        owner=("query", "engine.py"),
+        module="consensus_specs_tpu.query.engine",
+        instance_attrs=frozenset({"_artifacts", "_proof_cache"}),
+        invalidators=frozenset({"reset"}),
+    ),
+    CacheSpec(
+        name="query resident states",
+        owner=("query", "resident.py"),
+        module="consensus_specs_tpu.query.resident",
+        instance_attrs=frozenset({"_states"}),
+        invalidators=frozenset({"clear"}),
+    ),
+    # the once-per-artifact byte-identity memo: entries may only be made
+    # by a restore that just proved identity; anyone else may only forget
+    CacheSpec(
+        name="snapshot verified memo",
+        owner=("query", "coldstart.py"),
+        module="consensus_specs_tpu.query.coldstart",
+        module_globals=frozenset({"_VERIFIED"}),
+        invalidators=frozenset({"forget_verified"}),
+    ),
 )
 
 
