@@ -78,6 +78,12 @@ lint:
 analyze:
 	python tools/lint.py --json ANALYSIS.json
 
+# fast pre-commit sweep: re-analyze only files whose content or
+# dependency digest differs from the incremental cache (read-only —
+# never writes cache entries a full run would trust)
+analyze-changed:
+	python tools/lint.py --changed
+
 GENERATORS = sanity operations forks ssz_static shuffling bls epoch_processing finality rewards genesis random transition ssz_generic fork_choice merkle
 
 gen-all: $(addprefix gen-,$(GENERATORS))
@@ -100,4 +106,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep firehose firehose-adversarial doctor limb-probe dcn-dryrun lint analyze consume mdspec gen-all FORCE
+.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep firehose firehose-adversarial doctor limb-probe dcn-dryrun lint analyze analyze-changed consume mdspec gen-all FORCE

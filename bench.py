@@ -2297,6 +2297,31 @@ def check_counter_invariants(current, previous=None, plan_floor=0.25,
     return None
 
 
+def analyzer_refusal_line(findings, stale_entries) -> str:
+    """The one-line exit-3 refusal for the analyzer gate.
+
+    ``findings`` are finding-shaped objects (``.code``/``.file``/
+    ``.line``/``.message``), ``stale_entries`` the runner's stale-baseline
+    dicts.  Names the first offender so the refusal is actionable from
+    the summary alone; spec-mirror parity findings (SP01–SP03) surface
+    their full message because it names the drifted mirror and fork —
+    the whole point of the pin (ISSUE 18).
+    """
+    n = len(findings) + len(stale_entries)
+    if findings:
+        sp = [f for f in findings if f.code.startswith("SP")]
+        f0 = sp[0] if sp else findings[0]
+        first = f"first: {f0.code} in {f0.file}:{f0.line}"
+        if sp:
+            first += f" — {f0.message}"
+    else:
+        first = ("first: stale baseline entry in "
+                 f"{stale_entries[0]['file']}")
+    return (f"refusing to print the headline row: "
+            f"{n} unbaselined analyzer finding(s) "
+            f"({first}) — see ANALYSIS.json / `make analyze`")
+
+
 def main():
     device_fallback = _ensure_live_jax()
     if os.environ.get("CSTPU_FAULTS"):
@@ -2460,17 +2485,8 @@ def main():
             if blocking:
                 for line in blocking:
                     print(line, file=sys.stderr)
-                # name the first offender so the refusal is actionable
-                # from the one-line summary alone (ISSUE 15 drive-by)
-                if a_result.findings:
-                    f0 = a_result.findings[0]
-                    first = f"first: {f0.code} in {f0.file}:{f0.line}"
-                else:
-                    first = ("first: stale baseline entry in "
-                             f"{a_result.stale_baseline[0]['file']}")
-                print(f"refusing to print the headline row: "
-                      f"{len(blocking)} unbaselined analyzer finding(s) "
-                      f"({first}) — see ANALYSIS.json / `make analyze`",
+                print(analyzer_refusal_line(a_result.findings,
+                                            a_result.stale_baseline),
                       file=sys.stderr)
                 sys.exit(3)
 

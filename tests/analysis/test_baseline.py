@@ -100,6 +100,46 @@ def test_malformed_baseline_entry_rejected(tmp_path):
         Baseline.load(p)
 
 
+def test_prune_drops_exactly_the_stale_entries(tmp_path):
+    # tools/lint.py --prune-baseline: one file's entry is consumed, one
+    # same-file entry no longer fires, one entry's file is deleted — the
+    # run reports the latter two stale and prune() rewrites without them
+    from analysis.baseline import prune
+
+    (tmp_path / "a.py").write_text("import os\n")
+    consumed = {"file": "a.py", "code": "F401", "snippet": "import os",
+                "justification": "kept"}
+    fixed = {"file": "a.py", "code": "W291", "snippet": "x = 1",
+             "justification": "was fixed since"}
+    deleted = {"file": "gone.py", "code": "F401", "snippet": "import sys",
+               "justification": "file was deleted since"}
+    bl = _write_baseline(tmp_path, [consumed, fixed, deleted])
+    result = _run(tmp_path, bl)
+    assert {e["justification"] for e in result.stale_baseline} == {
+        "was fixed since", "file was deleted since"}
+
+    dropped = prune(bl, result.stale_baseline)
+    assert {e["justification"] for e in dropped} == {
+        "was fixed since", "file was deleted since"}
+    kept = json.loads(bl.read_text())["entries"]
+    assert kept == [consumed]
+    # the pruned baseline round-trips clean: no findings, nothing stale
+    again = _run(tmp_path, bl)
+    assert again.findings == [] and again.stale_baseline == []
+
+
+def test_prune_is_a_no_op_without_stale_entries(tmp_path):
+    from analysis.baseline import prune
+
+    entry = {"file": "a.py", "code": "F401", "snippet": "import os",
+             "justification": "kept"}
+    bl = _write_baseline(tmp_path, [entry])
+    before = bl.read_text()
+    assert prune(bl, []) == []
+    assert bl.read_text() == before
+    assert prune(tmp_path / "missing.json", [entry]) == []
+
+
 def test_live_baseline_entries_all_have_justifications():
     from analysis.runner import DEFAULT_BASELINE
 

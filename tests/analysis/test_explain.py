@@ -1,0 +1,49 @@
+"""``tools/lint.py --explain CODE``: rule-catalog entry + annotated fix
+example for every registered code, round-tripped through the CLI."""
+
+import subprocess
+import sys
+
+import lint
+from analysis.core import REGISTRY, all_rules
+
+
+def test_every_registered_rule_has_catalog_material():
+    all_rules()
+    assert REGISTRY, "registry empty"
+    for code, cls in REGISTRY.items():
+        assert cls.summary.strip(), code
+        assert (cls.__doc__ or "").strip(), code
+        assert cls.fix_example.strip(), code
+
+
+def test_explain_prints_summary_doc_and_fix(capsys):
+    all_rules()
+    for code in REGISTRY:
+        rc = lint.main(["--explain", code])
+        out = capsys.readouterr().out
+        assert rc == 0, code
+        assert out.startswith(f"{code}: "), out[:80]
+        assert REGISTRY[code].fix_example.rstrip() in out, code
+
+
+def test_explain_unknown_code_lists_registry(capsys):
+    rc = lint.main(["--explain", "ZZ99"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    for code in ("SP01", "SP02", "SP03", "TH01", "E501"):
+        assert code in out
+
+
+def test_explain_missing_argument(capsys):
+    assert lint.main(["--explain"]) == 2
+
+
+def test_explain_cli_round_trip():
+    # true subprocess round-trip: the documented developer invocation
+    proc = subprocess.run(
+        [sys.executable, "tools/lint.py", "--explain", "SP01"],
+        capture_output=True, text=True, cwd=lint.Path(lint.__file__).parent.parent)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.startswith("SP01: ")
+    assert "mirror_registry" in proc.stdout

@@ -318,8 +318,108 @@ def test_lk01_undeclared_lock_mutation_turns_red(gate):
                for f in found), found
 
 
+# -- spec-mirror parity (ISSUE 18): SP01/SP02/SP03 ---------------------------
+
+def test_sp02_capella_fast_forks_mutation_turns_red(gate):
+    # ROADMAP item 4's exact first step — widening FAST_FORKS to capella
+    # — is gate-red until every reachable capella spec fn is declared
+    rel = "consensus_specs_tpu/stf/engine.py"
+    found = _mutated(gate, {rel: lambda t: t.replace(
+        'FAST_FORKS = ("phase0", "altair", "bellatrix")',
+        'FAST_FORKS = ("phase0", "altair", "bellatrix", "capella")')})
+    hits = [f for f in found if f.code == "SP02"]
+    assert hits, found
+    assert all(f.file == rel for f in hits)
+    assert all("fast-path fork 'capella'" in f.message for f in hits), hits
+    # the coverage gaps are the capella additions themselves
+    named = " ".join(f.message for f in hits)
+    assert "process_withdrawals" in named, named
+    assert "process_full_withdrawals" in named, named
+
+
+def test_sp01_spec_body_edit_mutation_turns_red(gate):
+    # a semantic edit to a pinned spec function names the mirror + fork
+    rel = "consensus_specs_tpu/specs/src/phase0.py"
+    found = _mutated(gate, {rel: lambda t: t.replace(
+        "assert block.slot == state.slot",
+        "assert block.slot >= state.slot", 1)})
+    hits = [f for f in found if f.code == "SP01"]
+    assert any(f.file == "consensus_specs_tpu/stf/engine.py"
+               and "'_header'" in f.message
+               and "process_block_header" in f.message
+               and "phase0" in f.message for f in hits), found
+
+
+def test_sp01_spec_comment_churn_stays_green(gate):
+    # AST normalization: comment/docstring churn is not drift
+    rel = "consensus_specs_tpu/specs/src/phase0.py"
+    found = _mutated(gate, {rel: lambda t: t + "\n# annotated, no-op\n"})
+    assert not [f for f in found if f.code.startswith("SP")], found
+
+
+def test_sp03_guard_deletion_mutation_turns_red(gate):
+    # deleting a mapped guard from a live mirror is red with the guard,
+    # the spec twin, and the mirror named
+    rel = "consensus_specs_tpu/stf/slot_roots.py"
+    found = _mutated(gate, {rel: lambda t: t.replace(
+        "    assert state.slot < slot", "    pass")})
+    hits = [f for f in found if f.code == "SP03"]
+    assert any("assert state.slot < slot" in f.message
+               and "process_slots" in f.message for f in hits), found
+
+
+def test_mirror_pass_budget_and_snapshot_report(gate):
+    # the extraction pass reports its own wall time (ANALYSIS.json) and
+    # stays within the warm per-rule budget; the per-fork snapshot
+    # digests are the rows a pin bump is audited against
+    js = gate.to_json()
+    assert js["mirror_pass_s"] == round(gate.mirror_pass_s, 4)
+    assert 0.0 <= gate.mirror_pass_s < 0.5, gate.mirror_pass_s
+    assert set(js["spec_snapshot"]) == {
+        "phase0", "altair", "bellatrix", "capella", "ssz"}
+    assert all(len(d) == 64 for d in js["spec_snapshot"].values())
+
+
+def test_warm_run_keeps_the_mirror_pass_cheap(gate):
+    warm = run(cache_path=gate._cache_path)
+    assert warm.mirror_pass_s < 0.5, warm.mirror_pass_s
+
+
+def test_spec_edit_rederives_exactly_the_pinned_mirrors(gate):
+    # cache correctness: a (semantically inert) spec-source edit shifts
+    # the dependency digest of exactly the files whose registry pins
+    # reach that source — every mirror file re-analyzes, nothing else
+    from analysis import mirror_registry
+
+    rel = "consensus_specs_tpu/specs/src/bellatrix.py"
+    text = (REPO_ROOT / rel).read_text() + "\n# churn\n"
+    res = run(cache_path=gate._cache_path, overrides={rel: text},
+              changed_only=True)
+    assert res.findings == [], [f.render() for f in res.findings]
+    expected = {rel}
+    for display, deps in mirror_registry.extra_file_deps().items():
+        if rel in deps:
+            expected.add(display)
+    assert set(res.analyzed) == expected, (
+        sorted(set(res.analyzed) ^ expected))
+
+
+def test_changed_only_leaf_edit_stays_scoped(gate):
+    # make analyze-changed: an untouched tree re-analyzes nothing; a
+    # leaf edit re-analyzes the leaf (+ dependents — this leaf has none)
+    clean = run(cache_path=gate._cache_path, changed_only=True)
+    assert clean.analyzed == [] and clean.findings == []
+    assert clean.stale_baseline == []
+    leaf = "tests/analysis/test_noqa.py"
+    text = (REPO_ROOT / leaf).read_text() + "\n# touched\n"
+    res = run(cache_path=gate._cache_path, overrides={leaf: text},
+              changed_only=True)
+    assert set(res.analyzed) == {leaf}, sorted(res.analyzed)
+
+
 def test_registry_covers_every_mutation_code():
     # every rule family proven red above is a registered plugin
     for code in ("FC01", "DT01", "CC01", "RB01", "JX01", "ST01",
-                 "HD01", "SH01", "EF01", "OB01", "IO01", "TH01", "LK01"):
+                 "HD01", "SH01", "EF01", "OB01", "IO01", "TH01", "LK01",
+                 "SP01", "SP02", "SP03"):
         assert code in REGISTRY, code

@@ -378,3 +378,42 @@ def test_query_trend_not_comparable_is_silent():
     assert bench.check_query_trend(dict(_QL_ROW, value=99.0), other) is None
     assert bench.check_query_trend(
         dict(_QL_ROW, value=99.0), dict(_QL_ROW, value=0.0)) is None
+
+
+# -- analyzer-gate refusal line (ISSUE 18 satellite) -------------------------
+
+class _F:
+    def __init__(self, code, file, line, message):
+        self.code, self.file, self.line, self.message = (
+            code, file, line, message)
+
+
+def test_analyzer_refusal_surfaces_sp_mirror_and_fork():
+    # an SP finding carries the drifted mirror + fork in its message:
+    # the refusal must print it even when a hygiene finding sorts first
+    sp = _F("SP01", "consensus_specs_tpu/stf/engine.py", 725,
+            "mirror '_header' drifted from spec twin 'process_block_header'"
+            " at fork(s) phase0: pinned dda1eb99d09b..., now 1f2e3d4c5b6a...")
+    other = _F("DT01", "consensus_specs_tpu/ops/epoch.py", 3, "raw int")
+    line = bench.analyzer_refusal_line([other, sp], [])
+    assert "2 unbaselined" in line
+    assert "SP01 in consensus_specs_tpu/stf/engine.py:725" in line
+    assert "'_header'" in line and "phase0" in line
+    assert "exit" not in line  # the exit code is the caller's contract
+
+
+def test_analyzer_refusal_plain_first_offender():
+    f = _F("DT01", "x.py", 3, "raw int where Gwei is required")
+    line = bench.analyzer_refusal_line([f], [])
+    assert "1 unbaselined" in line
+    assert "first: DT01 in x.py:3" in line
+    # non-SP messages stay out of the one-liner (no mirror/fork payload)
+    assert "raw int" not in line
+
+
+def test_analyzer_refusal_stale_only():
+    line = bench.analyzer_refusal_line(
+        [], [{"file": "y.py", "code": "F401", "snippet": "import os",
+              "justification": "gone"}])
+    assert "1 unbaselined" in line
+    assert "stale baseline entry in y.py" in line

@@ -59,3 +59,25 @@ class Baseline:
         """Entries that matched no finding in the last run."""
         return [e for k, e in self._sample.items()
                 if self._matched.get(k, 0) == 0]
+
+
+def prune(path, stale: List[dict]) -> List[dict]:
+    """Rewrite ``baseline.json`` at ``path`` dropping ``stale`` entries
+    (as reported by a run's ``Result.stale_baseline``), preserving entry
+    order and formatting.  Returns the dropped entries."""
+    p = Path(path)
+    if not p.exists() or not stale:
+        return []
+    data = json.loads(p.read_text())
+    entries = data.get("entries", [])
+    stale_keys = {(e["file"], e["code"], e["snippet"]) for e in stale}
+    kept, dropped = [], []
+    for e in entries:
+        if (e["file"], e["code"], e["snippet"]) in stale_keys:
+            dropped.append(e)
+        else:
+            kept.append(e)
+    if dropped:
+        data["entries"] = kept
+        p.write_text(json.dumps(data, indent=2) + "\n")
+    return dropped

@@ -41,6 +41,9 @@ class Rule:
 
     code: str = ""
     summary: str = ""
+    # minimal annotated fix example, printed by ``tools/lint.py --explain
+    # CODE`` under the rule's catalog entry
+    fix_example: str = ""
 
     def check(self, ctx: "FileContext") -> Iterator[Tuple[int, str]]:
         raise NotImplementedError

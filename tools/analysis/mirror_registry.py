@@ -1,0 +1,965 @@
+"""Declared spec-mirror parity registry for the SP01–SP03 rules.
+
+The TPU fast paths *reimplement* spec functions — `stf/engine.py`'s block
+operations, the numpy/JAX epoch kernels in `ops/`, the builder's
+sanctioned substitutions, `forkchoice/batch.py`'s batched on_attestation,
+`query/streamproof.py`'s build_proof twin.  Parity with the literal
+pyspec otherwise lives only in differential tests that must be
+remembered; this registry makes every mirror a *declared* fact the
+analyzer can audit, exactly as `concurrency_registry.py` does for the
+threading contract:
+
+* ``MirrorSpec`` — one fast-path mirror (a function, nested function, or
+  class) with one ``SpecPin`` per spec twin: the AST-normalized SHA-256
+  of the twin's source **as compiled into consensus_specs_tpu/specs/**
+  per fork, its assert/raise site count + digest, and a guard mapping
+  that routes each spec raise site to either a named guard snippet that
+  must appear in the mirror's source (SP03 checks presence) or ``None``
+  — meaning the site is enforced by literal spec execution instead (the
+  engine's replay fallback, a direct ``spec.*`` call inside the mirror,
+  or a deferred batch check whose failure raises ``FastPathViolation``
+  and triggers replay).
+* ``LiteralSpec`` — a spec function the fast path executes *literally*
+  (the bellatrix ``process_execution_payload``-inside-snapshot shape, or
+  operations the engine loops through ``spec.process_*`` verbatim).  No
+  digest pin needed: the spec's own body runs.
+* ``WaiverSpec`` — an explicit, justified opt-out from SP02 coverage.
+
+SP01 fires when a pinned digest no longer matches the extracted spec
+source (re-audit the mirror, then bump the pin here).  SP02 fires when a
+fork in ``stf/engine.py``'s ``FAST_FORKS`` has a reachable spec function
+with no pin/literal/waiver — adding ``"capella"`` to ``FAST_FORKS``
+turns the gate red until every capella obligation is declared.  SP03
+fires when a pin's raise-point map is stale (spec grew an assert) or a
+mapped guard string was deleted from the mirror.
+
+Coverage obligations are the state-mutating entry points
+(``process_*``/``verify_*``/``on_*``) plus any function pinned or
+declared anywhere: pure helpers (``get_domain``, ``compute_epoch_at_slot``,
+...) are always exercised through the spec object itself and carry no
+independent drift risk beyond their callers' digests.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from . import spec_extract
+
+_PKG = "consensus_specs_tpu"
+
+#: Spec functions SP02 walks the intra-spec call graph from, per fast fork.
+ENTRY_FUNCTIONS: Tuple[str, ...] = ("state_transition",)
+
+#: The file whose FAST_FORKS tuple defines the coverage obligation set.
+ENGINE_DISPLAY = f"{_PKG}/stf/engine.py"
+
+#: Reachable spec functions matching these prefixes are obligated even if
+#: never pinned — they mutate state, so silence would hide a gap.
+OBLIGATED_PREFIXES: Tuple[str, ...] = ("process_", "verify_", "on_")
+
+# sha256 of zero raise sites (empty input) — the raise digest of every
+# spec function with no assert/raise statements.
+_NO_RAISES = "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+
+_MAINLINE = ("phase0", "altair", "bellatrix")
+_ALTAIR_ON = ("altair", "bellatrix", "capella")
+_ALL = ("phase0", "altair", "bellatrix", "capella")
+
+
+@dataclass(frozen=True)
+class SpecPin:
+    """One spec twin of a mirror: per-fork source digest + raise map."""
+
+    fn: str                             # spec function name
+    forks: Tuple[str, ...]              # forks sharing this effective def
+    digest: str                         # AST-normalized source sha256
+    raise_count: int
+    raise_digest: str
+    guards: Tuple[Optional[str], ...]   # one slot per spec raise site, in
+    #                                     source order: a snippet that must
+    #                                     appear in the mirror, or None =
+    #                                     routed to literal replay
+
+
+@dataclass(frozen=True)
+class MirrorSpec:
+    """One fast-path reimplementation of spec function(s)."""
+
+    name: str           # short audit handle
+    module: str         # dotted module holding the mirror
+    qualname: str       # possibly-nested def path inside the module
+    pins: Tuple[SpecPin, ...]
+    description: str
+
+
+@dataclass(frozen=True)
+class LiteralSpec:
+    """A spec function the fast path runs literally (no pin needed)."""
+
+    fn: str
+    forks: Tuple[str, ...]
+    why: str
+
+
+@dataclass(frozen=True)
+class WaiverSpec:
+    """An explicit SP02 coverage opt-out, with justification."""
+
+    fn: str
+    forks: Tuple[str, ...]
+    why: str
+
+
+MIRRORS: Tuple[MirrorSpec, ...] = (
+    # ---- stf/engine.py: the fast-path block transition --------------------
+    MirrorSpec(
+        name="fast-transition",
+        module=f"{_PKG}.stf.engine",
+        qualname="_fast_transition",
+        pins=(
+            SpecPin(
+                "state_transition", _MAINLINE,
+                "bb8fdce127f670d374f9f7313aaa4599c29404713eb3d2b9b577fc979d90e09b",
+                2,
+                "3daf41152d6c2fe0f13de6bdb515d60d20930f02d9b18b98cffa5eadf7e70f5c",
+                ("invalid signature (batch entry",
+                 "state root mismatch")),
+        ),
+        description="state_transition over the snapshot region: slots, "
+        "block ops, deferred signature batch, state-root check.",
+    ),
+    MirrorSpec(
+        name="proposer-signature-entry",
+        module=f"{_PKG}.stf.engine",
+        qualname="_proposer_entry",
+        pins=(
+            SpecPin(
+                "verify_block_signature", _MAINLINE,
+                "91b8a5007f422e3a88d7c45f7d12cb730f16c5fdca10055339908b03abc666a0",
+                0, _NO_RAISES, ()),
+        ),
+        description="verify_block_signature as one deferred batch entry; "
+        "a failed pairing raises via _fast_transition's batch guard.",
+    ),
+    MirrorSpec(
+        name="block-header",
+        module=f"{_PKG}.stf.engine",
+        qualname="_header",
+        pins=(
+            SpecPin(
+                "process_block_header", _MAINLINE,
+                "dda1eb99d09bb7ab8284d8788bd0704e1e8578df842257fdf158156f78144270",
+                5,
+                "3b29d00dbe32f4a407bd77ee1f4534096c3c2b777b6acc599771bd527bbefb49",
+                ("assert block.slot == state.slot",
+                 "assert block.slot > state.latest_block_header.slot",
+                 "assert block.proposer_index == beacon_proposer_index(spec, state)",
+                 "assert block.parent_root == spec.hash_tree_root(state.latest_block_header)",
+                 "assert not proposer.slashed")),
+        ),
+        description="process_block_header with the proposer check against "
+        "the numpy fast proposer walk; all five spec asserts transcribed.",
+    ),
+    MirrorSpec(
+        name="randao",
+        module=f"{_PKG}.stf.engine",
+        qualname="_randao_collect",
+        pins=(
+            SpecPin(
+                "process_randao", _MAINLINE,
+                "a93f7b5e4909da265be1f438625c246b1be357870fe6a7909963fa9fde7bc728",
+                1,
+                "9421816e1b99c5107c5a56edca86ef467837b6ae1b6a66ecfc9e80d92d62dbcf",
+                (None,)),
+        ),
+        description="process_randao with the reveal's pairing check "
+        "deferred into the block batch (None guard: a bad reveal fails "
+        "the batch and replays literally).",
+    ),
+    MirrorSpec(
+        name="operations-dispatch",
+        module=f"{_PKG}.stf.engine",
+        qualname="_operations",
+        pins=(
+            SpecPin(
+                "process_operations", _MAINLINE,
+                "414346eba84a6df9c095b73466127afcddff53d64893d51daf87c32d91dc36c9",
+                1,
+                "036c5bf30990a6ea193e9b8ce778d8e9eaecac302e724012a37426a65625562d",
+                ("assert len(body.deposits) == min(",)),
+        ),
+        description="process_operations with the attestation loop swapped "
+        "for the vectorized whole-block path; other operation loops call "
+        "spec.process_* literally.",
+    ),
+    MirrorSpec(
+        name="attestations-phase0",
+        module=f"{_PKG}.stf.engine",
+        qualname="_attestations_inner",
+        pins=(
+            SpecPin(
+                "process_attestation", ("phase0",),
+                "e535d8d21bb00209dc1ab5ba9ec3956add1a99ea27cbb657fdf98affabcdee33",
+                8,
+                "8b167700ccd6c36f942edd1b1613a4fbe3a07f4efaceef039dc1099780d30190",
+                (None, None, None, None, None,
+                 "source != current justified",
+                 "source != previous justified",
+                 None)),
+        ),
+        description="phase0 process_attestation over the whole block: "
+        "window/committee asserts live in _BlockResolver (pinned there), "
+        "source checks are the two named guards, the indexed-attestation "
+        "signature defers into the batch.",
+    ),
+    MirrorSpec(
+        name="attestations-altair",
+        module=f"{_PKG}.stf.engine",
+        qualname="_attestations_inner_altair",
+        pins=(
+            SpecPin(
+                "process_attestation", ("altair", "bellatrix"),
+                "f68c9cabb76a1fe7ebff6aef2a13a5677773948f6fe1e017126e00aa8c3047df",
+                6,
+                "33390d2f614e0f8dd592ab43c2082b018f6067323dbafadafaead1697d5af7ea",
+                (None, None, None, None, None, None)),
+        ),
+        description="altair-lineage process_attestation vectorized over "
+        "participation flags: windows/committees via _BlockResolver "
+        "(pinned there), flag asserts via _FlagMaskContext, signature "
+        "deferred into the batch.",
+    ),
+    MirrorSpec(
+        name="participation-flag-mask",
+        module=f"{_PKG}.stf.engine",
+        qualname="_FlagMaskContext.mask",
+        pins=(
+            SpecPin(
+                "get_attestation_participation_flag_indices",
+                ("altair", "bellatrix"),
+                "40a00349b84a8e119549c159f8e7252254f4b1bb3faa52b233f27f1a818d4f5c",
+                1,
+                "e1fea472018d789c02435f27a70e7cfed56be59527d2df4d872cf86e29423d02",
+                ("source != justified checkpoint",)),
+        ),
+        description="get_attestation_participation_flag_indices as a "
+        "per-(slot,delay) bitmask with the is_matching_source assert "
+        "reproduced as a FastPathViolation.",
+    ),
+    # ---- stf/slot_roots.py ------------------------------------------------
+    MirrorSpec(
+        name="slot-advance",
+        module=f"{_PKG}.stf.slot_roots",
+        qualname="process_slots",
+        pins=(
+            SpecPin(
+                "process_slots", _MAINLINE,
+                "20f2c2bf06e07bca625334381ea68606c05dfe660f2206332eac577289e8641a",
+                1,
+                "51049c89e70ec2abee5491a5e71a7684ac58e4aa4b88ed51f2883d601d55e550",
+                ("assert state.slot < slot",)),
+        ),
+        description="process_slots with bulk root hashing; the slot "
+        "monotonicity assert is transcribed verbatim.",
+    ),
+    MirrorSpec(
+        name="single-slot",
+        module=f"{_PKG}.stf.slot_roots",
+        qualname="_process_slot",
+        pins=(
+            SpecPin(
+                "process_slot", _MAINLINE,
+                "eecfd249a8bd48d5a928a2262be40df0a514d38a448907dd8a5b2551de5c3a61",
+                0, _NO_RAISES, ()),
+        ),
+        description="process_slot's three root writes off the bulk "
+        "hash-tree-root path.",
+    ),
+    # ---- stf/attestations.py ---------------------------------------------
+    MirrorSpec(
+        name="proposer-index",
+        module=f"{_PKG}.stf.attestations",
+        qualname="beacon_proposer_index",
+        pins=(
+            SpecPin(
+                "get_beacon_proposer_index", _MAINLINE,
+                "913ee070c10992c4187b0af9700c62e21dd1bed2b0516693ffe27e9deb244c3e",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "compute_proposer_index", _MAINLINE,
+                "5dcbb20c3c7be365b80b3cec66aca598d1b0b6cd507e3f5c682a8a927a569bb1",
+                1,
+                "d6e65d181e9024e6c15ddd7e6ea9046eef30a44751168b334d651973a0b17012",
+                ("assert total > 0",)),
+        ),
+        description="get_beacon_proposer_index + compute_proposer_index's "
+        "rejection-sampling walk over the numpy active set.",
+    ),
+    MirrorSpec(
+        name="committee-context",
+        module=f"{_PKG}.stf.attestations",
+        qualname="_CommitteeContext",
+        pins=(
+            SpecPin(
+                "get_beacon_committee", _MAINLINE,
+                "44dc1abfbb33fd035d4d902a73b688c4d203e1a3af7ccd97cbb3784415d9fb77",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "compute_committee", _MAINLINE,
+                "fb1ca571347798d66ad297ed49a5dc831187744aec393d0d80df92486b2c9610",
+                0, _NO_RAISES, ()),
+        ),
+        description="per-epoch committee geometry: one whole-permutation "
+        "shuffle replacing compute_committee's per-member walk.",
+    ),
+    MirrorSpec(
+        name="block-resolver",
+        module=f"{_PKG}.stf.attestations",
+        qualname="_BlockResolver",
+        pins=(
+            SpecPin(
+                "process_attestation", ("phase0",),
+                "e535d8d21bb00209dc1ab5ba9ec3956add1a99ea27cbb657fdf98affabcdee33",
+                8,
+                "8b167700ccd6c36f942edd1b1613a4fbe3a07f4efaceef039dc1099780d30190",
+                ("target epoch outside window",
+                 "target epoch != epoch of slot",
+                 "inclusion window",
+                 "committee index out of range",
+                 "aggregation bits != committee size",
+                 None, None, None)),
+            SpecPin(
+                "process_attestation", ("altair", "bellatrix"),
+                "f68c9cabb76a1fe7ebff6aef2a13a5677773948f6fe1e017126e00aa8c3047df",
+                6,
+                "33390d2f614e0f8dd592ab43c2082b018f6067323dbafadafaead1697d5af7ea",
+                ("target epoch outside window",
+                 "target epoch != epoch of slot",
+                 "inclusion window",
+                 "committee index out of range",
+                 "aggregation bits != committee size",
+                 None)),
+        ),
+        description="process_attestation's precondition asserts (target "
+        "window, slot/epoch match, inclusion delay, committee index, bit "
+        "length) reproduced as FastPathViolations while resolving each "
+        "attestation to committee rows; the indexed-attestation signature "
+        "(and phase0 source checks) are handled by the engine/batch.",
+    ),
+    MirrorSpec(
+        name="attesting-plan",
+        module=f"{_PKG}.stf.attestations",
+        qualname="cached_plan_attesters",
+        pins=(
+            SpecPin(
+                "get_attesting_indices", _MAINLINE,
+                "f398599283a0c54973da64b80170f90cba0f569250775272f3ad61544c396e69",
+                0, _NO_RAISES, ()),
+        ),
+        description="get_attesting_indices over the committee-context "
+        "rows, memoized per (state, attestation-plan).",
+    ),
+    # ---- stf/sync.py ------------------------------------------------------
+    MirrorSpec(
+        name="sync-aggregate",
+        module=f"{_PKG}.stf.sync",
+        qualname="process_sync_aggregate",
+        pins=(
+            SpecPin(
+                "process_sync_aggregate", ("altair", "bellatrix"),
+                "3015446276968a899111fa2b38c80ec256715f97d6e28dab790ffa6b47b12941",
+                1,
+                "24b1e85472e8e02ef814754e0c867a4b36e08a016bb71273508a302ebb1488a4",
+                ("empty sync set, non-infinity sig",)),
+        ),
+        description="process_sync_aggregate with the committee signature "
+        "deferred into the block batch; eth_fast_aggregate_verify's only "
+        "non-pairing acceptance (empty set + infinity sig) is the named "
+        "guard, the pairing half fails the batch and replays.",
+    ),
+    # ---- ops/epoch_jax.py: the phase0 epoch kernels -----------------------
+    MirrorSpec(
+        name="phase0-deltas-kernel",
+        module=f"{_PKG}.ops.epoch_jax",
+        qualname="attestation_deltas_for_state",
+        pins=(
+            SpecPin(
+                "get_attestation_deltas", ("phase0",),
+                "57d93e96de568884c1d12d2c659a9ae71ebd6c05a3b23dc25e49c5687af8fb65",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_source_deltas", ("phase0",),
+                "b8094ac90cefc0adac8e1cbb507d6d42fec3637c7b3952954453abd8eab76f02",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_target_deltas", ("phase0",),
+                "4fe3d9df4f3afe0d0a2d82fad4bb31248daf68659c3f195acaff7614acc547b2",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_head_deltas", ("phase0",),
+                "c9006c88efab4fbff09f44bc0f4611f9bbba3637317f5621866561790c8037ef",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_inclusion_delay_deltas", ("phase0",),
+                "28d5c289e6e0b59d758b90c0e4e5efbe51d133c1d6db45b03544c9e622e29afe",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_inactivity_penalty_deltas", ("phase0",),
+                "287d5901d992d44e9b63e0d970452fc4941ce115fdd9190cda9c488f95a7434a",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_attestation_component_deltas", ("phase0",),
+                "701ddea8e5d035c671b5210bfebc62eb7d045acef3efb5285ec67b48beb2aeb8",
+                0, _NO_RAISES, ()),
+        ),
+        description="get_attestation_deltas and its six component-delta "
+        "helpers as one vectorized rewards/penalties kernel.",
+    ),
+    MirrorSpec(
+        name="matching-attestation-scan",
+        module=f"{_PKG}.ops.epoch_jax",
+        qualname="_matching_scan",
+        pins=(
+            SpecPin(
+                "get_matching_source_attestations", ("phase0",),
+                "8736a57fbd9c948da87cf9b45e0177c138f3865cd32f9a712f00a93e19856d25",
+                1,
+                "00f4fbcd27e8cae795685ad19dbb89cfa5f58f162257abafa96bfd48b6728fc6",
+                ("assert int(epoch) in (prev_epoch, cur_epoch)",)),
+            SpecPin(
+                "get_matching_target_attestations", ("phase0",),
+                "0b6d84fbc728f366b72347e715d065289f3a1c742eb628a8adcd8a3643b83f84",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_matching_head_attestations", ("phase0",),
+                "2e25d6be32923bc6afa4d1a5d4a94d83842fa5434b0604cb250dfe13cbb6cc93",
+                0, _NO_RAISES, ()),
+        ),
+        description="the three matching-attestation filters as one cached "
+        "scan; the source filter's epoch-window assert is transcribed.",
+    ),
+    MirrorSpec(
+        name="attesting-balance",
+        module=f"{_PKG}.ops.epoch_jax",
+        qualname="attesting_balance",
+        pins=(
+            SpecPin(
+                "get_attesting_balance", ("phase0",),
+                "c2398c4b955297eeaa908ef26adfadfaf23fce8288fb989fec702c762e9d20fa",
+                0, _NO_RAISES, ()),
+        ),
+        description="get_attesting_balance summed over the numpy "
+        "effective-balance column.",
+    ),
+    MirrorSpec(
+        name="attesting-indices-union",
+        module=f"{_PKG}.ops.epoch_jax",
+        qualname="attesting_indices",
+        pins=(
+            SpecPin(
+                "get_attesting_indices", _MAINLINE,
+                "f398599283a0c54973da64b80170f90cba0f569250775272f3ad61544c396e69",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_unslashed_attesting_indices", ("phase0",),
+                "83fee5823f4db643118c5ad1d8a4313bca07511cfc8d0ebba85efc15c8298361",
+                0, _NO_RAISES, ()),
+        ),
+        description="per-attestation attesting sets and their unslashed "
+        "union as boolean masks over the registry columns.",
+    ),
+    MirrorSpec(
+        name="total-active-balance",
+        module=f"{_PKG}.ops.epoch_jax",
+        qualname="total_active_balance",
+        pins=(
+            SpecPin(
+                "get_total_active_balance", _ALL,
+                "6a793727c3b425c589cb9ed98f8463cb10910a5e7c347b3bdbe19bc71fc021d9",
+                0, _NO_RAISES, ()),
+        ),
+        description="get_total_active_balance as a masked column sum "
+        "(builder-installed for every fork).",
+    ),
+    MirrorSpec(
+        name="active-validator-indices",
+        module=f"{_PKG}.ops.epoch_jax",
+        qualname="active_validator_indices",
+        pins=(
+            SpecPin(
+                "get_active_validator_indices", _ALL,
+                "60c2eb3bf529bfc5704da36216befb8d32f4939a3384768e482965d07754d0b4",
+                0, _NO_RAISES, ()),
+        ),
+        description="get_active_validator_indices off the cached "
+        "activation/exit epoch columns (builder-installed for every fork).",
+    ),
+    MirrorSpec(
+        name="effective-balance-updates",
+        module=f"{_PKG}.ops.epoch_jax",
+        qualname="effective_balance_updates",
+        pins=(
+            SpecPin(
+                "process_effective_balance_updates", _ALL,
+                "de498e249b8c2a4d574f873161a7d4185d77a3e86d9d178ca39f97742fff7994",
+                0, _NO_RAISES, ()),
+        ),
+        description="process_effective_balance_updates' hysteresis sweep "
+        "vectorized over the balance columns.",
+    ),
+    MirrorSpec(
+        name="registry-updates",
+        module=f"{_PKG}.ops.epoch_jax",
+        qualname="registry_updates",
+        pins=(
+            SpecPin(
+                "process_registry_updates", _ALL,
+                "61556b40273fe1ad20d5ebc4900213ba0353b3f6571c6b31ffd8ff4c0a6b2183",
+                0, _NO_RAISES, ()),
+        ),
+        description="process_registry_updates' eligibility/ejection/"
+        "activation-queue sweep vectorized over the registry columns.",
+    ),
+    MirrorSpec(
+        name="slashings-sweep",
+        module=f"{_PKG}.ops.epoch_jax",
+        qualname="slashings_sweep",
+        pins=(
+            SpecPin(
+                "process_slashings", ("phase0",),
+                "f0be66e6b4d1ba09fb787080365249e3dda1c0988600fb18565dab63cb80b871",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "process_slashings", ("altair",),
+                "cdbe9db79fee2e4f9f21f8085cf7a1c733f2aa95f8922ddfc95db0dbcf2e4ebc",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "process_slashings", ("bellatrix", "capella"),
+                "e1402b320d51e3c6b5f372c76892ab068efa582e6ba8afc767b1d573be58c093",
+                0, _NO_RAISES, ()),
+        ),
+        description="process_slashings across all three fork variants, "
+        "differing only in the proportional-slashing multiplier "
+        "(_SLASHING_MULT per fork).",
+    ),
+    # ---- ops/epoch_altair.py: the altair-lineage epoch kernels ------------
+    MirrorSpec(
+        name="altair-justification",
+        module=f"{_PKG}.ops.epoch_altair",
+        qualname="justification_and_finalization",
+        pins=(
+            SpecPin(
+                "process_justification_and_finalization", _ALTAIR_ON,
+                "e4f557ee474a383770d16f7d35405fccb9ad7ca4f32aaeaa5bfd8262290e5358",
+                0, _NO_RAISES, ()),
+        ),
+        description="altair+ process_justification_and_finalization off "
+        "the participation-flag columns.",
+    ),
+    MirrorSpec(
+        name="altair-rewards",
+        module=f"{_PKG}.ops.epoch_altair",
+        qualname="rewards_and_penalties",
+        pins=(
+            SpecPin(
+                "process_rewards_and_penalties", _ALTAIR_ON,
+                "f0a9c26ab0c86f48ca872b3871f676965d60c7d44b41490af4541f6b2e5c73a3",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_flag_index_deltas", _ALTAIR_ON,
+                "60a1bf4b2054bf97719269fbdf76aa26ed4ffaddc7b18e14fc8d9149d237cfa4",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_inactivity_penalty_deltas", ("altair",),
+                "88fd01e6a6fbdfb8aba9c7050d53fe51f8b76c1e35330933ac2f7595a0826c06",
+                0, _NO_RAISES, ()),
+            SpecPin(
+                "get_inactivity_penalty_deltas", ("bellatrix", "capella"),
+                "af4f67bf011d475f5e9d0a5498b9013e4ec517648dc7549e883bf1b361857631",
+                0, _NO_RAISES, ()),
+        ),
+        description="altair+ process_rewards_and_penalties: flag-index "
+        "and inactivity deltas (altair vs bellatrix penalty quotients) "
+        "as one columnar kernel.",
+    ),
+    MirrorSpec(
+        name="inactivity-updates",
+        module=f"{_PKG}.ops.epoch_altair",
+        qualname="inactivity_updates",
+        pins=(
+            SpecPin(
+                "process_inactivity_updates", _ALTAIR_ON,
+                "7ab645178cdfbd8108e67c9f2a29d58cb13addc12ace44f9c1bf52c7b0d09a7a",
+                0, _NO_RAISES, ()),
+        ),
+        description="process_inactivity_updates' score bump/decay "
+        "vectorized over the inactivity-score column.",
+    ),
+    MirrorSpec(
+        name="participation-flag-rotation",
+        module=f"{_PKG}.ops.epoch_altair",
+        qualname="participation_flag_updates",
+        pins=(
+            SpecPin(
+                "process_participation_flag_updates", _ALTAIR_ON,
+                "285079d9731676864386d34360ebbc6ff4c1756bbc3e92420b818019e6d82e51",
+                0, _NO_RAISES, ()),
+        ),
+        description="process_participation_flag_updates' epoch rotation "
+        "as a column swap + zero fill.",
+    ),
+    MirrorSpec(
+        name="unslashed-participating-mask",
+        module=f"{_PKG}.ops.epoch_altair",
+        qualname="_unslashed_participating_mask",
+        pins=(
+            SpecPin(
+                "get_unslashed_participating_indices", _ALTAIR_ON,
+                "44ef5345826444575dfb8c9f332df0a90d707fe5a84dc8187487fad4a4ee5d96",
+                1,
+                "00f4fbcd27e8cae795685ad19dbb89cfa5f58f162257abafa96bfd48b6728fc6",
+                (None,)),
+        ),
+        description="get_unslashed_participating_indices as a boolean "
+        "mask; the spec's epoch-window assert is structurally satisfied "
+        "(every caller passes previous/current epoch), so the site routes "
+        "to literal replay rather than a named guard.",
+    ),
+    # ---- specs/builder.py: sanctioned in-spec substitutions ---------------
+    MirrorSpec(
+        name="builder-compute-committee",
+        module=f"{_PKG}.specs.builder",
+        qualname="_install_optimizations.compute_committee",
+        pins=(
+            SpecPin(
+                "compute_committee", _ALL,
+                "fb1ca571347798d66ad297ed49a5dc831187744aec393d0d80df92486b2c9610",
+                0, _NO_RAISES, ()),
+        ),
+        description="compute_committee via one whole-permutation shuffle "
+        "per epoch, installed into every compiled spec.",
+    ),
+    MirrorSpec(
+        name="builder-indexed-attestation",
+        module=f"{_PKG}.specs.builder",
+        qualname="_install_attestation_pubkey_column.is_valid_indexed_attestation",
+        pins=(
+            SpecPin(
+                "is_valid_indexed_attestation", _ALL,
+                "34cd6f7f83c8d58d310f41243228c4301e418b5469c2f6b2c447fa3bead18568",
+                0, _NO_RAISES, ()),
+        ),
+        description="is_valid_indexed_attestation with pubkey gathers off "
+        "the registry's affine pubkey column.",
+    ),
+    MirrorSpec(
+        name="builder-altair-attestation-kernel",
+        module=f"{_PKG}.specs.builder",
+        qualname="_install_altair_attestation_kernel.process_attestation",
+        pins=(
+            SpecPin(
+                "process_attestation", _ALTAIR_ON,
+                "f68c9cabb76a1fe7ebff6aef2a13a5677773948f6fe1e017126e00aa8c3047df",
+                6,
+                "33390d2f614e0f8dd592ab43c2082b018f6067323dbafadafaead1697d5af7ea",
+                ('assert data.target.epoch in (',
+                 'assert data.target.epoch == g["compute_epoch_at_slot"](data.slot)',
+                 'assert (data.slot + g["MIN_ATTESTATION_INCLUSION_DELAY"]',
+                 'assert data.index < g["get_committee_count_per_slot"](',
+                 'assert len(attestation.aggregation_bits) == len(committee)',
+                 'assert g["is_valid_indexed_attestation"](')),
+        ),
+        description="altair process_attestation against the scoped "
+        "participation mirror; all six spec asserts transcribed verbatim "
+        "over the compiled spec's globals.",
+    ),
+    MirrorSpec(
+        name="builder-sync-aggregate-index",
+        module=f"{_PKG}.specs.builder",
+        qualname="_install_sync_aggregate_index.process_sync_aggregate",
+        pins=(
+            SpecPin(
+                "process_sync_aggregate", _ALTAIR_ON,
+                "3015446276968a899111fa2b38c80ec256715f97d6e28dab790ffa6b47b12941",
+                1,
+                "24b1e85472e8e02ef814754e0c867a4b36e08a016bb71273508a302ebb1488a4",
+                ('assert g["eth_fast_aggregate_verify"](',)),
+        ),
+        description="process_sync_aggregate with index-based reward "
+        "application; the aggregate-signature assert is transcribed.",
+    ),
+    MirrorSpec(
+        name="builder-phase0-rewards",
+        module=f"{_PKG}.specs.builder",
+        qualname="_install_phase0_epoch_kernel.process_rewards_and_penalties",
+        pins=(
+            SpecPin(
+                "process_rewards_and_penalties", ("phase0",),
+                "48d5e12795ec2711cb1ddcb4d4d1ffb2ca6cd8a7e885d9a61448ec46b3796902",
+                0, _NO_RAISES, ()),
+        ),
+        description="phase0 process_rewards_and_penalties applying the "
+        "epoch_jax deltas kernel in one balance sweep.",
+    ),
+    MirrorSpec(
+        name="builder-phase0-deltas",
+        module=f"{_PKG}.specs.builder",
+        qualname="_install_phase0_epoch_kernel.get_attestation_deltas",
+        pins=(
+            SpecPin(
+                "get_attestation_deltas", ("phase0",),
+                "57d93e96de568884c1d12d2c659a9ae71ebd6c05a3b23dc25e49c5687af8fb65",
+                0, _NO_RAISES, ()),
+        ),
+        description="get_attestation_deltas adapter returning the "
+        "epoch_jax kernel's rewards/penalties as spec Gwei lists.",
+    ),
+    # ---- forkchoice/batch.py ----------------------------------------------
+    MirrorSpec(
+        name="batched-on-attestation",
+        module=f"{_PKG}.forkchoice.batch",
+        qualname="_ingest_attestations",
+        pins=(
+            SpecPin(
+                "on_attestation", _MAINLINE,
+                "c3f227c9a0748e9550ab20eea8f9e5d496bc53c53cf14c99713aae26c62f8126",
+                1,
+                "b0c936ed18b0f75174ceabdc4de8ea4abe5cea4ddb2d4612d040cf30f90ba574",
+                ("assert spec.is_valid_indexed_attestation(target_state, indexed)",)),
+            SpecPin(
+                "validate_on_attestation", _MAINLINE,
+                "5c2f9b16177dfeef9b3c30d690362fb9579c1033c36708b8e7a5d78fd4880d69",
+                6,
+                "96af4d0f89a899b3bb1293f3b8922c86f556f90441158b9365bc648160bd5513",
+                (None, None, None, None, None, None)),
+            SpecPin(
+                "update_latest_messages", _MAINLINE,
+                "2ef398cdc585f21953aba6721b4c37c4c7ddc137939eb7f3208924f7a39f2f7d",
+                0, _NO_RAISES, ()),
+        ),
+        description="batched on_attestation: validate_on_attestation runs "
+        "literally (spec.validate_on_attestation per dedup key, so its "
+        "six raise sites route to the literal call), the "
+        "indexed-attestation assert is transcribed, and the latest-message "
+        "fold mirrors update_latest_messages.",
+    ),
+    # ---- query/streamproof.py ---------------------------------------------
+    MirrorSpec(
+        name="stream-proof",
+        module=f"{_PKG}.query.streamproof",
+        qualname="proof_at",
+        pins=(
+            SpecPin(
+                "build_proof", ("ssz",),
+                "6a3f664c07c188140305928ac6ac27701103ebd4f84582524080ce4ee8e92fac",
+                1,
+                "3a322f1fcc38f8f487096428a27b2e9fd6fbee8ae1bba270ed70f5c815eb0360",
+                (None,)),
+        ),
+        description="ssz.gindex.build_proof regenerated off checkpoint "
+        "stream offsets; the reference's BranchNode assert maps to "
+        "_children's CheckpointError on a leaf-addressed gindex.",
+    ),
+    MirrorSpec(
+        name="proof-verify",
+        module=f"{_PKG}.query.streamproof",
+        qualname="verify_proof",
+        pins=(
+            SpecPin(
+                "is_valid_merkle_branch", _MAINLINE,
+                "2dc105975b7b0c4aca27dceffbb5f4a9e4c4974038cab4d2f8ee94c6271edbaa",
+                0, _NO_RAISES, ()),
+        ),
+        description="is_valid_merkle_branch's fold over a leaf-side-first "
+        "branch, shared by proof serving and its tests.",
+    ),
+)
+
+
+LITERALS: Tuple[LiteralSpec, ...] = (
+    LiteralSpec("process_block", _MAINLINE,
+                "the deferred-verification wrapper calls the spec's own "
+                "process_block; the engine's fast path re-dispatches into "
+                "the pinned per-operation mirrors"),
+    LiteralSpec("process_epoch", _MAINLINE,
+                "spec orchestrator: each phase hook it calls is "
+                "individually pinned or literal below"),
+    LiteralSpec("process_justification_and_finalization", ("phase0",),
+                "runs literally at phase0; its matching-attestation and "
+                "attesting-balance inputs ride the pinned epoch_jax scans"),
+    LiteralSpec("process_eth1_data", _MAINLINE,
+                "engine loops spec.process_eth1_data verbatim"),
+    LiteralSpec("process_proposer_slashing", _MAINLINE,
+                "engine loops spec.process_proposer_slashing verbatim"),
+    LiteralSpec("process_attester_slashing", _MAINLINE,
+                "engine loops spec.process_attester_slashing verbatim"),
+    LiteralSpec("process_deposit", _MAINLINE,
+                "engine loops spec.process_deposit verbatim"),
+    LiteralSpec("process_voluntary_exit", _MAINLINE,
+                "engine loops spec.process_voluntary_exit verbatim"),
+    LiteralSpec("process_execution_payload", ("bellatrix",),
+                "literal-inside-snapshot: the engine replays the spec "
+                "body (engine pass, payload checks) inside the snapshot "
+                "region rather than mirroring it"),
+    LiteralSpec("process_eth1_data_reset", _MAINLINE,
+                "trivial epoch reset, spec body runs as-is"),
+    LiteralSpec("process_slashings_reset", _MAINLINE,
+                "trivial epoch reset, spec body runs as-is"),
+    LiteralSpec("process_randao_mixes_reset", _MAINLINE,
+                "trivial epoch reset, spec body runs as-is"),
+    LiteralSpec("process_historical_roots_update", _MAINLINE,
+                "append-only epoch bookkeeping, spec body runs as-is"),
+    LiteralSpec("process_participation_record_updates", ("phase0",),
+                "phase0 attestation-record rotation, spec body runs as-is"),
+    LiteralSpec("process_sync_committee_updates", ("altair", "bellatrix"),
+                "periodic committee rotation, spec body runs as-is"),
+)
+
+WAIVERS: Tuple[WaiverSpec, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# queries
+
+
+def mirror_display(m: MirrorSpec) -> str:
+    """Display path of the file holding a mirror."""
+    return m.module.replace(".", "/") + ".py"
+
+
+def mirrors_for_file(display: str) -> Tuple[MirrorSpec, ...]:
+    return tuple(m for m in MIRRORS if mirror_display(m) == display)
+
+
+def mirror_files() -> Tuple[str, ...]:
+    seen: List[str] = []
+    for m in MIRRORS:
+        d = mirror_display(m)
+        if d not in seen:
+            seen.append(d)
+    return tuple(seen)
+
+
+def pinned_names() -> frozenset:
+    return frozenset(p.fn for m in MIRRORS for p in m.pins)
+
+
+def declared_names() -> frozenset:
+    return (pinned_names()
+            | frozenset(l.fn for l in LITERALS)
+            | frozenset(w.fn for w in WAIVERS))
+
+
+def coverage(fn: str, fork: str) -> Optional[str]:
+    """How (fn, fork) is covered: 'mirror:<name>', 'literal', 'waived',
+    or None when the pair has no declaration at all."""
+    for m in MIRRORS:
+        for p in m.pins:
+            if p.fn == fn and fork in p.forks:
+                return f"mirror:{m.name}"
+    for l in LITERALS:
+        if l.fn == fn and fork in l.forks:
+            return "literal"
+    for w in WAIVERS:
+        if w.fn == fn and fork in w.forks:
+            return "waived"
+    return None
+
+
+def extra_file_deps() -> Dict[str, Tuple[str, ...]]:
+    """Spec-source dependencies the registry adds to the incremental
+    cache: each mirror file depends on the full fork chains of its pinned
+    forks (an earlier-fork edit can move a later fork's effective def),
+    and the engine depends on every spec source (SP02 reads all chains)."""
+    deps: Dict[str, List[str]] = {}
+    for m in MIRRORS:
+        display = mirror_display(m)
+        bucket = deps.setdefault(display, [])
+        for p in m.pins:
+            for fork in p.forks:
+                for layer in spec_extract.FORK_CHAINS.get(fork, (fork,)):
+                    d = spec_extract.fork_display(layer)
+                    if d not in bucket:
+                        bucket.append(d)
+    engine = deps.setdefault(ENGINE_DISPLAY, [])
+    for d in spec_extract.spec_source_displays():
+        if d not in engine:
+            engine.append(d)
+    return {k: tuple(v) for k, v in deps.items()}
+
+
+def find_def(tree: ast.Module, qualname: str) -> Optional[ast.AST]:
+    """Resolve a possibly-nested def path ('_Outer.inner') to its node."""
+    scope: List[ast.AST] = list(tree.body)
+    node: Optional[ast.AST] = None
+    for part in qualname.split("."):
+        node = None
+        for cand in scope:
+            if (isinstance(cand, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef))
+                    and cand.name == part):
+                node = cand
+                break
+        if node is None:
+            return None
+        scope = [n for n in ast.walk(node) if n is not node
+                 and isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef))]
+    return node
+
+
+_HEX = set("0123456789abcdef")
+
+
+def registry_errors() -> List[str]:
+    """Structural validation, surfaced by tools/lint.py before any run."""
+    errors: List[str] = []
+    known_forks = set(spec_extract.FORK_CHAINS) | set(
+        spec_extract.EXTRA_SOURCES)
+    seen: set = set()
+    for m in MIRRORS:
+        key = (m.module, m.qualname)
+        if key in seen:
+            errors.append(f"duplicate mirror declaration: {m.module}."
+                          f"{m.qualname}")
+        seen.add(key)
+        if not m.pins:
+            errors.append(f"mirror '{m.name}' declares no spec pins")
+        if not m.description.strip():
+            errors.append(f"mirror '{m.name}' has no description")
+        for p in m.pins:
+            if len(p.digest) != 64 or not set(p.digest) <= _HEX:
+                errors.append(f"mirror '{m.name}' pin '{p.fn}': digest is "
+                              "not a sha256 hex string")
+            if len(p.raise_digest) != 64 or not set(p.raise_digest) <= _HEX:
+                errors.append(f"mirror '{m.name}' pin '{p.fn}': raise "
+                              "digest is not a sha256 hex string")
+            if len(p.guards) != p.raise_count:
+                errors.append(
+                    f"mirror '{m.name}' pin '{p.fn}': {p.raise_count} raise "
+                    f"site(s) declared but {len(p.guards)} guard slot(s) — "
+                    "every spec assert/raise needs a guard or an explicit "
+                    "None routing it to literal replay")
+            if not p.forks:
+                errors.append(f"mirror '{m.name}' pin '{p.fn}': empty fork "
+                              "tuple")
+            for fork in p.forks:
+                if fork not in known_forks:
+                    errors.append(f"mirror '{m.name}' pin '{p.fn}': unknown "
+                                  f"fork {fork!r}")
+    for kind, rows in (("literal", LITERALS), ("waiver", WAIVERS)):
+        for r in rows:
+            if not r.why.strip():
+                errors.append(f"{kind} declaration for '{r.fn}' has no "
+                              "justification")
+            for fork in r.forks:
+                if fork not in known_forks:
+                    errors.append(f"{kind} declaration for '{r.fn}': "
+                                  f"unknown fork {fork!r}")
+    lit = {(l.fn, f) for l in LITERALS for f in l.forks}
+    waiv = {(w.fn, f) for w in WAIVERS for f in w.forks}
+    for fn, fork in sorted(lit & waiv):
+        errors.append(f"'{fn}'@{fork} is declared both literal and waived")
+    return errors

@@ -13,6 +13,9 @@ from . import (  # noqa: F401
     jit_purity,
     key_coverage,
     lock_discipline,
+    mirror_coverage,
+    mirror_drift,
+    mirror_raises,
     observability,
     thread_roles,
     rollback,

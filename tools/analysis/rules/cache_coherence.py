@@ -320,6 +320,12 @@ class CacheCoherenceRule(Rule):
 
     code = "CC01"
     summary = "cache-structure write outside the owning module"
+    fix_example = """\
+# CC01: registered caches are written only by their owning module; call
+# its invalidation hook instead of reaching in.
+-    attestations._CTX_CACHE.clear()
++    attestations.invalidate_committee_caches()
+"""
 
     registry = CACHE_REGISTRY
     _ctx = None

@@ -56,6 +56,11 @@ class GweiDtypeRule(Rule):
 
     code = "DT01"
     summary = "Gwei reduction without explicit dtype=np.uint64"
+    fix_example = """\
+# DT01: balance sums overflow int32 defaults; pin the accumulator dtype.
+-    total = balances.sum()
++    total = balances.sum(dtype=np.uint64)
+"""
 
     def check(self, ctx):
         if ctx.tree is None or ctx.is_spec_source:

@@ -52,6 +52,14 @@ class EffectSafetyRule(Rule):
 
     code = "EF01"
     summary = "unroutable cache insert next to a fault probe"
+    fix_example = """\
+# EF01: a cache insert between a fault probe and the commit point can
+# survive a rollback.  Move the insert past the probe (or stage it).
+-    _CACHE[key] = derived
+-    _SITE_PROBE()
++    _SITE_PROBE()
++    _CACHE[key] = derived
+"""
 
     registry = CACHE_REGISTRY
 

@@ -57,6 +57,12 @@ class LatestMessagesMutationRule(Rule):
 
     code = "FC01"
     summary = "direct store vote-state mutation outside specs/+forkchoice/+node/"
+    fix_example = """\
+# FC01: latest-message state is owned by forkchoice/ — route mutations
+# through its API instead of poking the store.
+-    store.latest_messages[i] = LatestMessage(epoch, root)
++    batch.commit_votes(store, votes)
+"""
 
     def check(self, ctx):
         # persist/ is sanctioned alongside node/ (ISSUE 14): checkpoint
@@ -97,6 +103,13 @@ class PerItemVerifyLoopRule(Rule):
 
     code = "ST01"
     summary = "per-item bls verification in a loop"
+    fix_example = """\
+# ST01: verify signatures as one batch, not one pairing per item.
+-    for att in attestations:
+-        assert bls.Verify(pk(att), msg(att), att.signature)
++    entries = [(pk(a), msg(a), a.signature) for a in attestations]
++    assert verify.batch(entries)
+"""
 
     def check(self, ctx):
         if ctx.tree is None or ctx.in_dir("specs", "crypto"):

@@ -50,6 +50,12 @@ class HostSyncRule(Rule):
 
     code = "HD01"
     summary = "implicit device->host sync on the hot path"
+    fix_example = """\
+# HD01: int()/float()/.item() on a device array blocks the dispatch
+# queue; keep the value on device or sync once at the boundary.
+-    if int(total) > limit:          # device->host sync per call
++    if total_host > limit:          # synced once by the caller
+"""
 
     def check(self, ctx):
         if ctx.tree is None or ctx.in_dir("specs", "tests", "testing"):

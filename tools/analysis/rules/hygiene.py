@@ -21,6 +21,11 @@ class SyntaxErrorRule(Rule):
 
     code = "E999"
     summary = "syntax error"
+    fix_example = """\
+# E999: the file does not parse; every other rule is blind until fixed.
+-    def f(:
++    def f():
+"""
 
     def check(self, ctx):
         if ctx.syntax_error is not None:
@@ -36,6 +41,12 @@ class LineLengthRule(Rule):
 
     code = "E501"
     summary = "line too long (>120)"
+    fix_example = """\
+# E501: wrap at a call boundary instead of exceeding 120 columns.
+-    result = some_function(argument_one, argument_two, argument_three, argument_four, argument_five, argument_six_x)
++    result = some_function(argument_one, argument_two, argument_three,
++                           argument_four, argument_five, argument_six_x)
+"""
 
     def check(self, ctx):
         if ctx.is_spec_source:
@@ -51,6 +62,11 @@ class TrailingWhitespaceRule(Rule):
 
     code = "W291"
     summary = "trailing whitespace"
+    fix_example = """\
+# W291: delete the spaces after the last visible character.
+-    x = 1<space><space>
++    x = 1
+"""
 
     def check(self, ctx):
         for i, line in enumerate(ctx.lines, 1):
@@ -64,6 +80,11 @@ class TabIndentRule(Rule):
 
     code = "W191"
     summary = "tab indentation"
+    fix_example = """\
+# W191: indent with four spaces, never tabs.
+-\tx = 1
++    x = 1
+"""
 
     def check(self, ctx):
         for i, line in enumerate(ctx.lines, 1):
@@ -78,6 +99,12 @@ class BareExceptRule(Rule):
 
     code = "B001"
     summary = "bare except"
+    fix_example = """\
+# B001: catch the exception type you mean; bare except swallows
+# KeyboardInterrupt and masks real bugs.
+-    except:
++    except (OSError, ValueError):
+"""
 
     def check(self, ctx):
         if ctx.tree is None:
@@ -125,6 +152,11 @@ class UnusedImportRule(Rule):
 
     code = "F401"
     summary = "imported but unused"
+    fix_example = """\
+# F401: drop the import (or mark a deliberate re-export with noqa).
+-import os
+ import json
+"""
 
     def check(self, ctx):
         if ctx.tree is None or ctx.path.name == "__init__.py":
@@ -155,6 +187,11 @@ class InvalidEscapeRule(Rule):
 
     code = "W605"
     summary = "invalid escape sequence in non-raw string"
+    fix_example = """\
+# W605: make the string raw (or double the backslash).
+-    pattern = "\\d+"
++    pattern = r"\\d+"
+"""
 
     def check(self, ctx):
         if ctx.tree is None:
@@ -208,6 +245,12 @@ class MutableDefaultRule(Rule):
 
     code = "B006"
     summary = "mutable default argument"
+    fix_example = """\
+# B006: a mutable default is shared across calls; default to None.
+-def collect(items=[]):
++def collect(items=None):
++    items = [] if items is None else items
+"""
 
     def check(self, ctx):
         if ctx.tree is None:

@@ -50,6 +50,12 @@ class IoSafetyRule(Rule):
 
     code = "IO01"
     summary = "durable-artifact IO outside persist/atomic.py"
+    fix_example = """\
+# IO01: durable artifacts go through the atomic write/rename helper so a
+# crash never leaves a torn file.
+-    path.write_bytes(payload)
++    atomic.write_durable(path, payload)
+"""
 
     def check(self, ctx):
         if ctx.tree is None or "consensus_specs_tpu" not in ctx.parts:

@@ -58,6 +58,13 @@ class JitPurityRule(Rule):
 
     code = "JX01"
     summary = "impure operation inside a jit/shard_map-traced function"
+    fix_example = """\
+# JX01: traced functions must stay pure — hoist IO/global mutation out.
+ @jax.jit
+ def kernel(x):
+-    _COUNTER["calls"] += 1
+     return x * 2
+"""
 
     def check(self, ctx):
         if ctx.tree is None or ctx.in_dir("specs"):

@@ -174,6 +174,12 @@ class KeyCoverageRule(Rule):
 
     code = "CC02"
     summary = "memo lookup key omits an input the cached computation reads"
+    fix_example = """\
+# CC02: every input the cached computation reads must be in the key.
+-    key = (bytes(state.validators.hash_tree_root()),)
++    key = (bytes(state.validators.hash_tree_root()), int(epoch))
+     hit = _CACHE.get(key)
+"""
 
     registry = CACHE_REGISTRY
 

@@ -56,6 +56,14 @@ class LockDisciplineRule(Rule):
 
     code = "LK01"
     summary = "lock-discipline violation on a registered lock"
+    fix_example = """\
+# LK01: take registered locks with the with-statement, in the declared
+# order, never holding one across a blocking call.
+-    _STORE_LOCK.acquire()
+-    mutate(store)
++    with _STORE_LOCK:
++        mutate(store)
+"""
 
     def check(self, ctx):
         if ctx.tree is None or "consensus_specs_tpu" not in ctx.parts:

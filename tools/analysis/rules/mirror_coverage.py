@@ -1,0 +1,104 @@
+"""SP02: fast-path fork coverage of the spec-mirror registry.
+
+For every fork in ``stf/engine.py``'s ``FAST_FORKS``, every spec
+function reachable from the fast-path entry points over the intra-spec
+call graph — restricted to the state-mutating obligation set
+(``process_*``/``verify_*``/``on_*``) plus anything pinned or declared
+anywhere in the registry — must be covered at that fork: mirrored
+(``SpecPin``), declared literal (``LiteralSpec``), or explicitly waived
+(``WaiverSpec``).  Appending ``"capella"`` to ``FAST_FORKS`` with no
+capella declarations turns the gate red before a single wrong root
+ships.
+
+The rule parses FAST_FORKS out of the engine's AST (so override/mutation
+runs see the edited tuple) and walks the spec snapshot attached to the
+project by the runner.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+from ..core import FileContext, Rule, register
+from .. import mirror_registry, spec_extract
+
+
+def _parse_fast_forks(
+        tree: ast.Module) -> Tuple[int, Optional[Tuple[str, ...]]]:
+    """(line, forks) of the engine's FAST_FORKS tuple, or (1, None)."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name) and target.id == "FAST_FORKS":
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    forks = []
+                    for elt in node.value.elts:
+                        if (isinstance(elt, ast.Constant)
+                                and isinstance(elt.value, str)):
+                            forks.append(elt.value)
+                    return node.lineno, tuple(forks)
+                return node.lineno, None
+    return 1, None
+
+
+@register
+class MirrorCoverage(Rule):
+    """Each fork in ``stf/engine.py``'s ``FAST_FORKS`` promises the fast
+    path reproduces the full spec transition at that fork.  SP02 walks the
+    spec's intra-call graph from ``state_transition`` and requires every
+    reachable operative function (``process_*``/``verify_*``/``on_*`` and
+    anything already declared) to carry a mirror pin, a literal-replay
+    declaration, or a waiver at that fork.  Widening FAST_FORKS without
+    extending the registry is red at the FAST_FORKS line."""
+
+    code = "SP02"
+    summary = "FAST_FORKS fork with unmirrored reachable spec functions"
+    fix_example = """\
+# SP02 fires when FAST_FORKS grows a fork the registry doesn't cover:
+#   consensus_specs_tpu/stf/engine.py
+#     FAST_FORKS = ("phase0", "altair", "bellatrix", "capella")  # <- new
+#
+# Fix: for each named spec function, add to mirror_registry.py either a
+# SpecPin on the mirror that now handles it at that fork, or
+#   LiteralSpec("process_withdrawals", ("capella",),
+#               "runs literally inside the snapshot region"),
+# or a WaiverSpec with a justification.  Only then widen FAST_FORKS.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        if (ctx.display != mirror_registry.ENGINE_DISPLAY
+                or ctx.tree is None or ctx.project is None):
+            return
+        snap = getattr(ctx.project, "spec_snapshot", None)
+        if snap is None:
+            return
+        line, fast_forks = _parse_fast_forks(ctx.tree)
+        if fast_forks is None:
+            yield line, ("FAST_FORKS tuple of string literals not found in "
+                         "the engine — SP02 cannot audit fork coverage")
+            return
+        declared = mirror_registry.declared_names()
+        entries = ", ".join(mirror_registry.ENTRY_FUNCTIONS)
+        for fork in fast_forks:
+            if fork not in spec_extract.FORK_CHAINS:
+                yield line, (f"FAST_FORKS names fork {fork!r} with no "
+                             "declared spec chain in "
+                             "tools/analysis/spec_extract.py")
+                continue
+            reach = spec_extract.reachable(
+                snap, fork, mirror_registry.ENTRY_FUNCTIONS)
+            for name in sorted(reach):
+                obligated = (name.startswith(mirror_registry
+                                             .OBLIGATED_PREFIXES)
+                             or name in declared)
+                if not obligated:
+                    continue
+                if mirror_registry.coverage(name, fork) is None:
+                    fn = reach[name]
+                    yield line, (
+                        f"fast-path fork '{fork}': spec fn '{name}' "
+                        f"({fn.src}:{fn.line}) is reachable from "
+                        f"{entries} but has no mirror pin, literal "
+                        "declaration, or waiver at this fork in "
+                        "tools/analysis/mirror_registry.py")

@@ -1,0 +1,85 @@
+"""SP01: pinned spec digest drift under a fast-path mirror.
+
+Every mirror in ``mirror_registry.MIRRORS`` pins the AST-normalized
+SHA-256 of its spec twin's source per fork.  This rule re-extracts those
+digests from the spec snapshot the runner attaches to the project (so
+override runs see mutated spec sources) and goes red on any mismatch —
+the mirror must be re-audited against the new spec body and the pin
+bumped before the gate passes again.  Comment/whitespace/docstring churn
+never fires: the digest is over the docstring-stripped AST dump.
+
+Findings attach to the *mirror's* file at the mirror's def line; the
+registry's ``extra_file_deps`` folds the spec sources into each mirror
+file's dependency digest, so a spec edit re-derives exactly the pinned
+mirrors and nothing else.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+from ..core import FileContext, Rule, register
+from .. import mirror_registry
+
+
+@register
+class MirrorDrift(Rule):
+    """Every fast-path mirror pins the AST-normalized SHA-256 of its spec
+    twin's source per fork (tools/analysis/mirror_registry.py).  When a
+    spec source edit moves a pinned function's digest, the mirror is
+    silently computing something the spec no longer says: SP01 names the
+    mirror, the spec function, and the drifted fork(s) so the mirror is
+    re-audited before the pin is bumped.  Digests are AST-normalized —
+    comment, whitespace, and docstring churn never fires."""
+
+    code = "SP01"
+    summary = "fast-path mirror pinned against a drifted spec function"
+    fix_example = """\
+# SP01 fires when a spec source edit changes a pinned function, e.g.:
+#   consensus_specs_tpu/specs/src/phase0.py
+#     def process_block_header(state, block):
+#         ...
+#         assert block.slot >= state.slot   # <- semantic edit
+#
+# Fix: re-audit the mirror against the new spec body, port the change,
+# then bump the pin in tools/analysis/mirror_registry.py:
+#   SpecPin("process_block_header", ("phase0", "altair", "bellatrix"),
+#           "<new digest from the SP01 message>", ...)
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        mirrors = mirror_registry.mirrors_for_file(ctx.display)
+        if not mirrors or ctx.tree is None or ctx.project is None:
+            return
+        snap = getattr(ctx.project, "spec_snapshot", None)
+        if snap is None:
+            return
+        for m in mirrors:
+            node = mirror_registry.find_def(ctx.tree, m.qualname)
+            if node is None:
+                yield 1, (f"mirror '{m.qualname}' is registered in "
+                          "tools/analysis/mirror_registry.py but no such "
+                          f"def exists in {ctx.display}")
+                continue
+            line = node.lineno
+            for pin in m.pins:
+                drifted = []
+                for fork in pin.forks:
+                    fn = snap.get(fork, pin.fn)
+                    if fn is None:
+                        yield line, (
+                            f"mirror '{m.name}' pins spec fn '{pin.fn}' "
+                            f"which has no effective definition at fork "
+                            f"'{fork}'")
+                        continue
+                    if fn.digest != pin.digest:
+                        drifted.append((fork, fn))
+                if drifted:
+                    forks = ", ".join(f for f, _ in drifted)
+                    fn = drifted[0][1]
+                    yield line, (
+                        f"mirror '{m.qualname}' drifted from spec twin "
+                        f"'{pin.fn}' at fork(s) {forks}: pinned "
+                        f"{pin.digest[:12]} but {fn.src}:{fn.line} now "
+                        f"digests {fn.digest[:12]} — re-audit the mirror "
+                        "and bump the pin in "
+                        "tools/analysis/mirror_registry.py")

@@ -1,0 +1,90 @@
+"""SP03: raise-point audit of the declared guard mapping.
+
+Each ``SpecPin`` declares, per spec assert/raise site in source order,
+either a guard snippet that must literally appear inside the mirror's
+def, or ``None`` routing the site to literal replay.  This rule goes red
+when:
+
+* the spec function's extracted raise-site count or digest no longer
+  matches the pin (a new assert appeared, or one changed/moved) — the
+  guard mapping must be re-audited alongside the digest bump; or
+* a mapped guard snippet is no longer present in the mirror's source
+  segment (the guard was deleted or reworded without a registry update).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from ..core import FileContext, Rule, register
+from .. import mirror_registry
+
+
+@register
+class MirrorRaises(Rule):
+    """Every ``assert``/``raise`` site in a pinned spec function is
+    accounted for in the registry: either reproduced by a named guard
+    snippet that must appear verbatim in the mirror's source, or routed
+    to literal replay (``None`` slot).  SP03 is red when the spec's
+    raise-site count or digest no longer matches the pin (the spec grew
+    or changed a rejection path) or when a mapped guard has been deleted
+    from the mirror (the fast path stopped rejecting what the spec
+    rejects)."""
+
+    code = "SP03"
+    summary = "stale raise-point mapping between a spec twin and its mirror"
+    fix_example = """\
+# SP03 fires when a mapped guard disappears from a mirror, e.g.:
+#   stf/slot_roots.py::process_slots
+#     -    assert state.slot < slot     # <- deleted guard
+#
+# Fix: restore the guard (or route the spec site to literal replay on
+# purpose) and keep the pin's guard tuple in sync:
+#   SpecPin("process_slots", ..., raise_count=1,
+#           guards=("assert state.slot < slot",))
+# A raise-count/digest mismatch means the SPEC grew or changed a site:
+# re-audit every guard slot, then update raise_count/raise_digest.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        mirrors = mirror_registry.mirrors_for_file(ctx.display)
+        if not mirrors or ctx.tree is None or ctx.project is None:
+            return
+        snap = getattr(ctx.project, "spec_snapshot", None)
+        if snap is None:
+            return
+        for m in mirrors:
+            node = mirror_registry.find_def(ctx.tree, m.qualname)
+            if node is None:
+                continue  # SP01 reports the missing def
+            line = node.lineno
+            segment = ast.get_source_segment(ctx.text, node) or ""
+            for pin in m.pins:
+                stale = []
+                for fork in pin.forks:
+                    fn = snap.get(fork, pin.fn)
+                    if fn is None:
+                        continue  # SP01 reports the missing spec fn
+                    if (fn.raise_count != pin.raise_count
+                            or fn.raise_digest != pin.raise_digest):
+                        stale.append((fork, fn))
+                if stale:
+                    forks = ", ".join(f for f, _ in stale)
+                    fn = stale[0][1]
+                    yield line, (
+                        f"raise-point map for spec fn '{pin.fn}' at "
+                        f"fork(s) {forks} is stale: {fn.src} now has "
+                        f"{fn.raise_count} assert/raise site(s) (digest "
+                        f"{fn.raise_digest[:12]}) but mirror '{m.name}' "
+                        f"declares {pin.raise_count} "
+                        f"({pin.raise_digest[:12]}) — re-audit the guard "
+                        "mapping in tools/analysis/mirror_registry.py")
+                for i, guard in enumerate(pin.guards):
+                    if guard is not None and guard not in segment:
+                        yield line, (
+                            f"mapped guard {guard!r} for spec fn "
+                            f"'{pin.fn}' raise site {i + 1}/"
+                            f"{pin.raise_count} is gone from mirror "
+                            f"'{m.qualname}' — restore the guard or "
+                            "re-route the site in "
+                            "tools/analysis/mirror_registry.py")

@@ -77,6 +77,12 @@ class FlightRecorderDisciplineRule(Rule):
 
     code = "OB01"
     summary = "observability event bypasses its API, leaks a span, or logs an unsettled commit"
+    fix_example = """\
+# OB01: emit through the flight-recorder API so spans pair and commits
+# settle before they are logged.
+-    print(f"head now {root}")
++    recorder.event("head_update", root=root)
+"""
 
     def check(self, ctx):
         if ctx.tree is None or ctx.in_dir("telemetry", "specs", "tests"):

@@ -73,6 +73,13 @@ class RollbackSafetyRule(Rule):
 
     code = "RB01"
     summary = "state write outside the stf snapshot-protected region"
+    fix_example = """\
+# RB01: beacon-state mutation must happen inside the snapshot region so
+# a FastPathViolation can roll it back.
+-    state.slot = slot          # outside the snapshot scope
++    with snapshot_region(state):
++        state.slot = slot
+"""
 
     protected = PROTECTED_REGION
 

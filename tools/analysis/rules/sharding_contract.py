@@ -59,6 +59,12 @@ class ShardingContractRule(Rule):
 
     code = "SH01"
     summary = "shard_map/pjit callsite violates the sharding contract"
+    fix_example = """\
+# SH01: every shard_map callsite names its mesh axes and specs
+# explicitly against the declared mesh vocabulary.
+-    shard_map(kernel, mesh, in_specs=P("rows"), out_specs=P())
++    shard_map(kernel, mesh, in_specs=P("validators"), out_specs=P())
+"""
 
     def check(self, ctx):
         if ctx.tree is None or ctx.in_dir("specs"):

@@ -98,6 +98,13 @@ class ThreadRolesRule(Rule):
 
     code = "TH01"
     summary = "thread-role / shared-state discipline violation"
+    fix_example = """\
+# TH01: shared state declared in concurrency_registry.py may only be
+# touched under its guard (or from its owning role).
+-    node.head_root = new_head
++    with node._head_lock:
++        node.head_root = new_head
+"""
 
     def check(self, ctx):
         if ctx.tree is None or "consensus_specs_tpu" not in ctx.parts:

@@ -40,6 +40,7 @@ from .callgraph import FileSummary, summarize
 from .core import FileContext, Finding, all_rules
 from .dataflow import Project
 from .noqa import parse_noqa, suppressed
+from . import mirror_registry, spec_extract
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 DEFAULT_ROOTS = ("consensus_specs_tpu", "tests", "tools",
@@ -131,9 +132,18 @@ class Result:
     # wall time of the thread-role fixed point (ISSUE 15): the one pass
     # that runs warm or cold, so its budget is watched separately
     role_pass_s: float = 0.0
+    # wall time of the spec-source extraction pass (ISSUE 18) feeding
+    # SP01–SP03; like the role pass it runs warm or cold, so budgeted
+    mirror_pass_s: float = 0.0
+    # per-fork digests of the effective spec-function definitions — the
+    # ANALYSIS.json rows a pin bump is audited against
+    spec_snapshot: Dict[str, str] = field(default_factory=dict)
     # per-rule wall time + unsuppressed finding counts over the files
     # actually analyzed this run (cache hits skip rule execution)
     rule_stats: Dict[str, dict] = field(default_factory=dict)
+    # displays whose rules actually executed this run (cache misses);
+    # the --changed mode reports exactly this set
+    analyzed: List[str] = field(default_factory=list)
 
     def to_json(self) -> dict:
         def row(f: Finding) -> dict:
@@ -145,6 +155,8 @@ class Result:
             "cache_hits": self.cache_hits,
             "duration_s": round(self.duration_s, 3),
             "role_pass_s": round(self.role_pass_s, 4),
+            "mirror_pass_s": round(self.mirror_pass_s, 4),
+            "spec_snapshot": dict(sorted(self.spec_snapshot.items())),
             "rule_stats": {
                 code: {"time_s": round(s["time_s"], 4),
                        "findings": s["findings"]}
@@ -172,7 +184,8 @@ class _Entry:
 
 def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
         cache_path=None, baseline_path=None, rules=None,
-        overrides: Optional[Dict[str, str]] = None) -> Result:
+        overrides: Optional[Dict[str, str]] = None,
+        changed_only: bool = False) -> Result:
     """Analyze a tree.
 
     ``overrides`` maps display paths (repo-relative posix) to replacement
@@ -180,6 +193,13 @@ def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
     is on disk — the seeded-mutation tests use this to prove a
     reintroduced bug turns the gate red.  Override and rule-subset runs
     consult the cache read-only for untouched files.
+
+    ``changed_only`` (``make analyze-changed``) runs rules ONLY over
+    files whose own or dependency digest differs from the cache, reads
+    the cache without writing it, and reports exactly the re-derived
+    findings (``Result.analyzed`` lists the files that ran) — cached
+    findings of untouched files are not re-reported and the stale-
+    baseline sweep is restricted to the analyzed set.
     """
     t0 = time.perf_counter()
     root = Path(root) if root else REPO_ROOT
@@ -195,8 +215,10 @@ def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
         analyzer_version())
     # cached findings are only valid for the FULL registry on the REAL
     # tree: subset/override runs read (filtered) but must never seed
-    # entries a later full run would trust
-    write_cache = use_cache and rules is None and not overrides
+    # entries a later full run would trust; changed-only runs are
+    # read-only by contract (fast pre-commit use)
+    write_cache = (use_cache and rules is None and not overrides
+                   and not changed_only)
 
     result = Result()
     entries: List[_Entry] = []
@@ -248,6 +270,18 @@ def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
             cache.put_summary(e.display, e.digest, e.summary.to_json())
     project = Project([e.summary for e in entries])
 
+    # -- spec-source extraction (ISSUE 18): the per-fork effective-def
+    # snapshot SP01–SP03 read off ``ctx.project.spec_snapshot``.  Texts
+    # come from the scanned entries so override runs audit mutated spec
+    # sources, never the disk.
+    t_mirror = time.perf_counter()
+    by_display = {e.display: e.text for e in entries}
+    snap = spec_extract.snapshot(
+        {d: by_display.get(d) for d in spec_extract.spec_source_displays()})
+    project.spec_snapshot = snap
+    result.mirror_pass_s = time.perf_counter() - t_mirror
+    result.spec_snapshot = dict(snap.fork_digests)
+
     # the dependency digest folds in everything outside the file's own
     # bytes that can influence its findings: the shas of its transitive
     # import closure, plus the project-wide mesh-axis vocabulary SH01
@@ -260,9 +294,17 @@ def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
                  + "|" + project.role_salt())
     result.role_pass_s = project.role_pass_s
 
+    # registry-declared extra edges: each mirror file depends on the spec
+    # sources its pins digest (and the engine on all of them), so a spec
+    # edit re-derives exactly the mirrors pinned to it
+    mirror_deps = mirror_registry.extra_file_deps()
+
     def deps_digest(display: str) -> str:
         h = hashlib.sha256(axis_salt.encode())
-        for dep in sorted(project.dependencies(display)):
+        deps = set(project.dependencies(display))
+        deps.update(mirror_deps.get(display, ()))
+        deps.discard(display)
+        for dep in sorted(deps):
             h.update(dep.encode())
             h.update(shas.get(dep, "?").encode())
         return h.hexdigest()
@@ -277,6 +319,8 @@ def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
         dd = deps_digest(e.display)
         findings = (cache.get_findings(e.display, e.digest, dd)
                     if use_cache and not e.overridden else None)
+        if findings is not None and changed_only:
+            continue  # digests match the cache: the file is unchanged
         if findings is not None and subset_codes is not None:
             findings = [f for f in findings if f.code in subset_codes]
         if findings is None:
@@ -284,6 +328,7 @@ def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
                                              display=e.display)
             ctx.project = project
             findings = _check_ctx(ctx, rule_objs, result.rule_stats)
+            result.analyzed.append(e.display)
             if write_cache:
                 cache.put_findings(e.display, e.digest, dd, findings)
         for f in findings:
@@ -295,9 +340,10 @@ def run(roots=None, *, root: Optional[Path] = None, use_cache: bool = True,
     # stale = the entry's file was checked for findings and produced no
     # match, OR the file is gone entirely (deleted/renamed); a file
     # merely outside this run's report set is not evidence either way
+    checked = set(result.analyzed) if changed_only else reported
     result.stale_baseline = [
         e for e in baseline.stale_entries()
-        if e["file"] in reported or not (root / e["file"]).exists()]
+        if e["file"] in checked or not (root / e["file"]).exists()]
     result.duration_s = time.perf_counter() - t0
     return result
 

@@ -6,17 +6,22 @@ installs are barred).
 All checking lives in ``tools/analysis/``: a rule-plugin registry
 (hygiene codes E501/E999/W191/W291/W605/F401/B001/B006 plus the
 engine-invariant rules FC01/ST01/CC01/CC02/RB01/JX01/DT01 and the
-interprocedural rules HD01/SH01/EF01/OB01/IO01 plus the concurrency
-pair TH01/LK01 riding on the two-pass call-graph core with its
-thread-role fact family), per-code ``# noqa`` suppression, a reviewed
+interprocedural rules HD01/SH01/EF01/OB01/IO01, the concurrency
+pair TH01/LK01, and the spec-mirror parity family SP01/SP02/SP03
+riding on the two-pass call-graph core with its thread-role and
+spec-snapshot fact families), per-code ``# noqa`` suppression, a reviewed
 baseline for grandfathered findings (tools/analysis/baseline.json), and
 a dependency-aware content-hash incremental cache.
 This wrapper keeps the historical interface: ``python tools/lint.py
 [paths...]`` prints ``path:line: CODE message`` rows plus a summary line
 and exits 1 on unbaselined findings; ``--json OUT`` additionally writes
-the full report (``make analyze`` -> ANALYSIS.json).  ``check_file`` /
-``iter_py_files`` remain importable for scripts that drove the legacy
-checker.
+the full report (``make analyze`` -> ANALYSIS.json).  ``--explain CODE``
+prints a rule's catalog entry plus a minimal annotated fix example;
+``--prune-baseline`` rewrites baseline.json dropping stale entries;
+``--changed`` (``make analyze-changed``) re-analyzes only files whose
+content or dependency digest differs from the incremental cache.
+``check_file`` / ``iter_py_files`` remain importable for scripts that
+drove the legacy checker.
 """
 from __future__ import annotations
 
@@ -38,9 +43,38 @@ def check_file(path) -> list:
     return [(Path(path), f.line, f"{f.code} {f.message}") for f in findings]
 
 
+def explain(code: str) -> int:
+    """Print one rule's catalog entry + annotated fix example (exit 0),
+    or the known codes on an unregistered one (exit 2)."""
+    from analysis.core import REGISTRY, all_rules
+
+    all_rules()  # populate the registry
+    cls = REGISTRY.get(code)
+    if cls is None:
+        print(f"unknown rule code {code!r}; registered: "
+              + ", ".join(sorted(REGISTRY)))
+        return 2
+    print(f"{cls.code}: {cls.summary}")
+    doc = (cls.__doc__ or "").strip()
+    if doc:
+        print()
+        print(doc)
+    if cls.fix_example:
+        print()
+        print(cls.fix_example.rstrip())
+    return 0
+
+
 def main(argv):
     args = list(argv)
     json_out = None
+    if "--explain" in args:
+        i = args.index("--explain")
+        try:
+            return explain(args[i + 1])
+        except IndexError:
+            print("usage: lint.py --explain CODE")
+            return 2
     if "--json" in args:
         i = args.index("--json")
         try:
@@ -52,10 +86,17 @@ def main(argv):
     no_cache = "--no-cache" in args
     if no_cache:
         args.remove("--no-cache")
+    prune_baseline = "--prune-baseline" in args
+    if prune_baseline:
+        args.remove("--prune-baseline")
+    changed_only = "--changed" in args
+    if changed_only:
+        args.remove("--changed")
 
-    # a duplicate lock/role/structure declaration means two rules could
-    # disagree about the same object: refuse the whole run (exit 2)
+    # a duplicate lock/role/structure/mirror declaration means two rules
+    # could disagree about the same object: refuse the whole run (exit 2)
     from analysis.concurrency_registry import registry_errors
+    from analysis import mirror_registry
 
     errors = registry_errors()
     if errors:
@@ -64,22 +105,44 @@ def main(argv):
         print(f"lint: {len(errors)} duplicate/invalid concurrency-registry "
               "declaration(s) — fix tools/analysis/concurrency_registry.py")
         return 2
+    errors = mirror_registry.registry_errors()
+    if errors:
+        for e in errors:
+            print(f"mirror registry error: {e}")
+        print(f"lint: {len(errors)} invalid mirror-registry declaration(s) "
+              "— fix tools/analysis/mirror_registry.py")
+        return 2
 
     result = _runner.run(
         [Path(a) for a in args] if args else None,
-        use_cache=not no_cache)
+        use_cache=not no_cache, changed_only=changed_only)
     for f in result.findings:
         print(f.render())
     extra = ""
     if result.baselined:
         extra += f", {len(result.baselined)} baselined"
-    if result.stale_baseline:
+    if result.stale_baseline and prune_baseline:
+        from analysis.baseline import prune
+        from analysis.runner import DEFAULT_BASELINE
+
+        dropped = prune(DEFAULT_BASELINE, result.stale_baseline)
+        for e in dropped:
+            print(f"pruned stale baseline entry: "
+                  f"{e['file']}: {e['code']} {e['snippet']!r}")
+        extra += f", {len(dropped)} stale baseline entries pruned"
+        result.stale_baseline = []
+    elif result.stale_baseline:
         extra += f", {len(result.stale_baseline)} STALE baseline entries"
         for e in result.stale_baseline:
             print(f"stale baseline entry (fixed? remove it): "
                   f"{e['file']}: {e['code']} {e['snippet']!r}")
-    print(f"lint: {result.n_files} files checked, "
-          f"{len(result.findings)} findings{extra}")
+    if changed_only:
+        print(f"lint (changed-only): {len(result.analyzed)} of "
+              f"{result.n_files} files re-analyzed, "
+              f"{len(result.findings)} findings{extra}")
+    else:
+        print(f"lint: {result.n_files} files checked, "
+              f"{len(result.findings)} findings{extra}")
     if result.rule_stats:
         slowest = sorted(result.rule_stats.items(),
                          key=lambda kv: -kv[1]["time_s"])[:3]
