@@ -1241,7 +1241,8 @@ def _firehose_corpus_through_cache(spec, state, n_epochs, gossip_target):
 
 
 def bench_node_firehose(results, n_validators=None, n_epochs=2,
-                        gossip_target=100_000, n_gossip_producers=3):
+                        gossip_target=100_000, n_gossip_producers=3,
+                        row_key="node_firehose"):
     """Driver-parsed ``node_firehose`` row (ISSUE 12): the node serving
     pipeline under production-shaped concurrent load — ``n_epochs`` of
     full blocks routed through the engine-backed ``on_block`` (fork
@@ -1252,10 +1253,17 @@ def bench_node_firehose(results, n_validators=None, n_epochs=2,
     byte-identical head/root asserted.  BLS off like the fork-choice
     ingest row (orchestration, not pairing — the e2e rows gate that);
     the stf fast path must still carry EVERY block (zero replays, the
-    acceptance bar for the composition actually engaging)."""
+    acceptance bar for the composition actually engaging).
+
+    ``row_key`` parameterizes the contention sweep (ISSUE 19): the
+    driver runs a second leg at 16 producer threads
+    (``node_firehose_16p``) so the blocked-put fix is gated where it
+    actually shows — heavy producer fan-in over the same bounded
+    queue."""
     from consensus_specs_tpu import stf
     from consensus_specs_tpu.crypto import bls
     from consensus_specs_tpu.forkchoice import engine as fc_engine
+    from consensus_specs_tpu.node import admission
     from consensus_specs_tpu.node import firehose
     from consensus_specs_tpu.node import service as node_service
     from consensus_specs_tpu.specs.builder import get_spec
@@ -1301,8 +1309,10 @@ def bench_node_firehose(results, n_validators=None, n_epochs=2,
         roots = firehose.assert_parity(spec, node, ref)
 
         queue = run["queue"]
-        results["node_firehose"] = {
-            "metric": (f"node_firehose_{n_epochs}epochs_{n_gossip}_"
+        svc = run["service"]
+        adm = admission.stats
+        results[row_key] = {
+            "metric": (f"{row_key}_{n_epochs}epochs_{n_gossip}_"
                        f"gossip_atts_{n}_validators"),
             "value": run["elapsed_s"],
             "unit": "s",
@@ -1319,6 +1329,14 @@ def bench_node_firehose(results, n_validators=None, n_epochs=2,
             "queue_depth_max": queue["depth_max"],
             "queue_blocked_puts": queue["blocked_puts"],
             "queue_blocked_s": round(queue["blocked_s"], 3),
+            # micro-batching surface (ISSUE 19): how the apply loop
+            # actually consumed the load — drained batches, coalesced
+            # gossip runs, and admission-side aggregation absorbing the
+            # would-be blocked puts
+            "batches_applied": svc["batches_applied"],
+            "runs_coalesced": svc["runs_coalesced"],
+            "gossip_aggregated": adm["aggregated"],
+            "agg_flushes": adm["agg_flushes"],
             "state_build_s": round(t_build_state, 3),
             "corpus_build_s": round(t_corpus, 3),
             "corpus_cached": corpus_cached,
@@ -1334,8 +1352,11 @@ def bench_node_firehose(results, n_validators=None, n_epochs=2,
                 "breaker_state": stf.stats["breaker_state"],
                 "breaker_trips": stf.stats["breaker_trips"],
                 "native_degraded": stf_verify.stats["native_degraded"],
-                "rejected_batches": run["service"]["rejected_batches"],
-                "requeued_items": run["service"]["requeued_items"],
+                "rejected_batches": svc["rejected_batches"],
+                "requeued_items": svc["requeued_items"],
+                # a bisection on the honest corpus means a healthy run
+                # commit raised — the batching layer broke, not the load
+                "batch_bisections": svc["batch_bisections"],
                 "attestations_ingested":
                     fc_engine.stats["attestations_ingested"],
                 "fc_prunes": fc_engine.stats["prunes"],
@@ -1474,6 +1495,17 @@ def bench_node_firehose_adversarial(results, n_validators=None, n_epochs=3,
             node.store.block_states[head].hash_tree_root()), \
             "recovered node diverged from the crashed node's state"
 
+        # honest/adversarial serving ratio (ISSUE 19): the survival
+        # layer's overhead is a gated product number — the trend gate
+        # refuses when hostile load costs more than 1.3x the honest
+        # row's gossip throughput (same run, same corpus scale)
+        honest = results.get("node_firehose")
+        slowdown = None
+        if (isinstance(honest, dict) and honest.get("atts_per_s")
+                and run["atts_per_s"]):
+            slowdown = round(
+                float(honest["atts_per_s"]) / run["atts_per_s"], 2)
+
         results["node_firehose_adversarial"] = {
             "metric": (f"node_firehose_adversarial_{n_epochs}epochs_"
                        f"{n_gossip}_gossip_atts_{n}_validators"),
@@ -1482,6 +1514,12 @@ def bench_node_firehose_adversarial(results, n_validators=None, n_epochs=3,
             "vs_baseline": round(t_parity / run["elapsed_s"], 1),
             "blocks_per_s": run["blocks_per_s"],
             "atts_per_s": run["atts_per_s"],
+            "honest_atts_per_s": (honest or {}).get("atts_per_s"),
+            "vs_honest_slowdown": slowdown,
+            "batches_applied": svc["batches_applied"],
+            "runs_coalesced": svc["runs_coalesced"],
+            "batch_bisections": svc["batch_bisections"],
+            "gossip_aggregated": adm["aggregated"],
             "blocks": run["blocks"],
             "fork_blocks": run["fork_blocks"],
             "slashings": run["slashings"],
@@ -1502,7 +1540,8 @@ def bench_node_firehose_adversarial(results, n_validators=None, n_epochs=3,
                 "stale_blocks", "stale_ticks", "shed_items", "quarantines",
                 "dead_lettered", "orphan_pool_depth", "orphan_pool_cap",
                 "parked_depth", "parked_cap", "dead_letter_depth",
-                "dead_letter_cap", "seen_size", "seen_cap")},
+                "dead_letter_cap", "seen_size", "seen_cap",
+                "agg_depth", "agg_cap")},
             # counter invariants (the trend gate reads this subtree):
             # a halt-shaped regression — a replayed block, a quarantined
             # item in a fault-free run, an open breaker — refuses the
@@ -2214,6 +2253,66 @@ def check_query_trend(current, previous, threshold: float = 0.15):
             f"{threshold * 100.0:.0f}% budget)")
 
 
+def check_firehose_trend(current, previous, threshold: float = 0.15,
+                         slowdown_cap: float = 1.3,
+                         blocked_floor_s: float = 1.0):
+    """Serving-throughput gate for the ``node_firehose`` rows (ISSUE
+    19): wall time already rides ``check_perf_trend``, but the serving
+    claim is gossip throughput — ``atts_per_s`` can collapse while the
+    wall clock hides behind the fixed block work.  Refuses the headline
+    when:
+
+    * the row errored (the ISSUE-8 lesson: an opt-in row must not rot
+      silently for a round);
+    * ``atts_per_s`` (larger is better) dropped more than ``threshold``
+      vs the previous BENCH_DETAILS row;
+    * producer blocked time (``queue_blocked_s``) grew past
+      ``blocked_floor_s`` AND past the previous row's budgeted value —
+      the micro-batching tentpole turned the 37.8s blocked-put wall
+      into near-zero, and this is the counter that regresses first if
+      the drain/aggregation path stops absorbing back-pressure (the
+      floor keeps millisecond noise from refusing);
+    * the adversarial row's ``vs_honest_slowdown`` (honest atts/s over
+      adversarial atts/s, embedded by the bench) exceeds
+      ``slowdown_cap`` — survival overhead is a gated product number.
+
+    None when within budget or not comparable (row skipped, no previous
+    details, metric changed)."""
+    if not isinstance(current, dict):
+        return None
+    if "error" in current:
+        return f"node_firehose row errored: {current['error']}"
+    metric = current.get("metric", "node_firehose")
+    slowdown = current.get("vs_honest_slowdown")
+    if slowdown is not None and float(slowdown) > slowdown_cap:
+        return (f"{metric} adversarial slowdown {float(slowdown):.2f}x "
+                f"exceeds the {slowdown_cap:.1f}x cap vs the honest row")
+    if not isinstance(previous, dict) or "error" in previous:
+        return None
+    if current.get("metric") != previous.get("metric"):
+        return None
+    try:
+        cur, prev = float(current["atts_per_s"]), float(previous["atts_per_s"])
+    except (KeyError, TypeError, ValueError):
+        cur = prev = 0.0
+    if prev > 0 and cur < prev * (1.0 - threshold):
+        return (f"perf-trend regression: {metric} served "
+                f"{cur:.1f} att/s vs {prev:.1f} att/s in the previous run "
+                f"({(1.0 - cur / prev) * 100.0:.1f}% drop > "
+                f"{threshold * 100.0:.0f}% budget)")
+    try:
+        cur_b = float(current["queue_blocked_s"])
+        prev_b = float(previous["queue_blocked_s"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if cur_b > blocked_floor_s and cur_b > prev_b * (1.0 + threshold):
+        return (f"perf-trend regression: {metric} producers spent "
+                f"{cur_b:.3f}s blocked on the ingest queue vs "
+                f"{prev_b:.3f}s in the previous run — the apply loop "
+                f"stopped absorbing back-pressure")
+    return None
+
+
 def check_counter_invariants(current, previous=None, plan_floor=0.25,
                              memo_floor=0.25, h2c_drift=0.15,
                              overlap_floor=0.25):
@@ -2261,6 +2360,14 @@ def check_counter_invariants(current, previous=None, plan_floor=0.25,
         # containment layer absorbed it (wall-time would never show it)
         return (f"counter invariant: {metric} quarantined "
                 f"{tel['quarantined_items']} items in a fault-free run")
+    if tel.get("batch_bisections"):
+        # ISSUE 19: the honest firehose corpus is all-valid — a gossip
+        # run commit raising (the only bisection trigger) means the
+        # micro-batching layer itself regressed, and the per-item
+        # fallback would hide it from wall time
+        return (f"counter invariant: {metric} bisected "
+                f"{tel['batch_bisections']} gossip runs in a fault-free "
+                f"run")
     if tel.get("store_corruptions"):
         # ISSUE 14: a fault-free bench run writes and restores its own
         # checkpoints — a corrupt artifact here means the write path
@@ -2375,6 +2482,14 @@ def main():
             except Exception as exc:
                 results["node_firehose"] = {"error": repr(exc)[:300]}
             try:
+                # contention sweep (ISSUE 19): same corpus, 16 producer
+                # threads — gates that the bulk-drain/aggregation path
+                # holds queue_blocked_s near zero under heavy fan-in
+                bench_node_firehose(results, n_gossip_producers=15,
+                                    row_key="node_firehose_16p")
+            except Exception as exc:
+                results["node_firehose_16p"] = {"error": repr(exc)[:300]}
+            try:
                 bench_node_firehose_adversarial(results)
             except Exception as exc:
                 results["node_firehose_adversarial"] = {
@@ -2440,6 +2555,7 @@ def main():
     # its counter-invariant history must stay diffable run over run)
     for preserved in ("epoch_scale_1m", "epoch_e2e_scale_1m",
                       "epoch_e2e_scale_2m", "node_firehose",
+                      "node_firehose_16p",
                       "node_firehose_adversarial",
                       "node_recover_checkpoint",
                       "cold_start_checkpoint", "node_query_load"):
@@ -2528,7 +2644,8 @@ def main():
             # same way, and their wall time rides the perf trend too
             for row_key in ("epoch_e2e_bls", "epoch_e2e_bls_altair",
                             "epoch_e2e_scale_1m", "epoch_e2e_scale_2m",
-                            "node_firehose", "node_firehose_adversarial",
+                            "node_firehose", "node_firehose_16p",
+                            "node_firehose_adversarial",
                             "node_recover_checkpoint",
                             "cold_start_checkpoint", "node_query_load"):
                 regressions.append(check_counter_invariants(
@@ -2548,11 +2665,19 @@ def main():
             # erode run over run (ISSUE 12); the adversarial row joins
             # it (ISSUE 13): survival must not get slower either
             for row_key in ("epoch_e2e_scale_1m", "epoch_e2e_scale_2m",
-                            "node_firehose", "node_firehose_adversarial",
+                            "node_firehose", "node_firehose_16p",
+                            "node_firehose_adversarial",
                             "node_recover_checkpoint"):
                 regressions.append(check_perf_trend(
                     results.get(row_key), prev_details.get(row_key),
                     previous_details=prev_details.get(row_key)))
+            # ISSUE 19: the serving claim itself — gossip atts/s,
+            # producer blocked time, and the honest/adversarial ratio —
+            # refuses the headline like a wall-time slowdown
+            for row_key in ("node_firehose", "node_firehose_16p",
+                            "node_firehose_adversarial"):
+                regressions.append(check_firehose_trend(
+                    results.get(row_key), prev_details.get(row_key)))
         regressions = [r for r in regressions if r]
         if regressions:
             fc_row = results.get("forkchoice_batch_ingest")

@@ -55,6 +55,19 @@ BEFORE the spec sees it:
   consensus-critical objects must survive a misbehaving relay, and a
   block's validity is its own gate.
 
+* **back-pressure aggregation** — when the bounded ingest queue is full
+  the producers used to sleep in ``put`` (37.8 s cumulative at 4
+  firehose threads); now ``Node.enqueue_attestations`` routes the
+  overflow here instead (ISSUE 19): ``aggregate_gossip`` files the batch
+  into a bounded, content-root-grouped staging buffer (``_AGG``, keyed
+  by the first attestation's data root so same-data batches sit
+  adjacent), and the apply loop's micro-batcher pulls the groups back
+  out with ``drain_aggregated`` as ready-to-coalesce runs.  Aggregated
+  items never skipped admission — they are judged by ``admit`` like any
+  dequeued item when the writer gets to them.  At ``AGG_CAP`` the
+  buffer refuses and the producer falls back to the blocking ``put``,
+  so back-pressure still bounds total memory.
+
 * **dead-letter ring** — the apply loop's poison-pill containment
   (node/service.py) quarantines an item that keeps failing here: a
   bounded ring of (item kind, producer, error, attempts) records with a
@@ -93,6 +106,7 @@ ORPHAN_EXPIRY_SLOTS = 64        # two mainnet epochs: the vote window
 PARKED_CAP = 128
 DEAD_LETTER_CAP = 64
 SCORE_CAP = 256                 # distinct producers tracked
+AGG_CAP = 512                   # staged gossip batches during back-pressure
 
 # peer-scoring charge schedule + decay (docs/architecture.md has the
 # worked decay table): malformed junk is the strongest signal, a
@@ -140,6 +154,9 @@ stats = {
     "quarantines": 0,           # producer entered quarantine
     "releases": 0,              # producer left quarantine (decay)
     "dead_lettered": 0,
+    "aggregated": 0,            # gossip batches staged during back-pressure
+    "agg_flushes": 0,           # drain_aggregated calls that returned work
+    "agg_refusals": 0,          # buffer at cap: producer fell back to put
 }
 
 # guards stats + every pool below: admission runs on the single-writer
@@ -153,6 +170,12 @@ _PARKED: List[Tuple[int, WorkItem]] = []                # (slot, item)
 _DEAD_LETTERS: collections.deque = collections.deque(maxlen=DEAD_LETTER_CAP)
 _SCORES: Dict[str, float] = {}
 _QUARANTINED: set = set()
+# back-pressure staging: first-data-root -> [WorkItem], insertion-ordered
+# so the drain hands same-data batches back ADJACENT (maximal gossip runs
+# for the micro-batcher); counted separately because groups hold lists
+_AGG: "collections.OrderedDict[bytes, List[WorkItem]]" = \
+    collections.OrderedDict()
+_AGG_COUNT = 0
 
 
 def reset_stats() -> None:
@@ -179,18 +202,20 @@ def reset_transient() -> None:
     re-delivers them, and their seen-keys must not suppress that
     re-delivery as 'duplicates'), while the post-mortem evidence and
     the shed protection outlive the crash."""
-    global _ORPHAN_COUNT
+    global _ORPHAN_COUNT, _AGG_COUNT
     with _LOCK:
         _SEEN.clear()
         _ORPHANS.clear()
         _ORPHAN_COUNT = 0
         del _PARKED[:]
+        _AGG.clear()
+        _AGG_COUNT = 0
 
 
 def reset_state() -> None:
     """Drop every pool, the seen-set, and all peer scores (a fresh
     ``Node`` adopting the process-wide admission surface)."""
-    global _ORPHAN_COUNT
+    global _ORPHAN_COUNT, _AGG_COUNT
     with _LOCK:
         _SEEN.clear()
         _ORPHANS.clear()
@@ -199,6 +224,8 @@ def reset_state() -> None:
         _DEAD_LETTERS.clear()
         _SCORES.clear()
         _QUARANTINED.clear()
+        _AGG.clear()
+        _AGG_COUNT = 0
 
 
 # -- content keys --------------------------------------------------------------
@@ -628,6 +655,77 @@ def on_clock(current_slot: int, slots_advanced: int) -> List[WorkItem]:
     return release_parked(current_slot)
 
 
+# -- back-pressure aggregation (ISSUE 19) --------------------------------------
+
+
+def aggregate_gossip(payload, producer: str,
+                     link: Optional[int] = None) -> bool:
+    """Stage a gossip batch a full ingest queue refused (``try_put``
+    returned False): filed under the batch's first attestation-data root
+    so same-data batches come back out adjacent — ready-made gossip runs
+    for the micro-batcher.  Returns False (producer falls back to the
+    blocking ``put``) at ``AGG_CAP`` or for a batch whose first entry
+    cannot tree-hash (junk routes through normal admission so it is
+    charged, never silently staged)."""
+    global _AGG_COUNT
+    try:
+        key = bytes(payload[0].data.hash_tree_root())
+    except Exception:
+        return False
+    item = WorkItem("attestations", payload, link, producer)
+    with _LOCK:
+        if producer in _QUARANTINED:
+            # a quarantined peer's gossip must meet the shed check in
+            # FIFO order with the charges that quarantined it — staging
+            # would delay the judgment past the decay window
+            return False
+        if _AGG_COUNT >= AGG_CAP:
+            stats["agg_refusals"] += 1
+            return False
+        try:
+            _AGG.setdefault(key, []).append(item)
+            _AGG_COUNT += 1
+            stats["aggregated"] += 1
+        except BaseException:
+            bucket = _AGG.get(key)
+            if bucket is not None:
+                bucket[:] = [e for e in bucket if e is not item]
+                if not bucket:
+                    _AGG.pop(key, None)
+            raise
+    return True
+
+
+def drain_aggregated(max_items: Optional[int] = None) -> List[WorkItem]:
+    """Hand staged batches to the apply loop, group by group in staging
+    order (items inside a group keep arrival order).  The items were
+    never judged — the micro-batcher routes each through ``admit`` like
+    any dequeued work.  A ``max_items`` bound may split a group; the
+    remainder stays staged at the front."""
+    global _AGG_COUNT
+    out: List[WorkItem] = []
+    with _LOCK:
+        while _AGG and (max_items is None or len(out) < max_items):
+            key, bucket = next(iter(_AGG.items()))
+            room = None if max_items is None else max_items - len(out)
+            if room is None or len(bucket) <= room:
+                out.extend(bucket)
+                _AGG.pop(key)
+            else:
+                out.extend(bucket[:room])
+                bucket[:] = bucket[room:]
+        # one recount beats per-branch bookkeeping under the lock
+        _AGG_COUNT = sum(len(b) for b in _AGG.values())
+        if out:
+            stats["agg_flushes"] += 1
+    return out
+
+
+def aggregation_depth() -> int:
+    with _LOCK:
+        return _AGG_COUNT
+
+
 # -- dead-letter ring ----------------------------------------------------------
 
 
@@ -706,6 +804,8 @@ def snapshot() -> dict:
             "seen_cap": SEEN_CAP,
             "scores_size": len(_SCORES),
             "scores_cap": SCORE_CAP,
+            "agg_depth": _AGG_COUNT,
+            "agg_cap": AGG_CAP,
             "producer_scores": {p: round(s, 3)
                                 for p, s in sorted(_SCORES.items())},
             "quarantined_producers": sorted(_QUARANTINED),

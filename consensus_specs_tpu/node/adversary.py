@@ -38,8 +38,8 @@ contract:
   reaches the same head, state root, checkpoints, and latest messages
   (``firehose.assert_parity``);
 * **bounded memory** — every admission structure (orphan pool, parked
-  ring, dead-letter ring, seen-set, score table) sits at or under its
-  cap in the bus snapshot (``assert_bounded``).
+  ring, dead-letter ring, seen-set, score table, aggregation buffer)
+  sits at or under its cap in the bus snapshot (``assert_bounded``).
 """
 from __future__ import annotations
 
@@ -302,7 +302,8 @@ def assert_bounded(snap: dict = None) -> dict:
             ("parked_depth", "parked_cap"),
             ("dead_letter_depth", "dead_letter_cap"),
             ("seen_size", "seen_cap"),
-            ("scores_size", "scores_cap")):
+            ("scores_size", "scores_cap"),
+            ("agg_depth", "agg_cap")):
         assert snap[size_key] <= snap[cap_key], (
             f"admission {size_key} {snap[size_key]} over its cap "
             f"{snap[cap_key]} — an unbounded survival structure")

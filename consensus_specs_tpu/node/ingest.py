@@ -15,8 +15,11 @@ is the boundary between the two worlds:
   full queue BLOCKS (back-pressure, the production behavior: a node
   sheds load by slowing its gossip readers, not by growing without
   bound).  Blocked puts and the seconds spent blocked are counted;
-* **single-consumer** — ``get`` hands items to the apply loop; ``close``
-  lets producers finish a run (drained queue + closed == end of stream).
+* **single-consumer** — ``get`` hands items to the apply loop one at a
+  time; ``drain`` pulls EVERYTHING admissible in one lock acquisition
+  and wakes every blocked producer with a single ``notify_all`` (the
+  micro-batcher's entry point — ISSUE 19); ``close`` lets producers
+  finish a run (drained queue + closed == end of stream).
 
 Every item carries a timeline causality link allocated at enqueue time:
 the producer's ``node/enqueue`` span and the apply loop's ``node/apply``
@@ -163,6 +166,36 @@ class IngestQueue:
                         stats["producers"].get(name, 0) + 1
                 self._not_empty.notify()
 
+    def try_put(self, kind: str, payload) -> bool:
+        """Non-blocking enqueue: True when the item landed, False when
+        the queue sits at cap — the caller turns to useful work
+        (admission-side aggregation, node/admission.py) instead of
+        blocking, so a False does NOT count as a blocked put.  Raises
+        ``RuntimeError`` on a closed queue exactly like ``put``."""
+        _SITE_ENQUEUE()
+        link = timeline.next_link() if timeline.enabled() else None
+        name = threading.current_thread().name
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("put into a closed ingest queue")
+            if len(self._items) >= self._cap:
+                return False
+            self._items.append(WorkItem(kind, payload, link, name))
+            depth = len(self._items)
+            with _STATS_LOCK:
+                stats["enqueued"] += 1
+                if depth > stats["depth_max"]:
+                    stats["depth_max"] = depth
+                stats["producers"][name] = \
+                    stats["producers"].get(name, 0) + 1
+            self._not_empty.notify()
+        if link is not None:
+            # the handoff edge for Perfetto: emitted after the lock so
+            # the timeline ring is never touched under the queue lock
+            with timeline.span("node/enqueue", link=link, kind=kind):
+                pass
+        return True
+
     def close(self) -> None:
         """End of stream: no further puts; ``get`` returns None once the
         backlog drains.  Blocked producers wake and see the close."""
@@ -195,6 +228,38 @@ class IngestQueue:
                 stats["dequeued"] += 1
             self._not_full.notify()
             return item
+
+    def drain(self, timeout: Optional[float] = None,
+              max_items: Optional[int] = None):
+        """Bulk dequeue: ONE lock acquisition pulls every queued item (up
+        to ``max_items``), then wakes EVERY blocked producer with a
+        single ``notify_all`` — a batch removal frees many slots, and the
+        per-item ``notify`` of ``get`` would leave all but one producer
+        sleeping on a queue with room (ISSUE 19 satellite).  Blocks like
+        ``get`` while the queue is empty; ``timeout=0`` is the
+        opportunistic non-blocking probe.  Returns None when the queue is
+        closed AND drained (end of stream) or on timeout, else a
+        non-empty list in FIFO order."""
+        with self._not_empty:
+            deadline = (None if timeout is None
+                        else time.perf_counter() + timeout)
+            while not self._items:
+                if self._closed:
+                    return None
+                remaining = (None if deadline is None
+                             else deadline - time.perf_counter())
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._not_empty.wait(remaining)
+            if max_items is None or max_items >= len(self._items):
+                batch = list(self._items)
+                self._items.clear()
+            else:
+                batch = [self._items.popleft() for _ in range(max_items)]
+            with _STATS_LOCK:
+                stats["dequeued"] += len(batch)
+            self._not_full.notify_all()
+            return batch
 
     def requeue_front(self, item: WorkItem,
                       count_attempt: bool = True) -> WorkItem:

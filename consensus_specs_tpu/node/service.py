@@ -29,6 +29,20 @@ pipeline:
   fires before any store/proto mutation so an injected failure leaves
   both untouched (tests/chaos/test_node_chaos.py).
 
+* **adaptive micro-batching (ISSUE 19)** — the loop drains the WHOLE
+  queue in one lock acquisition (waking every blocked producer at
+  once), partitions the batch into strict-order items (blocks, ticks,
+  slashings — the full rollback contract, unchanged) and consecutive
+  gossip **runs** that land through ONE staged-commit
+  ``forkchoice.batch.ingest_attestations`` each, and flushes the
+  admission gate's back-pressure aggregation buffer into the same
+  drain.  The journal keeps per-item provenance (one entry per original
+  gossip batch, in arrival order), so replay parity and ``recover_node``
+  hold byte-identically.  A spec-rejected run BISECTS to its poison
+  item (``stf/verify.py``'s ``first_invalid`` pattern at the node
+  layer, fault site ``node.batch_bisect``): the clean remainder lands,
+  exactly the poison producer is charged.
+
 * **the survival layer (ISSUE 13)** — every loop item passes the
   admission gate (``node/admission.py``: content-root dedup, orphan
   pool, future parking, malformed rejection, peer quarantine) before a
@@ -67,10 +81,11 @@ from typing import Optional, Sequence
 
 from consensus_specs_tpu import faults, telemetry
 from consensus_specs_tpu.forkchoice import ForkChoiceEngine
+from consensus_specs_tpu.forkchoice import batch as fc_batch
 from consensus_specs_tpu.persist import store as persist_store
 from consensus_specs_tpu.query.engine import QueryEngine
 from consensus_specs_tpu.stf import apply_signed_blocks
-from consensus_specs_tpu.telemetry import recorder, timeline
+from consensus_specs_tpu.telemetry import histogram, recorder, timeline
 
 from . import admission, ingest
 
@@ -82,6 +97,10 @@ _SITE_APPLY = faults.site("node.apply")
 # leaves the half-built node discarded and nothing global touched — a
 # retried recovery starts clean (tests/chaos/test_node_chaos.py)
 _SITE_RECOVER = faults.site("node.recover")
+# probed at each bisection step of a spec-rejected gossip run (ISSUE
+# 19): an injected failure abandons the bisection machinery and falls
+# back to item-at-a-time apply — containment degrades, never breaks
+_SITE_BISECT = faults.site("node.batch_bisect")
 
 # total apply ATTEMPTS a poison item gets before the loop quarantines it
 # to the dead-letter ring (the containment contract: the node keeps
@@ -108,6 +127,9 @@ stats = {
     "checkpoints_scheduled": 0,
     "checkpoint_gather_failures": 0,
     "apply_loop_runs": 0,
+    "batches_applied": 0,      # drained micro-batches (ISSUE 19)
+    "runs_coalesced": 0,       # multi-item gossip runs landed as one ingest
+    "batch_bisections": 0,     # spec-rejected runs bisected to the poison
 }
 
 
@@ -374,7 +396,26 @@ class Node:
 
     def enqueue_attestations(self, attestations: Sequence,
                              timeout: Optional[float] = None) -> None:
-        self.queue.put("attestations", tuple(attestations), timeout=timeout)
+        payload = tuple(attestations)
+        if self._admission:
+            # back-pressure becomes aggregation work (ISSUE 19): when
+            # the queue sits at cap, the batch goes to the admission
+            # gate's staging buffer instead of blocking the producer;
+            # the apply loop flushes the buffer into its next drain.
+            # Refused stagings (buffer at cap, undecodable payload,
+            # quarantined producer) fall through to the blocking put —
+            # the original back-pressure contract
+            if self.queue.try_put("attestations", payload):
+                return
+            link = timeline.next_link() if timeline.enabled() else None
+            if admission.aggregate_gossip(
+                    payload, threading.current_thread().name, link):
+                if link is not None:
+                    with timeline.span("node/enqueue", link=link,
+                                       kind="attestations", aggregated=True):
+                        pass
+                return
+        self.queue.put("attestations", payload, timeout=timeout)
 
     def enqueue_attester_slashing(self, attester_slashing,
                                   timeout: Optional[float] = None) -> None:
@@ -444,16 +485,25 @@ class Node:
             self.queue.requeue_front(item)
             stats["requeued_items"] += 1
 
-    def _process_item(self, item: ingest.WorkItem,
-                      readmit: bool = False) -> None:
+    def _process_item(self, item: ingest.WorkItem, readmit: bool = False,
+                      tail: tuple = (), admitted: bool = False) -> bool:
         """One dequeued item through the survival layer: admission
         verdict, apply, containment, and the follow-ups a success
         unlocks (orphan re-links after a block, parked releases after a
         tick) — processed iteratively so a long re-link chain cannot
-        recurse."""
-        work = collections.deque([(item, readmit)])
+        recurse.
+
+        ``tail`` is the drained micro-batch's unprocessed remainder
+        (ISSUE 19): a containment re-queue puts it back BEHIND the
+        retried item — exact pre-drain order — and the method returns
+        False so the batch stops and the loop re-drains.  ``admitted``
+        marks an item already past the gate this drain (a gossip-run
+        member falling back to item-at-a-time apply); its verdict is
+        not re-judged."""
+        stop = False
+        work = collections.deque([(item, readmit, admitted)])
         while work:
-            it, re = work.popleft()
+            it, re, adm = work.popleft()
             clock_before = self._clock_slot
             try:
                 # admission runs INSIDE containment: a fault at the gate
@@ -462,7 +512,7 @@ class Node:
                 # A retried item (attempts > 0) already passed the dedup
                 # check once and sits in the seen-set: it re-enters as a
                 # re-admission, not a duplicate.
-                if self._admission:
+                if self._admission and not adm:
                     verdict, it = admission.admit(
                         self.spec, self.store, it, self._clock_slot,
                         readmit=re or it.attempts > 0 or it.readmit)
@@ -472,14 +522,26 @@ class Node:
             except AssertionError:
                 self._count_rejected(it)
             except Exception as exc:
+                will_retry = it.attempts + 1 < self._max_item_retries
+                if will_retry and tail:
+                    # the retried item lands FIRST (inside
+                    # _contain_failure below), the batch tail right
+                    # behind it — exact pre-drain order
+                    for rest in reversed(tail):
+                        self.queue.requeue_front(rest, count_attempt=False)
+                    tail = ()
                 try:
                     self._contain_failure(it, exc)
                 except BaseException:
                     # containment itself failed (e.g. a quarantine
                     # fault): restore the queue in EXACT order — the
                     # in-flight item first, its pending followups right
-                    # behind — and propagate loudly
-                    for rest, _re in reversed(work):
+                    # behind, then any batch tail not yet returned —
+                    # and propagate loudly
+                    for rest in reversed(tail):
+                        self.queue.requeue_front(rest, count_attempt=False)
+                    tail = ()
+                    for rest, _re, _adm in reversed(work):
                         self.queue.requeue_front(
                             rest._replace(readmit=True),
                             count_attempt=False)
@@ -487,16 +549,22 @@ class Node:
                     self.queue.requeue_front(it)
                     stats["requeued_items"] += 1
                     raise
+                if will_retry:
+                    stop = True
             except BaseException:
                 # a real kill (KeyboardInterrupt, SystemExit): crash
                 # semantics — the item back at the head, the journal a
                 # true history, recovery's replay picks up from here.
                 # Pending followups were already POPPED from the
                 # admission pools: re-queue them behind the in-flight
-                # item (in order) or they would vanish unaccounted.
+                # item (in order) or they would vanish unaccounted, and
+                # the batch tail behind THEM (exact pre-drain order).
                 # Neither they nor the interrupted item FAILED — the
                 # kill is not a poison signal, so no attempt is charged
-                for rest, _re in reversed(work):
+                for rest in reversed(tail):
+                    self.queue.requeue_front(rest, count_attempt=False)
+                tail = ()
+                for rest, _re, _adm in reversed(work):
                     self.queue.requeue_front(rest._replace(readmit=True),
                                              count_attempt=False)
                 work.clear()
@@ -509,13 +577,250 @@ class Node:
                     continue
                 if it.kind == "block":
                     root = bytes(it.payload.message.hash_tree_root())
-                    work.extend((child, True)
+                    work.extend((child, True, False)
                                 for child in admission.pop_children(root))
                 elif it.kind == "tick":
                     released = admission.on_clock(
                         self._clock_slot,
                         self._clock_slot - clock_before)
-                    work.extend((r, True) for r in released)
+                    work.extend((r, True, False) for r in released)
+        return not stop
+
+    # -- the micro-batcher (ISSUE 19) ----------------------------------------
+
+    def _drain_aggregated(self, max_items: Optional[int] = None) -> list:
+        """Flush the admission gate's back-pressure aggregation buffer
+        into the current drain (gate off: nothing ever staged)."""
+        if not self._admission:
+            return []
+        return admission.drain_aggregated(max_items)
+
+    def _process_batch(self, batch: list) -> int:
+        """Partition one drained micro-batch: blocks, ticks, and
+        slashings stay strict-order item-at-a-time through the full
+        rollback contract; maximal consecutive gossip slices become
+        runs.  Returns the number of batch items consumed before the
+        batch completed or stopped (a containment re-queue returned the
+        remainder to the real queue)."""
+        pending = collections.deque(batch)
+        consumed = 0
+        while pending:
+            if (pending[0].kind == "attestations" and len(pending) > 1
+                    and pending[1].kind == "attestations"):
+                run = []
+                while pending and pending[0].kind == "attestations":
+                    run.append(pending.popleft())
+                consumed += len(run)
+                if not self._process_gossip_run(run, tuple(pending)):
+                    return consumed
+            else:
+                it = pending.popleft()
+                consumed += 1
+                if not self._process_item(it, tail=tuple(pending)):
+                    return consumed
+                # the epoch fence must fire PER settled item, not per
+                # drained batch: one bulk drain can carry ticks crossing
+                # several epoch boundaries, and each crossing owes its
+                # own checkpoint (only ticks move the clock, so gossip
+                # runs never need the check)
+                if self._ckpt_store is not None:
+                    self._maybe_checkpoint()
+        return consumed
+
+    def _process_gossip_run(self, run: list, tail: tuple) -> bool:
+        """A maximal consecutive slice of gossip items from one drain:
+        judge each at the gate in FIFO order, then land every admitted
+        batch through ONE staged-commit fork-choice ingest
+        (``_commit_run``).  Returns False when items went back to the
+        real queue (the batch stops and the loop re-drains)."""
+        admitted = []
+        pending = collections.deque(run)
+        while pending:
+            it = pending.popleft()
+            if not self._admission:
+                admitted.append(it)
+                continue
+            try:
+                verdict, judged = admission.admit(
+                    self.spec, self.store, it, self._clock_slot,
+                    readmit=it.attempts > 0 or it.readmit)
+            except Exception as exc:
+                # infrastructure failure at the gate mid-run: the
+                # admitted prefix keeps its place (marked readmit — its
+                # seen-keys are in), the failing item gets the per-item
+                # containment verdict, the unjudged rest and the batch
+                # tail line up behind — exact pre-drain order
+                will_retry = it.attempts + 1 < self._max_item_retries
+                if will_retry:
+                    for rest in reversed(tail):
+                        self.queue.requeue_front(rest, count_attempt=False)
+                    for rest in reversed(pending):
+                        self.queue.requeue_front(rest, count_attempt=False)
+                try:
+                    self._contain_failure(it, exc)
+                except BaseException:
+                    if not will_retry:
+                        for rest in reversed(tail):
+                            self.queue.requeue_front(rest,
+                                                     count_attempt=False)
+                        for rest in reversed(pending):
+                            self.queue.requeue_front(rest,
+                                                     count_attempt=False)
+                    self.queue.requeue_front(it)
+                    stats["requeued_items"] += 1
+                    for rest in reversed(admitted):
+                        self.queue.requeue_front(rest, count_attempt=False)
+                    raise
+                if will_retry:
+                    for rest in reversed(admitted):
+                        self.queue.requeue_front(rest, count_attempt=False)
+                    return False
+                continue
+            if verdict == admission.VERDICT_ADMIT:
+                # marked readmit: from here on the item is past the
+                # gate — any later re-queue must skip the dedup check
+                admitted.append(judged._replace(readmit=True))
+        if not admitted:
+            return True
+        return self._commit_run(admitted, tail)
+
+    def _commit_run(self, items: list, tail: tuple) -> bool:
+        """Land an admitted gossip run as one combined ingest, with the
+        containment ladder batching adds: a spec rejection anywhere in
+        the combined batch bisects to the poison item; an infrastructure
+        failure falls back to item-at-a-time apply (one retry event for
+        the run); a kill restores exact order and propagates."""
+        try:
+            self._apply_gossip_run(items)
+            return True
+        except AssertionError:
+            return self._bisect_run(items, tail)
+        except Exception:
+            stats["retried_items"] += 1
+            return self._apply_items_individually(items, tail)
+        except BaseException:
+            for rest in reversed(tail):
+                self.queue.requeue_front(rest, count_attempt=False)
+            for rest in reversed(items):
+                self.queue.requeue_front(rest, count_attempt=False)
+            stats["requeued_items"] += 1
+            raise
+
+    def _apply_gossip_run(self, items: Sequence) -> None:
+        """Land admitted gossip items as ONE staged-commit fork-choice
+        ingest — ``batch.ingest_attestations`` validates the whole
+        combined batch before a single vote lands, so a failure leaves
+        vote state untouched — while the journal keeps per-item
+        provenance: one entry per original batch, in arrival order, so
+        journal-replay parity and ``recover_node`` stay
+        byte-identical."""
+        combined = [a for it in items for a in it.payload]
+        with timeline.span("node/apply", link=items[0].link,
+                           kind="attestations", run=len(items)):
+            with self._single_writer():
+                _SITE_APPLY()
+                self.engine.on_attestations(combined)
+                for it in items:
+                    stats["attestation_batches_applied"] += 1
+                    stats["attestations_applied"] += len(it.payload)
+                    self._journal_append("attestations", tuple(it.payload))
+        if recorder.enabled():
+            for it in items:
+                recorder.record("node_gossip", n=len(it.payload))
+        if timeline.enabled():
+            # the coalesced items' causality links still need an apply
+            # edge each, or Perfetto shows orphaned enqueue arrows
+            for it in items[1:]:
+                with timeline.span("node/apply", link=it.link,
+                                   kind="attestations", coalesced=True):
+                    pass
+        if len(items) > 1:
+            stats["runs_coalesced"] += 1
+        histogram.observe("gossip_run", float(len(items)))
+
+    def _probe_run(self, items: Sequence) -> bool:
+        """Validation-only probe of a candidate slice: stage through the
+        batch ingest and DISCARD — ``forkchoice/batch`` validates every
+        attestation before staging and commits nothing until
+        ``commit_votes``, so a probe's only store touch is the
+        idempotent target-checkpoint-state cache the spec handler would
+        populate anyway."""
+        combined = [a for it in items for a in it.payload]
+        try:
+            with self._single_writer():
+                fc_batch.ingest_attestations(self.spec, self.engine.store,
+                                             combined)
+        except AssertionError:
+            return False
+        return True
+
+    def _bisect_run(self, items: list, tail: tuple) -> bool:
+        """The combined commit was spec-rejected: bisect to the poison
+        item (``stf/verify.py``'s ``first_invalid`` pattern at the node
+        layer) with validation-only probes, land every clean slice as a
+        run, hand exactly the poison item to the per-item containment
+        core (charged + forgotten there), and continue with the rest.
+        The ``node.batch_bisect`` probe fires once per bisection step;
+        any machinery failure degrades to item-at-a-time apply."""
+        stats["batch_bisections"] += 1
+        pending = list(items)
+        known_bad = True
+        while pending:
+            try:
+                if not known_bad:
+                    if self._probe_run(pending):
+                        self._apply_gossip_run(pending)
+                        return True
+                if len(pending) == 1:
+                    lo = 0
+                else:
+                    # invariant: pending[:lo] verifies; a failure sits
+                    # in pending[lo:hi] (the stf first_invalid loop)
+                    lo, hi = 0, len(pending)
+                    while hi - lo > 1:
+                        _SITE_BISECT()
+                        mid = (lo + hi) // 2
+                        if self._probe_run(pending[lo:mid]):
+                            lo = mid
+                        else:
+                            hi = mid
+                    if lo > 0:
+                        self._apply_gossip_run(pending[:lo])
+            except Exception:
+                # the bisection machinery itself died (an injected
+                # node.batch_bisect fault, a probe infrastructure
+                # error): item-at-a-time fallback keeps every
+                # containment guarantee for what is left
+                stats["retried_items"] += 1
+                return self._apply_items_individually(pending, tail)
+            except BaseException:
+                for rest in reversed(tail):
+                    self.queue.requeue_front(rest, count_attempt=False)
+                for rest in reversed(pending):
+                    self.queue.requeue_front(rest, count_attempt=False)
+                stats["requeued_items"] += 1
+                raise
+            poison, pending = pending[lo], pending[lo + 1:]
+            if not self._process_item(poison, tail=tuple(pending) + tail,
+                                      admitted=True):
+                return False
+            known_bad = False
+        return True
+
+    def _apply_items_individually(self, items: Sequence,
+                                  tail: tuple) -> bool:
+        """Fallback from a failed combined commit: every run item
+        through the per-item containment core.  Admission is not
+        re-judged (the run already passed the gate); rejection counting,
+        retry/backoff, quarantine, and crash ordering all apply
+        unchanged."""
+        pending = collections.deque(items)
+        while pending:
+            it = pending.popleft()
+            if not self._process_item(it, tail=tuple(pending) + tail,
+                                      admitted=True):
+                return False
+        return True
 
     # -- durable checkpoints (ISSUE 14) --------------------------------------
 
@@ -591,17 +896,32 @@ class Node:
         node's cap with backoff, then quarantined to the dead-letter
         ring (``node_quarantine`` flight-recorder event) while serving
         continues.  ``max_items`` stops the loop after that many items —
-        the crash-drill hook the recovery tests kill the loop with."""
+        the crash-drill hook the recovery tests kill the loop with.
+
+        The drain is an adaptive micro-batcher (ISSUE 19): one bulk
+        ``drain`` pulls everything queued — waking every blocked
+        producer with a single ``notify_all`` — the admission gate's
+        back-pressure aggregation buffer flushes into the same batch,
+        and ``_process_batch`` partitions it into strict-order items
+        and coalesced gossip runs."""
         stats["apply_loop_runs"] += 1
         processed = 0
         while max_items is None or processed < max_items:
-            item = self.queue.get(timeout=timeout)
-            if item is None:
-                return processed
-            self._process_item(item)
-            processed += 1
-            if self._ckpt_store is not None:
-                self._maybe_checkpoint()
+            limit = None if max_items is None else max_items - processed
+            batch = self.queue.drain(timeout=timeout, max_items=limit)
+            if batch is None:
+                # end of stream (or timeout): whatever back-pressure
+                # staged in the aggregation buffer still owes an apply
+                batch = self._drain_aggregated(limit)
+                if not batch:
+                    return processed
+            else:
+                room = None if limit is None else limit - len(batch)
+                if room is None or room > 0:
+                    batch.extend(self._drain_aggregated(room))
+            histogram.observe("drain_batch", float(len(batch)))
+            stats["batches_applied"] += 1
+            processed += self._process_batch(batch)
         return processed
 
 

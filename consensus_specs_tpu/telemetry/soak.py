@@ -227,7 +227,8 @@ def bounded_cache_sizes() -> List[dict]:
             ("node.admission.dead_letters", "dead_letter_depth",
              "dead_letter_cap"),
             ("node.admission.seen", "seen_size", "seen_cap"),
-            ("node.admission.scores", "scores_size", "scores_cap")):
+            ("node.admission.scores", "scores_size", "scores_cap"),
+            ("node.admission.aggregation", "agg_depth", "agg_cap")):
         samples.append({"name": name, "size": adm.get(size_key, 0),
                         "cap": adm.get(cap_key, 0)})
     for key in ("ctx_size", "ctx_lookup_size", "plan_ctx_lookup_size",

@@ -295,6 +295,24 @@ def test_th01_lock_free_requeue_front_mutation_turns_red(gate):
                and "IngestQueue._lock" in f.message for f in found), found
 
 
+def test_th01_unguarded_aggregation_buffer_write_mutation_turns_red(gate):
+    # ISSUE 19's cross-role staging buffer: gossip producers write it,
+    # the apply loop drains it — the admission lock dropped from the
+    # producer-side staging write must turn the gate red
+    rel = "consensus_specs_tpu/node/admission.py"
+    found = _mutated(gate, {rel: lambda t: t.replace(
+        '    item = WorkItem("attestations", payload, link, producer)\n'
+        "    with _LOCK:\n"
+        "        if producer in _QUARANTINED:",
+        '    item = WorkItem("attestations", payload, link, producer)\n'
+        "    if True:\n"
+        "        if producer in _QUARANTINED:")})
+    hits = [f for f in found if f.code == "TH01"]
+    assert hits, found
+    assert any("admission aggregation buffer" in f.message
+               for f in hits), hits
+
+
 def test_th01_undeclared_spawn_site_mutation_turns_red(gate):
     # registry completeness: a new production thread without a declared
     # role turns the gate red (the chaos COVERED_SITES pattern)

@@ -9,6 +9,8 @@ its journal, plus a clean re-run where the contract promises one.
 
 ``COVERED_SITES`` is closed over by test_registry_complete.py.
 """
+import threading
+
 import pytest
 
 from consensus_specs_tpu import faults
@@ -22,7 +24,7 @@ from consensus_specs_tpu.testing.helpers.genesis import create_genesis_state
 F = faults.Fault
 
 COVERED_SITES = {"node.apply", "node.enqueue", "node.admission",
-                 "node.quarantine", "node.recover"}
+                 "node.quarantine", "node.recover", "node.batch_bisect"}
 
 
 @pytest.fixture(autouse=True)
@@ -284,6 +286,104 @@ def test_crash_kill_partial_journal_is_replayable():
     recovered.queue.close()
     recovered.run_apply_loop()
     _assert_journal_parity(spec, state, corpus, recovered)
+
+
+def _gossip_run_with_poison(spec, state, corpus):
+    """A node with a two-block chain prefix applied and a five-batch
+    gossip run queued behind it — batch 3 spec-invalid (unknown beacon
+    block root), every batch from its own named producer thread so the
+    charge accounting is attributable."""
+    node = Node(spec, state, retry_backoff_s=0.0)
+    for signed in corpus.chain[:2]:
+        s = int(signed.message.slot)
+        node.enqueue_tick(int(node.store.genesis_time)
+                          + s * int(spec.config.SECONDS_PER_SLOT))
+        node.enqueue_block(signed)
+    node.enqueue_tick(int(node.store.genesis_time)
+                      + (int(corpus.chain[1].message.slot) + 1)
+                      * int(spec.config.SECONDS_PER_SLOT))
+    assert node.run_apply_loop(max_items=5) == 5
+    votes = list(corpus.gossip[int(corpus.chain[0].message.slot)])
+    assert len(votes) >= 8
+    poison = votes[0].copy()
+    poison.data.beacon_block_root = spec.Root(b"\x66" * 32)
+    for name, batch in [("peer-honest-a", tuple(votes[0:2])),
+                        ("peer-honest-b", tuple(votes[2:4])),
+                        ("peer-poison", (poison,)),
+                        ("peer-honest-c", tuple(votes[4:6])),
+                        ("peer-honest-d", tuple(votes[6:8]))]:
+        t = threading.Thread(target=node.enqueue_attestations,
+                             args=(batch,), name=name)
+        t.start()
+        t.join()
+    node.queue.close()
+    return node
+
+
+def test_batched_poison_gossip_bisects_and_rest_of_run_lands():
+    """ISSUE 19 containment, case A: a spec-invalid batch INSIDE a
+    coalesced gossip run must not poison the run — the combined commit
+    bisects to the poison item, every clean slice lands as a run,
+    EXACTLY the poison producer is charged, and the journal (clean
+    batches only, per-item provenance) replays to parity with the stf
+    fast path intact (``replayed_blocks == 0`` — no fault fired, no
+    cache was invalidated)."""
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.node import service
+
+    spec, state, corpus = _scaffold()
+    service.reset_stats()
+    stf.reset_stats()
+    node = _gossip_run_with_poison(spec, state, corpus)
+    node.run_apply_loop()
+
+    assert service.stats["batch_bisections"] == 1
+    assert service.stats["rejected_batches"] == 1
+    assert service.stats["rejected_attestations"] == 1
+    # the four honest batches all landed, coalesced around the poison
+    assert service.stats["attestation_batches_applied"] == 4
+    assert service.stats["attestations_applied"] == 8
+    assert service.stats["runs_coalesced"] >= 1
+    assert service.stats["retried_items"] == 0
+    assert service.stats["requeued_items"] == 0
+    assert service.stats["quarantined_items"] == 0
+    scores = admission.snapshot()["producer_scores"]
+    assert scores.get("peer-poison") == admission.CHARGE_REJECTED
+    assert not any(p.startswith("peer-honest") for p in scores)
+    assert stf.stats["replayed_blocks"] == 0
+    _assert_journal_parity(spec, state, corpus, node)
+
+
+def test_batch_bisect_fault_degrades_to_item_at_a_time():
+    """ISSUE 19 containment, case B: a fault in the bisection machinery
+    itself (the ``node.batch_bisect`` probe) degrades, never breaks —
+    the run falls back to item-at-a-time apply through the full
+    containment core, the clean batches land, the poison is rejected
+    and charged exactly once, and the drain ends in parity."""
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.node import service
+
+    spec, state, corpus = _scaffold()
+    service.reset_stats()
+    stf.reset_stats()
+    node = _gossip_run_with_poison(spec, state, corpus)
+    plan = faults.FaultPlan([F("node.batch_bisect", nth=1)])
+    with faults.inject(plan):
+        node.run_apply_loop()
+
+    assert [s for s, _n, _k in plan.fired] == ["node.batch_bisect"]
+    assert service.stats["batch_bisections"] == 1
+    assert service.stats["retried_items"] == 1  # one event for the run
+    assert service.stats["requeued_items"] == 0
+    assert service.stats["quarantined_items"] == 0
+    assert service.stats["rejected_batches"] == 1
+    assert service.stats["attestation_batches_applied"] == 4
+    assert service.stats["attestations_applied"] == 8
+    scores = admission.snapshot()["producer_scores"]
+    assert scores.get("peer-poison") == admission.CHARGE_REJECTED
+    assert not any(p.startswith("peer-honest") for p in scores)
+    assert stf.stats["replayed_blocks"] == 0
+    _assert_journal_parity(spec, state, corpus, node)
 
 
 def test_single_writer_contract_is_enforced():

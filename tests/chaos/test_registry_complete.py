@@ -67,7 +67,7 @@ def test_node_survival_sites_are_registered_and_covered():
     chaos case — removing a probe or dropping its case turns this red
     independently of the generic completeness sweep above."""
     expected = {"node.apply", "node.enqueue", "node.admission",
-                "node.quarantine", "node.recover"}
+                "node.quarantine", "node.recover", "node.batch_bisect"}
     node_sites = {n for n in _production_sites() if n.startswith("node.")}
     assert expected <= node_sites, sorted(expected - node_sites)
     assert node_sites <= set(test_node_chaos.COVERED_SITES), \

@@ -144,3 +144,83 @@ def test_snapshot_reports_live_depth():
     assert snap["depth"] == 1
     assert snap["cap"] == 4
     assert snap["enqueued"] == 1
+
+
+def test_drain_pulls_everything_in_fifo_order():
+    q = IngestQueue(cap=8)
+    for i in range(6):
+        q.put("tick", i)
+    batch = q.drain(timeout=0)
+    assert [it.payload for it in batch] == [0, 1, 2, 3, 4, 5]
+    assert q.depth() == 0
+    assert ingest.stats["dequeued"] == 6
+    q.close()
+    assert q.drain(timeout=0) is None  # closed + drained = end of stream
+
+
+def test_drain_max_items_leaves_the_rest_queued():
+    q = IngestQueue(cap=8)
+    for i in range(5):
+        q.put("tick", i)
+    batch = q.drain(timeout=0, max_items=3)
+    assert [it.payload for it in batch] == [0, 1, 2]
+    assert [it.payload for it in q.drain(timeout=0)] == [3, 4]
+
+
+def test_drain_timeout_and_close_semantics_match_get():
+    q = IngestQueue(cap=4)
+    assert q.drain(timeout=0) is None  # empty, non-blocking probe
+    q.put("tick", 0)
+    q.close()
+    assert [it.payload for it in q.drain(timeout=0)] == [0]
+    assert q.drain(timeout=0) is None
+
+
+def test_one_drain_unblocks_every_blocked_producer():
+    """The ISSUE 19 satellite pin: a bulk removal frees MANY slots, so
+    the consumer must ``notify_all`` — with ``get``'s per-item
+    ``notify``, K-1 of these producers would stay asleep on a queue
+    with room."""
+    k = 4
+    q = IngestQueue(cap=k)
+    for i in range(k):
+        q.put("tick", ("seed", i))
+
+    landed = threading.Barrier(k + 1, timeout=10)
+
+    def producer(tag):
+        q.put("tick", ("blocked", tag))  # must block: queue at cap
+        landed.wait()
+
+    threads = [threading.Thread(target=producer, args=(t,), daemon=True)
+               for t in range(k)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 5
+    while ingest.stats["blocked_puts"] < k:
+        assert time.time() < deadline, "producers never blocked"
+        time.sleep(0.01)
+
+    batch = q.drain(timeout=0)  # ONE drain frees k slots at once
+    assert len(batch) == k
+    landed.wait()  # all k producers unblocked off the single notify_all
+    for t in threads:
+        t.join(timeout=5)
+    assert q.depth() == k
+    assert ingest.stats["blocked_puts"] == k
+
+
+def test_try_put_lands_or_refuses_without_blocking():
+    q = IngestQueue(cap=2)
+    assert q.try_put("tick", 0)
+    assert q.try_put("tick", 1)
+    t0 = time.perf_counter()
+    assert not q.try_put("tick", 2)  # full: immediate False, no block
+    assert time.perf_counter() - t0 < 0.5
+    assert ingest.stats["blocked_puts"] == 0  # a refusal is not a block
+    assert ingest.stats["enqueued"] == 2
+    assert q.get().payload == 0
+    assert q.try_put("tick", 2)
+    q.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        q.try_put("tick", 3)

@@ -380,6 +380,83 @@ def test_query_trend_not_comparable_is_silent():
         dict(_QL_ROW, value=99.0), dict(_QL_ROW, value=0.0)) is None
 
 
+# -- node_firehose serving gate (ISSUE 19) -----------------------------------
+
+_FH_ROW = {"metric": ("node_firehose_2epochs_100032_gossip_atts_"
+                      "400000_validators"),
+           "value": 4.0, "unit": "s", "atts_per_s": 55_000.0,
+           "queue_blocked_s": 0.012}
+
+
+def test_firehose_trend_error_row_blocks():
+    msg = bench.check_firehose_trend({"error": "TimeoutError('starved')"},
+                                     None)
+    assert msg is not None and "errored" in msg
+
+
+def test_firehose_throughput_regression_flagged():
+    # atts_per_s is the serving claim: SMALLER is the regression
+    # direction, independent of the wall-time `value`
+    cur = dict(_FH_ROW, atts_per_s=44_000.0)  # -20% vs 55k
+    msg = bench.check_firehose_trend(cur, _FH_ROW)
+    assert msg is not None and "perf-trend regression" in msg
+    assert "att/s" in msg
+    assert bench.check_firehose_trend(dict(_FH_ROW, atts_per_s=48_000.0),
+                                      _FH_ROW) is None  # -12.7%: in budget
+
+
+def test_firehose_blocked_time_growth_flagged():
+    # the tentpole turned 37.8s of blocked puts into near-zero: the gate
+    # refuses when blocked time climbs back over the previous run
+    cur = dict(_FH_ROW, queue_blocked_s=5.2)
+    msg = bench.check_firehose_trend(cur, _FH_ROW)
+    assert msg is not None and "blocked" in msg
+    # millisecond noise under the 1s floor never refuses...
+    assert bench.check_firehose_trend(dict(_FH_ROW, queue_blocked_s=0.9),
+                                      _FH_ROW) is None
+    # ...and a large-but-shrinking value passes (recovery round)
+    assert bench.check_firehose_trend(
+        dict(_FH_ROW, queue_blocked_s=5.0),
+        dict(_FH_ROW, queue_blocked_s=37.8)) is None
+
+
+def test_firehose_adversarial_slowdown_cap():
+    # the adversarial row embeds honest-atts/s ÷ adversarial-atts/s:
+    # over the 1.3x cap refuses even with no previous row to diff
+    row = dict(_FH_ROW, vs_honest_slowdown=1.42)
+    msg = bench.check_firehose_trend(row, None)
+    assert msg is not None and "1.42x" in msg and "1.3x cap" in msg
+    assert bench.check_firehose_trend(
+        dict(_FH_ROW, vs_honest_slowdown=1.3), None) is None
+    # honest rows carry no ratio (None when the honest row errored):
+    # the cap check stays silent
+    assert bench.check_firehose_trend(
+        dict(_FH_ROW, vs_honest_slowdown=None), None) is None
+
+
+def test_firehose_not_comparable_is_silent():
+    assert bench.check_firehose_trend(None, _FH_ROW) is None  # skipped row
+    assert bench.check_firehose_trend(_FH_ROW, None) is None
+    assert bench.check_firehose_trend(_FH_ROW, {"error": "x"}) is None
+    # the 4-producer row never diffs against the 16-producer row
+    other = dict(_FH_ROW, metric=("node_firehose_16p_2epochs_100032_"
+                                  "gossip_atts_400000_validators"))
+    assert bench.check_firehose_trend(dict(_FH_ROW, atts_per_s=1.0),
+                                      other) is None
+    # pre-ISSUE-19 previous rows (no atts_per_s / queue_blocked_s keys)
+    prev = {"metric": _FH_ROW["metric"], "value": 4.0}
+    assert bench.check_firehose_trend(_FH_ROW, prev) is None
+
+
+def test_counters_batch_bisections_block():
+    # ISSUE 19: the honest firehose corpus is all-valid — a bisected
+    # gossip run in a fault-free bench means the batching layer broke
+    msg = bench.check_counter_invariants(_e2e_row(batch_bisections=1))
+    assert msg is not None and "bisected 1 gossip runs" in msg
+    assert bench.check_counter_invariants(
+        _e2e_row(batch_bisections=0)) is None
+
+
 # -- analyzer-gate refusal line (ISSUE 18 satellite) -------------------------
 
 class _F:

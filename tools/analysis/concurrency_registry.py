@@ -199,6 +199,14 @@ SHARED: Tuple[SharedSpec, ...] = (
                # acquisition by documented contract
                lock_holders=frozenset({"_charge_locked", "_forget_locked",
                                        "_shed_oldest_orphan_locked"})),
+    # the back-pressure aggregation buffer (ISSUE 19): gossip producers
+    # stage into it (aggregate_gossip) while the apply loop drains it
+    # (drain_aggregated) — a cross-role structure, every touch under
+    # the admission lock; the micro-batcher's run/tail staging lists in
+    # node/service.py stay thread-local to the apply writer by design
+    SharedSpec("admission aggregation buffer", f"{_PKG}.node.admission",
+               module_globals=frozenset({"_AGG", "_AGG_COUNT"}),
+               lock="admission lock"),
     SharedSpec("persist checkpoint index", f"{_PKG}.persist.store",
                module_globals=frozenset({"_INDEX"}),
                lock="persist index lock"),
@@ -346,8 +354,12 @@ ROLE_SEEDS: Tuple[RoleSeed, ...] = (
 # telemetry entry points.  Calls to a seam are never a TH01 hazard.
 HANDOFF_SEAMS: FrozenSet[str] = frozenset({
     f"{_PKG}.node.ingest.IngestQueue.put",
+    f"{_PKG}.node.ingest.IngestQueue.try_put",
     f"{_PKG}.node.ingest.IngestQueue.get",
+    f"{_PKG}.node.ingest.IngestQueue.drain",
     f"{_PKG}.node.ingest.IngestQueue.requeue_front",
+    f"{_PKG}.node.admission.aggregate_gossip",
+    f"{_PKG}.node.admission.drain_aggregated",
     f"{_PKG}.telemetry.metrics.span",
     f"{_PKG}.telemetry.metrics.count",
     f"{_PKG}.telemetry.timeline.begin",

@@ -222,6 +222,20 @@ CACHE_REGISTRY: Tuple[CacheSpec, ...] = (
         invalidators=frozenset({"reset_state"}),
         observational=True,
     ),
+    # the admission-side gossip aggregation buffer (ISSUE 19): producers
+    # stage batches a full ingest queue refused through
+    # ``aggregate_gossip`` (lock-guarded, bounded by AGG_CAP) and only
+    # the apply loop's ``drain_aggregated`` flushes it — an outside
+    # insert would break the cap accounting and the FIFO flush order
+    # the micro-batcher journals in
+    CacheSpec(
+        name="node aggregation buffer",
+        owner=("node", "admission.py"),
+        module="consensus_specs_tpu.node.admission",
+        module_globals=frozenset({"_AGG"}),
+        invalidators=frozenset({"reset_state", "reset_transient",
+                                "drain_aggregated"}),
+    ),
     # the durable checkpoint store's in-memory index (ISSUE 14): path ->
     # {journal_pos, bytes} over the artifacts on disk.  Inserts happen
     # only through the owner's ``_index_put`` (riding the cache
