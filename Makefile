@@ -33,6 +33,13 @@ limb-probe:
 dcn-dryrun:
 	python tools/dcn_dryrun.py
 
+# process-fabric dryrun (ISSUE 20): regenerate DCN_DRYRUN.json through
+# the supervised worker pool — 2 worker processes, epoch/merkle/pairing
+# checks bit-identical to the in-process twins, one injected worker kill
+# with recovery; analyzer-gated like chaos/soak
+dist-dryrun:
+	python -m pytest tests/test_dist_dryrun.py tests/analysis/test_live_tree_clean.py -q
+
 # tier-1 chaos subset (fault-injection differential suites) + the
 # analyzer gate — the failure-containment half of `make test`
 chaos:
@@ -46,6 +53,13 @@ soak:
 	python -m pytest tests/soak -q
 soak-deep:
 	CSTPU_SOAK_DEEP=1 python -m pytest tests/soak -q
+
+# wall-clock-budgeted endurance mode (ISSUE 20 / ROADMAP item 3): loop
+# the bounded corpus until CSTPU_SOAK_MINUTES expires, sampling RSS per
+# epoch and asserting the same flatness envelope over the whole
+# multi-pass series.  Default 5 minutes; make soak-endurance SOAK_MINUTES=120
+soak-endurance:
+	CSTPU_SOAK_MINUTES=$(if $(SOAK_MINUTES),$(SOAK_MINUTES),5) python -m pytest tests/soak -q -k endurance
 
 # node firehose (ISSUE 12 / ROADMAP item 1): the concurrent serving
 # harness — multi-producer gossip + blocks through the single-writer
@@ -106,4 +120,4 @@ mdspec:
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset minimal -o ./build/mdspec
 	python -m consensus_specs_tpu.specs.mdcompiler --fork capella --preset mainnet -o ./build/mdspec
 
-.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep firehose firehose-adversarial doctor limb-probe dcn-dryrun lint analyze analyze-changed consume mdspec gen-all FORCE
+.PHONY: test test-par test-fast test-mainnet bench chaos soak soak-deep soak-endurance firehose firehose-adversarial doctor limb-probe dcn-dryrun dist-dryrun lint analyze analyze-changed consume mdspec gen-all FORCE

@@ -1876,6 +1876,139 @@ def bench_node_query_load(results, n_validators=None, n_epochs=10,
             store.close()
 
 
+def bench_dist_verify_fabric(results, n_entries=512, group_pubkeys=128,
+                             n_groups=32, n_chunks=4):
+    """ISSUE 20: lane-chunked batch verification THROUGH the 2-worker
+    process fabric (``dist/``), 400k-validator key universe.  Three legs:
+
+    * **timed clean leg** — ``n_entries`` aggregate entries dispatched in
+      ``n_chunks`` lane chunks over 2 worker processes vs the in-process
+      ``stf/verify.first_invalid`` twin: identical verdict, and the
+      fabric throughput must clear the 0.25x floor (pickle+pipe overhead
+      is bounded, not free).  The row's ``telemetry`` carries the
+      dispatch/fabric counters of THIS leg only — the counter-invariant
+      gate refuses any nonzero ``redispatched_chunks``/``fallback_runs``
+      in a fault-free run;
+    * **bisection-naming leg** — one entry invalidated at a known index:
+      the chunk-local minima merge must name the SAME leftmost index the
+      unchunked bisection does;
+    * **kill leg** — a scoped chaos plan (``dist.worker.exec@2=crash@
+      proc1``) kills one worker mid-chunk: the run completes on the
+      survivor with ``redispatched_chunks > 0`` and the identical
+      verdict.  Its counters land under ``kill_leg``, never in
+      ``telemetry``."""
+    import hashlib as _hashlib
+
+    from consensus_specs_tpu import faults
+    from consensus_specs_tpu.crypto.bls import native
+    from consensus_specs_tpu.dist import dispatch as dist_dispatch
+    from consensus_specs_tpu.dist import fabric as dist_fabric
+    from consensus_specs_tpu.dist import workloads
+    from consensus_specs_tpu.dist.dispatch import FabricExecutor
+    from consensus_specs_tpu.dist.fabric import Fabric
+    from consensus_specs_tpu.stf import verify as stf_verify
+
+    universe = 400_000
+    t0 = time.perf_counter()
+    # n_groups distinct (message, aggregate) units over disjoint key sets
+    # sampled from the 400k universe, tiled to n_entries — the signing
+    # bill stays bounded while every entry is a real 128-wide aggregate
+    groups = []
+    for g in range(n_groups):
+        sks = [1 + ((g * group_pubkeys + i) * 97) % universe
+               for i in range(group_pubkeys)]
+        msg = _hashlib.sha256(b"dist-fabric-bench-%d" % g).digest()
+        pks = [native.SkToPk(sk) for sk in sks]
+        agg = native.Aggregate([native.Sign(sk, msg) for sk in sks])
+        flat = b"".join(native.pubkey_affine(pk) for pk in pks)
+        groups.append((group_pubkeys, flat, msg, agg))
+    entries = [groups[i % n_groups] for i in range(n_entries)]
+    t_corpus = time.perf_counter() - t0
+
+    dist_dispatch.reset_stats()
+    dist_fabric.reset_stats()
+    with Fabric(n_workers=2) as fab:
+        ex = FabricExecutor(fab)
+        # warmup: the workers import the verify stack on their first
+        # chunk — pay it outside the timed region, like every compile
+        first, mode = workloads.batch_first_invalid(
+            ex, entries[:8], n_chunks=n_chunks, deadline_s=120.0)
+        assert mode == "fabric" and first is None, (mode, first)
+
+        t_fab, (first_fab, mode) = _timed(
+            lambda: workloads.batch_first_invalid(
+                ex, entries, n_chunks=n_chunks, deadline_s=120.0))
+        assert mode == "fabric", mode
+        t_in, first_in = _timed(stf_verify.first_invalid, entries)
+        assert first_fab is None and first_in is None, (first_fab, first_in)
+
+        # bisection-naming parity: invalidate one entry (wrong message
+        # for its signature) at a known non-boundary index
+        bad_idx = (n_entries * 5) // 8 + 1
+        bad = list(entries)
+        cnt, flat, msg, _sig = bad[bad_idx]
+        wrong = groups[(bad_idx + 1) % n_groups][3]
+        bad[bad_idx] = (cnt, flat, msg, wrong)
+        named_fab, mode = workloads.batch_first_invalid(
+            ex, bad, n_chunks=n_chunks, deadline_s=120.0)
+        named_in = stf_verify.first_invalid(bad)
+        assert mode == "fabric" and named_fab == named_in == bad_idx, (
+            mode, named_fab, named_in, bad_idx)
+
+        clean = {**dist_dispatch.snapshot(), **dist_fabric.snapshot()}
+        # the fault-free contract, asserted in-run AND gated by
+        # check_counter_invariants on the row's telemetry
+        assert clean["redispatched_chunks"] == 0, clean
+        assert clean["fallback_runs"] == 0, clean
+        assert clean["workers_lost"] == 0, clean
+
+    # kill leg: proc1 dies mid-chunk on its 2nd task; the survivor
+    # absorbs the re-dispatched chunks and the verdict is unchanged
+    dist_dispatch.reset_stats()
+    dist_fabric.reset_stats()
+    plan = faults.FaultPlan([faults.Fault("dist.worker.exec", nth=2,
+                                          kind="crash", proc="proc1")])
+    with faults.inject(plan):
+        with Fabric(n_workers=2) as fab:
+            ex = FabricExecutor(fab)
+            t_kill, (first_kill, mode) = _timed(
+                lambda: workloads.batch_first_invalid(
+                    ex, entries, n_chunks=n_chunks, deadline_s=120.0))
+    assert mode == "fabric" and first_kill is None, (mode, first_kill)
+    # the crash fires inside the WORKER process (the plan ships via env),
+    # so the coordinator-side proof is the loss + re-dispatch it caused
+    kill = {**dist_dispatch.snapshot(), **dist_fabric.snapshot()}
+    assert kill["redispatched_chunks"] > 0, kill
+    assert kill["workers_lost"] >= 1, kill
+
+    vs_inprocess = round(t_in / t_fab, 3) if t_fab > 0 else None
+    assert vs_inprocess is not None and vs_inprocess >= 0.25, (
+        f"fabric throughput floor: {vs_inprocess}x < 0.25x of in-process")
+    results["dist_verify_fabric"] = {
+        "metric": (f"dist_verify_fabric_2workers_{n_entries}x"
+                   f"{group_pubkeys}_{universe}"),
+        "value": round(t_fab, 3),
+        "unit": "s",
+        "entries": n_entries,
+        "pubkeys_per_entry": group_pubkeys,
+        "n_chunks": n_chunks,
+        "entries_per_s": round(n_entries / t_fab, 1),
+        "inprocess_s": round(t_in, 3),
+        "vs_inprocess": vs_inprocess,
+        "bisection_named_index": bad_idx,
+        "bisection_parity": True,
+        "corpus_build_s": round(t_corpus, 3),
+        "kill_leg": {
+            "wall_s": round(t_kill, 3),
+            "verdict_parity": True,
+            "redispatched_chunks": kill["redispatched_chunks"],
+            "workers_lost": kill["workers_lost"],
+            "channel_losses": kill["channel_losses"],
+        },
+        "telemetry": clean,
+    }
+
+
 def bench_scale_probe(results):
     """Scale-headroom probe (VERDICT r4 item 7): the BLS-free epoch
     transition at 2^20 validators (registry limit is 2^40; real mainnet is
@@ -2381,6 +2514,24 @@ def check_counter_invariants(current, previous=None, plan_floor=0.25,
         # replay is the recovery twin of a replayed block
         return (f"counter invariant: {metric} fell back to full journal "
                 f"replay {tel['restore_fallbacks']} times")
+    if tel.get("redispatched_chunks"):
+        # ISSUE 20: a fault-free run has no chunk re-dispatch — one here
+        # means workers are dying (or replies corrupting) under zero
+        # injected faults, and first-valid-reply-wins would hide it
+        return (f"counter invariant: {metric} re-dispatched "
+                f"{tel['redispatched_chunks']} chunks in a fault-free run")
+    if tel.get("fallback_runs"):
+        # the dist ladder silently demoting to in-process is the fabric
+        # twin of a replayed block: the row's wall time becomes the
+        # in-process path's, and the fabric claim is untested
+        return (f"counter invariant: {metric} demoted "
+                f"{tel['fallback_runs']} runs to in-process in a "
+                f"fault-free run")
+    if tel.get("workers_lost") or tel.get("corrupt_replies"):
+        return (f"counter invariant: {metric} lost "
+                f"{tel.get('workers_lost', 0)} workers / "
+                f"{tel.get('corrupt_replies', 0)} corrupt replies in a "
+                f"fault-free run")
     for key, floor in (("plan_hit_ratio", plan_floor),
                        ("memo_hit_ratio", memo_floor)):
         ratio = tel.get(key)
@@ -2507,6 +2658,12 @@ def main():
             bench_cold_start_checkpoint(results)
         except Exception as exc:
             results["cold_start_checkpoint"] = {"error": repr(exc)[:300]}
+        try:
+            # ISSUE 20: lane-chunked verification through the 2-worker
+            # process fabric — parity, kill-leg re-dispatch, throughput
+            bench_dist_verify_fabric(results)
+        except Exception as exc:
+            results["dist_verify_fabric"] = {"error": repr(exc)[:300]}
     if os.environ.get("BENCH_SCALE_PROBE") == "1":
         try:
             bench_scale_probe(results)
@@ -2558,7 +2715,8 @@ def main():
                       "node_firehose_16p",
                       "node_firehose_adversarial",
                       "node_recover_checkpoint",
-                      "cold_start_checkpoint", "node_query_load"):
+                      "cold_start_checkpoint", "node_query_load",
+                      "dist_verify_fabric"):
         if preserved not in results and prev_details.get(preserved):
             results[preserved] = prev_details[preserved]
     if prev_details:
@@ -2647,7 +2805,8 @@ def main():
                             "node_firehose", "node_firehose_16p",
                             "node_firehose_adversarial",
                             "node_recover_checkpoint",
-                            "cold_start_checkpoint", "node_query_load"):
+                            "cold_start_checkpoint", "node_query_load",
+                            "dist_verify_fabric"):
                 regressions.append(check_counter_invariants(
                     results.get(row_key), prev_details.get(row_key)))
             # ISSUE 16: the historical-read-path rows carry their own
@@ -2667,7 +2826,8 @@ def main():
             for row_key in ("epoch_e2e_scale_1m", "epoch_e2e_scale_2m",
                             "node_firehose", "node_firehose_16p",
                             "node_firehose_adversarial",
-                            "node_recover_checkpoint"):
+                            "node_recover_checkpoint",
+                            "dist_verify_fabric"):
                 regressions.append(check_perf_trend(
                     results.get(row_key), prev_details.get(row_key),
                     previous_details=prev_details.get(row_key)))

@@ -1,0 +1,39 @@
+"""Cross-process execution fabric (ISSUE 20): coordinator/worker process
+pool with supervision, deadline/retry/hedge re-dispatch, and the process
+as a first-class failure domain.
+
+The single-host engines are fast, adversary-proof, and crash-recoverable
+*within one process*; ROADMAP item 5 ("Beyond one host") needs the same
+robustness contract across processes before multi-host is anything but a
+static sketch.  This package supplies it for the two chunkable
+workloads — BLS verification chunks (the fixed-merge-order pairing of
+``parallel/bls_sharded.py``) and registry-sharded epoch kernel slices:
+
+* ``codec``     — versioned length-framed messages over pipes, each frame
+  wrapped in the ``persist/atomic.py`` digest envelope so a torn or
+  corrupted reply is a DETECTED miss (``ArtifactCorrupt``), never garbage;
+* ``worker``    — the subprocess body (``python -m
+  consensus_specs_tpu.dist.worker``): executes task chunks, heartbeats
+  from a side thread, inherits the coordinator's fault plan via env with
+  per-process scope (``faults.py`` ``site[@nth][=kind][@procK]``);
+* ``fabric``    — worker lifecycle: spawn, per-worker sender/reader
+  threads, heartbeat bookkeeping, loss detection (EOF, corrupt frame,
+  dead process), respawn for recovery probes;
+* ``dispatch``  — deterministic chunk assignment with per-task deadlines
+  (exponential backoff), hedged duplicate dispatch for stragglers
+  (first-valid-reply wins, duplicates discarded by task id), re-dispatch
+  of a dead/timed-out/corrupt-replying worker's chunks to survivors, and
+  the degradation ladder: repeated fabric failures open a breaker that
+  demotes runs to in-process execution with recovery probes — serving
+  never halts;
+* ``workloads`` — the chunked workloads themselves, each carrying its
+  bit-identical in-process twin: the fixed merge order (chunk-index
+  partial products, leftmost-failure minima, ordered slice concat) makes
+  verdict/root parity PROVABLE at every failure schedule, and the tests
+  assert it.
+"""
+from consensus_specs_tpu.dist.dispatch import (  # noqa: F401
+    FabricDown,
+    FabricExecutor,
+)
+from consensus_specs_tpu.dist.fabric import Fabric  # noqa: F401

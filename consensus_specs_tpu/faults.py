@@ -29,9 +29,15 @@ Plans activate via ``with faults.inject(plan):`` (tests) or the
 
     CSTPU_FAULTS="stf.verify.native_call@2=error,stf.sync.rows_memo=corrupt"
 
-Each directive is ``site[@nth][=kind]`` (nth defaults to 1, kind to
-``error``); ``@nth+`` makes the fault sticky (fires on every hit from the
-Nth on).  ``FaultPlan.seeded`` draws a reproducible random schedule over
+Each directive is ``site[@nth][=kind][@procK]`` (nth defaults to 1, kind
+to ``error``); ``@nth+`` makes the fault sticky (fires on every hit from
+the Nth on).  The trailing ``@procK`` scopes the fault to ONE process of
+the dist fabric (``proc0`` is the coordinator, ``proc1..N`` the
+workers): the coordinator ships the whole plan to every worker via env,
+and each process arms only the faults addressed to it.  With no fabric
+active (no process scope set) the scope is ignored and the fault is
+armed everywhere — existing plans behave identically.
+``FaultPlan.seeded`` draws a reproducible random schedule over
 a site subset — the chaos differential suite (tests/chaos/) replays
 seeded block walks under such plans and asserts the containment
 contracts hold byte-exactly.
@@ -45,14 +51,27 @@ from __future__ import annotations
 import contextlib
 import os
 import random
+import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
     "Fault", "FaultPlan", "InjectedBackendCrash", "InjectedFault",
-    "inject", "plan_from_env", "registry", "site",
+    "inject", "plan_from_env", "process_scope", "registry",
+    "set_process_scope", "site",
 ]
 
 KINDS = ("error", "crash", "corrupt")
+
+# the only well-formed process scope: procK, K a decimal ordinal
+# (proc0 = coordinator, proc1.. = dist workers)
+_PROC_RE = re.compile(r"proc\d+")
+
+# this process's identity within an active dist fabric (None outside
+# one): the coordinator sets "proc0" while a fabric is alive, workers
+# inherit theirs from CSTPU_DIST_PROC at spawn.  Scoped faults fire only
+# in their addressed process WHEN a scope is set; with no fabric active
+# the scope is ignored and scoped faults are armed everywhere.
+_PROC_SCOPE: Optional[str] = None
 
 
 class InjectedFault(RuntimeError):
@@ -67,21 +86,30 @@ class InjectedBackendCrash(OSError):
 
 class Fault:
     """One armed rule: fire ``kind`` at ``site`` on the ``nth`` hit
-    (1-based; ``sticky`` keeps firing from the nth hit on)."""
+    (1-based; ``sticky`` keeps firing from the nth hit on).  ``proc``
+    scopes the rule to one process of the dist fabric (``"proc0"`` =
+    coordinator, ``"proc1"``.. = workers); None fires in every
+    process."""
 
-    __slots__ = ("site", "nth", "kind", "sticky")
+    __slots__ = ("site", "nth", "kind", "sticky", "proc")
 
     def __init__(self, site: str, nth: int = 1, kind: str = "error",
-                 sticky: bool = False):
+                 sticky: bool = False, proc: Optional[str] = None):
         if nth < 1:
             raise ValueError(f"nth is 1-based, got {nth}")
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} (one of {KINDS})")
+        if proc is not None and not _PROC_RE.fullmatch(proc):
+            raise ValueError(
+                f"malformed process scope {proc!r} (expected procK, e.g. "
+                "proc0 for the coordinator, proc1.. for workers)")
         self.site, self.nth, self.kind, self.sticky = site, int(nth), kind, sticky
+        self.proc = proc
 
     def __repr__(self):  # deterministic, used in test ids
         tail = "+" if self.sticky else ""
-        return f"{self.site}@{self.nth}{tail}={self.kind}"
+        scope = f"@{self.proc}" if self.proc else ""
+        return f"{self.site}@{self.nth}{tail}={self.kind}{scope}"
 
 
 class FaultPlan:
@@ -115,6 +143,9 @@ class FaultPlan:
         n = self.hits.get(name, 0) + 1
         self.hits[name] = n
         for f in self._by_site.get(name, ()):
+            if (f.proc is not None and _PROC_SCOPE is not None
+                    and f.proc != _PROC_SCOPE):
+                continue  # addressed to another process of the fabric
             if n == f.nth or (f.sticky and n > f.nth):
                 self.fired.append((name, n, f.kind))
                 if f.kind == "error" or (f.kind == "corrupt" and value is None):
@@ -214,6 +245,23 @@ def active_plan() -> Optional[FaultPlan]:
     return _PLAN
 
 
+def set_process_scope(scope: Optional[str]) -> None:
+    """Declare this process's identity within a dist fabric (``"proc0"``
+    for the coordinator, ``"proc1"``.. for workers; None tears the scope
+    back down when the fabric stops).  While a scope is set, faults
+    carrying a different ``proc`` are skipped; unscoped faults fire as
+    always."""
+    global _PROC_SCOPE
+    if scope is not None and not _PROC_RE.fullmatch(scope):
+        raise ValueError(
+            f"malformed process scope {scope!r} (expected procK)")
+    _PROC_SCOPE = scope
+
+
+def process_scope() -> Optional[str]:
+    return _PROC_SCOPE
+
+
 def assert_sites_registered(plan: Optional[FaultPlan] = None) -> None:
     """Fail fast on a schedule naming sites the registry doesn't know — a
     typo in ``CSTPU_FAULTS`` would otherwise silently disarm the whole
@@ -231,12 +279,27 @@ def assert_sites_registered(plan: Optional[FaultPlan] = None) -> None:
 
 
 def plan_from_env(value: str) -> FaultPlan:
-    """Parse a ``CSTPU_FAULTS`` directive string (see module docstring)."""
+    """Parse a ``CSTPU_FAULTS`` directive string (see module docstring).
+    Grammar per directive: ``site[@nth][=kind][@procK]`` — the process
+    scope, when present, is the LAST ``@`` segment and must match
+    ``proc\\d+`` exactly; anything else starting with ``proc`` after an
+    ``@`` is rejected loudly (a typo'd scope must never silently arm the
+    fault everywhere)."""
     faults = []
     for raw in value.split(","):
         raw = raw.strip()
         if not raw:
             continue
+        proc = None
+        if "@" in raw:
+            head, tail = raw.rsplit("@", 1)
+            if _PROC_RE.fullmatch(tail):
+                proc, raw = tail, head
+            elif tail.startswith("proc"):
+                raise ValueError(
+                    f"malformed process scope in fault directive "
+                    f"{raw!r}: {tail!r} (expected procK, K a decimal "
+                    "ordinal — proc0 = coordinator, proc1.. = workers)")
         kind = "error"
         if "=" in raw:
             raw, kind = raw.rsplit("=", 1)
@@ -246,11 +309,26 @@ def plan_from_env(value: str) -> FaultPlan:
             if nth_s.endswith("+"):
                 sticky, nth_s = True, nth_s[:-1]
             nth = int(nth_s)
-        faults.append(Fault(raw, nth=nth, kind=kind, sticky=sticky))
+        faults.append(Fault(raw, nth=nth, kind=kind, sticky=sticky,
+                            proc=proc))
     return FaultPlan(faults)
+
+
+def plan_to_env(plan: FaultPlan) -> str:
+    """Serialize a plan back to the ``CSTPU_FAULTS`` grammar — the
+    coordinator ships its ACTIVE plan to every worker this way, so a
+    chaos schedule written for the fabric crosses the process boundary
+    verbatim (each process re-parses and arms only the faults addressed
+    to it)."""
+    return ",".join(repr(f) for f in plan.faults())
 
 
 _env = os.environ.get("CSTPU_FAULTS")
 if _env:  # bench/CI chaos runs: arm the process-wide plan at import
     _PLAN = plan_from_env(_env)
+del _env
+
+_env = os.environ.get("CSTPU_DIST_PROC")
+if _env:  # dist worker subprocess: scope set before any probe can fire
+    set_process_scope(_env)
 del _env

@@ -236,10 +236,17 @@ class IngestQueue:
         single ``notify_all`` — a batch removal frees many slots, and the
         per-item ``notify`` of ``get`` would leave all but one producer
         sleeping on a queue with room (ISSUE 19 satellite).  Blocks like
-        ``get`` while the queue is empty; ``timeout=0`` is the
-        opportunistic non-blocking probe.  Returns None when the queue is
-        closed AND drained (end of stream) or on timeout, else a
-        non-empty list in FIFO order."""
+        ``get`` while the queue is empty; ``timeout=0`` (or negative) is
+        the opportunistic non-blocking probe — the timeout bounds the
+        WAIT, never the work, so a zero-timeout drain of a non-empty
+        queue still returns the whole backlog.  Returns None when the
+        queue is closed AND drained (end of stream) or on timeout, else
+        a non-empty list in FIFO order.  ``max_items <= 0`` is a request
+        for nothing: ``[]`` immediately, never a wait, never a consume —
+        the degenerate bound a caller's batch arithmetic can reach
+        (tests/node/test_ingest.py pins it harmless)."""
+        if max_items is not None and max_items <= 0:
+            return []
         with self._not_empty:
             deadline = (None if timeout is None
                         else time.perf_counter() + timeout)

@@ -134,6 +134,30 @@ def write_artifact(path: str, payload: bytes, kind: str,
     return len(header) + len(payload) + _DIGEST_LEN
 
 
+def envelope(payload: bytes, kind: str, tag: str = "") -> bytes:
+    """The in-memory artifact envelope (header | payload | digest) — the
+    exact byte layout ``write_artifact`` persists, for callers framing
+    payloads over a CHANNEL instead of a file (the dist fabric's message
+    codec, dist/codec.py).  Round-trips through ``verify_buffer`` /
+    ``parse_buffer``, so a torn or damaged frame surfaces as
+    ``ArtifactCorrupt`` — a detected miss, never garbage input."""
+    payload = bytes(payload)
+    header = _header(kind, tag, len(payload))
+    digest = hashlib.sha256(header + payload).digest()
+    return header + payload + digest
+
+
+def parse_buffer(path: str, raw) -> Tuple[str, str, bytes]:
+    """Digest-verify one envelope WITHOUT pinning kind/tag up front (a
+    channel receiver learns the message kind from the frame itself, so
+    the ``verify_buffer`` compare-against-expected shape does not fit).
+    Returns ``(kind, tag, payload)``; the same corruption ladder as
+    ``verify_buffer``, minus the kind/tag staleness compare — which the
+    caller owns."""
+    kind, tag, start, stop = _split_bounds(path, raw)
+    return kind, tag, bytes(raw[start:stop])
+
+
 def read_artifact(path: str, kind: str, tag: str = "",
                   expected_payload_len: Optional[int] = None) -> bytes:
     """Load and verify one artifact; returns the payload.  Raises the
@@ -207,6 +231,8 @@ def _split_bounds(path: str, raw) -> Tuple[str, str, int, int]:
         kind, off = _read_str(raw, off)
         tag, off = _read_str(raw, off)
         payload_len = int.from_bytes(raw[off:off + 8], "little")
+        # thread-safe: `off` is a function-local cursor seeded FROM the
+        # module constant _HDR_FIXED, never the constant itself
         off += 8
     except (IndexError, UnicodeDecodeError) as exc:
         raise ArtifactCorrupt(f"{path}: malformed header ({exc})") from None

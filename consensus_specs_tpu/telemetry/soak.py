@@ -39,7 +39,10 @@ Profiles: ``bounded`` (~2 min on the 1 vCPU host: phase0 + altair, 32
 epochs each — long enough for finality to advance, FIFO memos to rotate,
 and the plan cache to shed old epochs) is the ``make soak`` default;
 ``deep`` (96 epochs each) is the slow endurance tier (``make
-soak-deep``).  An ambient
+soak-deep``).  Orthogonal to both, ``run_endurance`` loops the bounded
+corpus under a WALL-CLOCK budget (``CSTPU_SOAK_MINUTES``, ``make
+soak-endurance``) and asserts the same flatness envelope over the whole
+multi-pass RSS series — the multi-hour flat-RSS lever.  An ambient
 ``CSTPU_FAULTS`` schedule stays armed during the walk's clean epochs
 (extra chaos, same assertions) but is masked during the verification
 re-run, which must be genuinely fault-free to prove coherence.
@@ -471,8 +474,123 @@ def run_soak(profile: str = "bounded", seed: int = 90001,
     return report
 
 
+def run_endurance(minutes: Optional[float] = None,
+                  out_path: Optional[str] = None) -> Dict:
+    """Wall-clock-budgeted endurance mode (``CSTPU_SOAK_MINUTES``,
+    ``make soak-endurance``): build the bounded corpus once, then loop
+    fault-free full passes over it until the budget expires, sampling
+    every bounded cap and the process RSS after each epoch and asserting
+    the SAME flatness envelope over the whole multi-pass series — the
+    opt-in lever for ROADMAP item 3's remaining multi-hour flat-RSS
+    claim.  At least one full pass always completes, however small the
+    budget; a started pass always finishes (root parity is per block, so
+    the series stays pass-aligned).  Clean passes run under whatever
+    ambient ``CSTPU_FAULTS`` plan is armed, like the walk's clean
+    epochs — containment keeps parity either way."""
+    import time as _time
+
+    from consensus_specs_tpu import stf
+    from consensus_specs_tpu.crypto import bls
+
+    from . import recorder
+
+    if minutes is None:
+        minutes = float(os.environ.get("CSTPU_SOAK_MINUTES", "0") or 0.0)
+    if minutes <= 0:
+        raise ValueError(
+            "endurance soak needs a positive wall-clock budget "
+            "(CSTPU_SOAK_MINUTES=<minutes> or minutes=...)")
+    cfg = PROFILES["bounded"]
+    out_path = out_path or os.environ.get(
+        "CSTPU_SOAK_OUT", os.path.join(_repo_root(), "SOAK.json"))
+    report: Dict = {"profile": "endurance",
+                    "config": {**cfg, "minutes": minutes},
+                    "out_path": out_path, "forks": [], "failure": None}
+
+    bls.use_fastest()
+    prev_bls = bls.bls_active
+    bls.bls_active = True
+    was_recording = recorder.enabled()
+    prev_cap = recorder.stats()["cap"]
+    recorder.enable(cap=cfg["ring_cap"])
+    recorder.reset()
+    section: Dict = {"mode": "endurance", "budget_minutes": minutes,
+                     "passes": 0, "blocks_applied": 0, "cache_samples": []}
+    try:
+        corpora = {fork: _build_corpus(fork, cfg["epochs"])
+                   for fork in cfg["forks"]}
+        _fresh_engine_env()
+        start = _time.monotonic()
+        deadline = start + minutes * 60.0
+        while section["passes"] == 0 or _time.monotonic() < deadline:
+            for fork in cfg["forks"]:
+                spec, pre, blocks, roots = corpora[fork]
+                spe = int(spec.SLOTS_PER_EPOCH)
+                s = pre.copy()
+                applied = 0
+                with _ambient():
+                    for off in range(0, len(blocks), spe):
+                        for sb in blocks[off:off + spe]:
+                            stf.apply_signed_blocks(spec, s, [sb], True)
+                            if bytes(s.hash_tree_root()) != roots[applied]:
+                                _fail(report, section,
+                                      f"{fork}: root diverged from the "
+                                      f"literal replay at block {applied} "
+                                      f"(pass {section['passes']})")
+                            applied += 1
+                            section["blocks_applied"] += 1
+                        sample = {"pass": section["passes"], "fork": fork,
+                                  "epoch": off // spe,
+                                  "sizes": bounded_cache_sizes(),
+                                  "rss_mb": process_rss_mb(),
+                                  "breaker_state": stf.stats["breaker_state"]}
+                        section["cache_samples"].append(sample)
+                        for entry in sample["sizes"]:
+                            if entry["cap"] and entry["size"] > entry["cap"]:
+                                _fail(report, section,
+                                      f"{fork}: {entry['name']} grew past "
+                                      f"its cap in pass "
+                                      f"{section['passes']}: "
+                                      f"{entry['size']} > {entry['cap']}")
+            section["passes"] += 1
+        section["elapsed_s"] = round(_time.monotonic() - start, 1)
+        section["walk_stats"] = {
+            **{k: stf.stats[k] for k in
+               ("fast_blocks", "replayed_blocks", "breaker_trips",
+                "breaker_probes", "breaker_skipped", "breaker_state")},
+            "replay_reasons": dict(stf.stats["replay_reasons"]),
+        }
+        # the endurance claim: over however many passes the budget
+        # bought, RSS past warmup stays inside the same bounded-growth
+        # envelope the per-walk soak asserts — a per-pass leak (the
+        # failure mode a single bounded walk cannot see) compounds
+        # across passes and trips this within a handful of them
+        section["rss_flatness"] = rss_flatness(
+            [smp["rss_mb"] for smp in section["cache_samples"]])
+        if section["rss_flatness"] is not None \
+                and not section["rss_flatness"]["flat"]:
+            rf = section["rss_flatness"]
+            _fail(report, section,
+                  f"endurance: process RSS grew {rf['growth_mb']} MB past "
+                  f"the post-warmup level ({rf['baseline_mb']} MB) across "
+                  f"{section['passes']} passes, over the {rf['budget_mb']} "
+                  f"MB flatness budget")
+        _finalize(report, section)
+        _write(report)
+    finally:
+        bls.bls_active = prev_bls
+        recorder.enable(cap=prev_cap)
+        if not was_recording:
+            recorder.disable()
+    return report
+
+
 if __name__ == "__main__":  # pragma: no cover - operator entry point
     import sys
 
-    run_soak(profile=sys.argv[1] if len(sys.argv) > 1 else "bounded")
-    print("soak green: SOAK.json written")
+    if len(sys.argv) > 1 and sys.argv[1] == "endurance":
+        run_endurance()
+        print("endurance soak green: SOAK.json written")
+    else:
+        run_soak(profile=sys.argv[1] if len(sys.argv) > 1 else "bounded")
+        print("soak green: SOAK.json written")

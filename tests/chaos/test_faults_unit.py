@@ -127,3 +127,79 @@ def test_fault_validates_inputs():
         faults.Fault("x", nth=0)
     with pytest.raises(ValueError, match="unknown fault kind"):
         faults.Fault("x", kind="explode")
+    with pytest.raises(ValueError, match="malformed process scope"):
+        faults.Fault("x", proc="worker1")
+    with pytest.raises(ValueError, match="malformed process scope"):
+        faults.Fault("x", proc="proc")
+
+
+# -- per-process scope (ISSUE 20): site[@nth][=kind][@procK] ------------------
+
+def test_plan_from_env_parses_process_scope():
+    plan = faults.plan_from_env(
+        "a.b@2=corrupt@proc1, c.d@proc0 ,e.f@3+=crash@proc12")
+    reprs = sorted(repr(f) for f in plan.faults())
+    assert reprs == ["a.b@2=corrupt@proc1", "c.d@1=error@proc0",
+                     "e.f@3+=crash@proc12"]
+    # round-trip: the coordinator ships its plan to workers this way
+    again = faults.plan_from_env(faults.plan_to_env(plan))
+    assert sorted(repr(f) for f in again.faults()) == reprs
+
+
+@pytest.mark.parametrize("bad", [
+    "a.b@proc",        # bare prefix, no ordinal
+    "a.b@procX",       # non-decimal ordinal
+    "a.b@proc1x",      # trailing junk
+    "a.b=error@proc-1",  # negative ordinal
+])
+def test_plan_from_env_rejects_malformed_scopes_loudly(bad):
+    """A typo'd scope must never silently arm the fault everywhere."""
+    with pytest.raises(ValueError, match="malformed process scope"):
+        faults.plan_from_env(bad)
+
+
+def test_scope_ignored_when_no_fabric_active():
+    """With no process scope set (the default, outside a fabric), a
+    scoped fault fires everywhere — existing plans behave identically."""
+    assert faults.process_scope() is None
+    plan = faults.FaultPlan([faults.Fault(SITE.name, proc="proc1")])
+    with faults.inject(plan):
+        with pytest.raises(faults.InjectedFault):
+            SITE()
+    assert plan.fired == [(SITE.name, 1, "error")]
+
+
+def test_scoped_fault_fires_only_in_its_process():
+    plan = faults.FaultPlan([faults.Fault(SITE.name, proc="proc2",
+                                          sticky=True)])
+    faults.set_process_scope("proc1")
+    try:
+        with faults.inject(plan):
+            SITE()  # addressed to proc2: skipped here
+            assert plan.fired == []
+        faults.set_process_scope("proc2")
+        with faults.inject(plan):
+            with pytest.raises(faults.InjectedFault):
+                SITE()
+    finally:
+        faults.set_process_scope(None)
+    # hits counted in BOTH processes — only the firing is scoped, so the
+    # per-site hit cadence matches an unscoped run
+    assert plan.hits[SITE.name] == 2
+
+
+def test_unscoped_fault_fires_inside_a_fabric_process():
+    plan = faults.FaultPlan([faults.Fault(SITE.name)])
+    faults.set_process_scope("proc0")
+    try:
+        with faults.inject(plan):
+            with pytest.raises(faults.InjectedFault):
+                SITE()
+    finally:
+        faults.set_process_scope(None)
+
+
+def test_set_process_scope_validates():
+    with pytest.raises(ValueError, match="malformed process scope"):
+        faults.set_process_scope("coordinator")
+    assert faults.process_scope() is None

@@ -12,6 +12,8 @@ import pytest
 from consensus_specs_tpu import faults
 
 # importing the instrumented modules registers their sites
+import consensus_specs_tpu.dist.dispatch  # noqa: F401  (registers fabric's too)
+import consensus_specs_tpu.dist.worker  # noqa: F401
 import consensus_specs_tpu.forkchoice.engine  # noqa: F401
 import consensus_specs_tpu.node.service  # noqa: F401  (registers ingest's too)
 import consensus_specs_tpu.query.coldstart  # noqa: F401
@@ -20,6 +22,7 @@ import consensus_specs_tpu.query.resident  # noqa: F401
 import consensus_specs_tpu.stf.engine  # noqa: F401
 
 from . import (
+    test_dist_chaos,
     test_forkchoice_chaos,
     test_node_chaos,
     test_persist_chaos,
@@ -40,7 +43,8 @@ def test_every_site_has_a_chaos_case():
                | set(test_forkchoice_chaos.COVERED_SITES)
                | set(test_node_chaos.COVERED_SITES)
                | set(test_persist_chaos.COVERED_SITES)
-               | set(test_query_chaos.COVERED_SITES))
+               | set(test_query_chaos.COVERED_SITES)
+               | set(test_dist_chaos.COVERED_SITES))
     missing = registered - covered
     assert not missing, (
         f"fault sites with no chaos case: {sorted(missing)} — add a case to "
@@ -101,6 +105,19 @@ def test_query_sites_are_registered_and_covered():
     assert expected <= query_sites, sorted(expected - query_sites)
     assert query_sites <= set(test_query_chaos.COVERED_SITES), \
         sorted(query_sites - set(test_query_chaos.COVERED_SITES))
+
+
+def test_dist_sites_are_registered_and_covered():
+    """ISSUE 20: the process-boundary seams exist AND each carries a
+    chaos case — both coordinator-side (spawn/dispatch/reply/heartbeat)
+    and the worker-side execution probe a scoped plan crosses the
+    process boundary to reach."""
+    expected = {"dist.spawn", "dist.dispatch", "dist.reply",
+                "dist.heartbeat", "dist.worker.exec"}
+    dist_sites = {n for n in _production_sites() if n.startswith("dist.")}
+    assert expected <= dist_sites, sorted(expected - dist_sites)
+    assert dist_sites <= set(test_dist_chaos.COVERED_SITES), \
+        sorted(dist_sites - set(test_dist_chaos.COVERED_SITES))
 
 
 def test_site_names_are_unique_and_dotted():

@@ -150,6 +150,56 @@ def test_firehose_timeline_shows_producer_to_apply_handoff():
     assert crossed, "no cross-thread enqueue->apply link found"
 
 
+def test_single_item_drains_keep_journal_parity():
+    """ISSUE 20 satellite: force the apply loop through the most
+    degenerate drain bound — ``max_items=1``, one item per drain, so the
+    micro-batcher can never coalesce a gossip run — and the journal
+    still carries one entry per ORIGINAL gossip batch with byte-exact
+    head/state-root parity vs the literal spec replay.  The drain bound
+    shapes batching, never provenance: journal parity must not split."""
+    from consensus_specs_tpu.node import admission
+
+    spec, state = _spec_and_state()
+    corpus = firehose.build_corpus(spec, state, n_epochs=1,
+                                   gossip_target=120)
+    service.reset_stats()
+    stf.reset_stats()
+    admission.reset_state()
+    node = service.Node(spec, state, corpus.anchor_block,
+                        retry_backoff_s=0.0)
+    genesis = int(state.genesis_time)
+    sps = int(spec.config.SECONDS_PER_SLOT)
+    # serial causal enqueue: tick into each slot, that slot's block,
+    # then the PREVIOUS slot's gossip (mature once the clock passed it)
+    # in slices well under one slot's run — many queue items per run
+    gossip_items = 0
+    for sb in corpus.chain:
+        slot = int(sb.message.slot)
+        node.enqueue_tick(genesis + slot * sps)
+        node.enqueue_block(sb)
+        for prev in (slot - 1,):
+            for off in range(0, len(corpus.gossip.get(prev, ())), 5):
+                node.enqueue_attestations(corpus.gossip[prev][off:off + 5])
+                gossip_items += 1
+    last = int(corpus.chain[-1].message.slot)
+    node.enqueue_tick(genesis + (last + 1) * sps)
+    for off in range(0, len(corpus.gossip[last]), 5):
+        node.enqueue_attestations(corpus.gossip[last][off:off + 5])
+        gossip_items += 1
+    node.queue.close()
+    while node.run_apply_loop(timeout=0, max_items=1):
+        pass
+    assert service.stats["rejected_batches"] == 0
+    # every drain really was a singleton batch
+    assert service.stats["batches_applied"] >= gossip_items
+    # provenance held: one journal entry per original gossip batch
+    assert sum(1 for kind, _ in node.journal
+               if kind == "attestations") == gossip_items
+    ref = firehose.replay_journal_literal(
+        spec, state, corpus.anchor_block, node._journal)
+    firehose.assert_parity(spec, node, ref)
+
+
 @pytest.mark.slow
 def test_firehose_deep_profile():
     """The ``make firehose`` leg: a heavier seeded run (env-scalable) —

@@ -176,6 +176,51 @@ def test_drain_timeout_and_close_semantics_match_get():
     assert q.drain(timeout=0) is None
 
 
+def test_drain_zero_and_negative_timeout_bound_the_wait_not_the_work():
+    """ISSUE 20 satellite: the timeout is a WAIT bound, never a work
+    bound — a zero- or negative-timeout drain of a non-empty queue
+    returns the whole backlog in one piece, in FIFO order.  A drain
+    that split here would split a gossip run across journal entries."""
+    q = IngestQueue(cap=8)
+    assert q.drain(timeout=-1) is None   # empty: an already-expired wait
+    assert q.drain(timeout=-0.001) is None
+    for i in range(6):
+        q.put("tick", i)
+    batch = q.drain(timeout=-5)          # non-empty: full batch anyway
+    assert [it.payload for it in batch] == [0, 1, 2, 3, 4, 5]
+    for i in range(4):
+        q.put("tick", i)
+    assert [it.payload for it in q.drain(timeout=0)] == [0, 1, 2, 3]
+    q.close()
+    assert q.drain(timeout=-1) is None   # closed + drained, same as get
+
+
+def test_drain_max_items_zero_or_negative_is_a_request_for_nothing():
+    """ISSUE 20 satellite: ``max_items <= 0`` returns ``[]`` at once —
+    no wait (even on an empty OPEN queue, where the old wait loop would
+    have slept forever for an item it would not take), no consume, no
+    counter movement — and the next real drain still sees the intact
+    FIFO backlog."""
+    q = IngestQueue(cap=8)
+    t0 = time.perf_counter()
+    assert q.drain(max_items=0) == []    # empty + open: returns, no block
+    assert q.drain(max_items=-2) == []
+    assert time.perf_counter() - t0 < 0.5
+    for i in range(5):
+        q.put("tick", i)
+    before = ingest.stats["dequeued"]
+    assert q.drain(timeout=0, max_items=0) == []
+    assert q.drain(timeout=0, max_items=-1) == []
+    assert q.depth() == 5                # nothing consumed
+    assert ingest.stats["dequeued"] == before
+    assert [it.payload for it in q.drain(timeout=0)] == [0, 1, 2, 3, 4]
+    q.close()
+    assert q.drain(timeout=0, max_items=0) == []  # closed beats nothing?
+    # no: a nothing-request short-circuits even the end-of-stream None —
+    # the caller asked for zero items and got exactly that
+    assert q.drain(timeout=0) is None
+
+
 def test_one_drain_unblocks_every_blocked_producer():
     """The ISSUE 19 satellite pin: a bulk removal frees MANY slots, so
     the consumer must ``notify_all`` — with ``get``'s per-item

@@ -61,3 +61,36 @@ def test_soak_bounded():
 def test_soak_deep():
     report = soak.run_soak("deep")
     _check_report(report, ("phase0", "altair"))
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.environ.get("CSTPU_SOAK_MINUTES"),
+                    reason="wall-clock endurance mode: "
+                           "CSTPU_SOAK_MINUTES=<minutes> "
+                           "(make soak-endurance)")
+def test_soak_endurance():
+    """ISSUE 20 satellite / ROADMAP item 3: the budgeted loop runs to
+    expiry, every sampled cap holds, and the whole multi-pass RSS series
+    sits inside the same flatness envelope the per-walk soak asserts."""
+    report = soak.run_endurance()
+    assert report["failure"] is None
+    section = report["forks"][0]
+    assert section["mode"] == "endurance"
+    assert section["passes"] >= 1
+    assert section["blocks_applied"] > 0
+    assert section["elapsed_s"] >= section["budget_minutes"] * 60.0 * 0.9 \
+        or section["passes"] == 1  # a single pass may outlast a tiny budget
+    for sample in section["cache_samples"]:
+        for entry in sample["sizes"]:
+            if entry["cap"]:
+                assert entry["size"] <= entry["cap"], entry
+    rss = [s["rss_mb"] for s in section["cache_samples"]]
+    assert all(r is None or r > 0 for r in rss)
+    flat = section["rss_flatness"]
+    if flat is not None:  # None only when RSS was unsampleable
+        assert flat["flat"], flat
+        assert flat["budget_mb"] >= 128.0
+    with open(report["out_path"]) as f:
+        on_disk = json.load(f)
+    assert on_disk["profile"] == "endurance"
+    assert on_disk["failure"] is None

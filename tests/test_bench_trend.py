@@ -457,6 +457,48 @@ def test_counters_batch_bisections_block():
         _e2e_row(batch_bisections=0)) is None
 
 
+def _dist_row(**tel_overrides):
+    tel = {"tasks": 12, "dispatched": 12, "replies": 12,
+           "redispatched_chunks": 0, "hedged_tasks": 0,
+           "fallback_runs": 0, "fabric_runs": 3,
+           "workers_lost": 0, "corrupt_replies": 0,
+           "breaker_state": "closed"}
+    return {"metric": "dist_verify_fabric_2workers_512x128_400000",
+            "value": 0.61, "unit": "s",
+            "telemetry": dict(tel, **tel_overrides)}
+
+
+def test_counters_dist_redispatch_blocks():
+    # ISSUE 20: a fault-free fabric run re-dispatches nothing — a
+    # nonzero count means workers are dying under zero injected faults,
+    # and first-valid-reply-wins keeps the wall time looking healthy
+    msg = bench.check_counter_invariants(_dist_row(redispatched_chunks=2))
+    assert msg is not None and "re-dispatched 2 chunks" in msg
+    assert bench.check_counter_invariants(_dist_row()) is None
+
+
+def test_counters_dist_fallback_and_losses_block():
+    # the ladder silently demoting to in-process (or losing workers /
+    # corrupting replies) is behavioral rot wall-time never shows
+    msg = bench.check_counter_invariants(_dist_row(fallback_runs=1))
+    assert msg is not None and "demoted 1 runs to in-process" in msg
+    msg = bench.check_counter_invariants(_dist_row(workers_lost=1))
+    assert msg is not None and "lost 1 workers" in msg
+    msg = bench.check_counter_invariants(_dist_row(corrupt_replies=3))
+    assert msg is not None and "3 corrupt replies" in msg
+    # the dist breaker rides the generic breaker-state check
+    msg = bench.check_counter_invariants(_dist_row(breaker_state="open"))
+    assert msg is not None and "breaker open" in msg
+
+
+def test_dist_row_rides_the_perf_trend_gate():
+    cur, prev = _dist_row(), _dist_row()
+    assert bench.check_perf_trend(cur, prev) is None
+    cur = dict(cur, value=prev["value"] * 1.5)
+    msg = bench.check_perf_trend(cur, prev)
+    assert msg is not None and "dist_verify_fabric" in msg
+
+
 # -- analyzer-gate refusal line (ISSUE 18 satellite) -------------------------
 
 class _F:

@@ -1,0 +1,40 @@
+"""CI hook for the process-fabric dryrun (tools/dist_dryrun.py): the
+epoch/merkle/pairing capability checks over the 2-worker supervised pool,
+bit-identical to the in-process twins, plus the injected worker-kill leg
+with recovery (ISSUE 20 satellite; ``make dist-dryrun``)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_process_fabric_dryrun():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dist_dryrun.py")],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(
+        open(os.path.join(REPO, "DCN_DRYRUN.json")).read())
+    assert report["ok"]
+    assert report["path"] == "process-fabric"
+    assert report["n_processes"] == 2
+    assert report["checks"] == {
+        "epoch_balances_bitexact": True,
+        "merkle_root_matches_ssz": True,
+        "pairing_lanes_verdicts_exact": True,
+        "clean_run_no_redispatch": True,
+    }
+    # the failure-domain leg: the kill really happened AND the run
+    # recovered on the fabric with a bit-identical root
+    assert report["kill"]["root_parity"]
+    assert report["kill"]["recovered_on_fabric"]
+    assert report["kill"]["redispatched_chunks"] > 0
+    assert report["kill"]["workers_lost"] >= 1

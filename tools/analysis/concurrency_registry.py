@@ -53,9 +53,11 @@ _PKG = "consensus_specs_tpu"
 # is the single-writer loop (not concurrent with itself); the SPAWNED
 # roles run concurrently with everything else and drive the hazards.
 ROLES = ("main", "apply-writer", "pipeline-worker", "producer",
-         "persist-writer", "native-pool", "query-reader")
+         "persist-writer", "native-pool", "query-reader",
+         "dist-io", "dist-worker")
 SPAWNED_ROLES = frozenset({"pipeline-worker", "producer", "persist-writer",
-                           "native-pool", "query-reader"})
+                           "native-pool", "query-reader",
+                           "dist-io", "dist-worker"})
 
 
 @dataclass(frozen=True)
@@ -156,6 +158,26 @@ LOCKS: Tuple[LockSpec, ...] = (
     LockSpec("snapshot verified lock", f"{_PKG}.query.coldstart",
              frozenset({"_VERIFIED_LOCK"}),
              "once-per-artifact byte-identity memo for cold starts"),
+    # ISSUE 20: the cross-process execution fabric (coordinator side)
+    LockSpec("dist fabric stats lock", f"{_PKG}.dist.fabric",
+             frozenset({"_STATS_LOCK"}),
+             "channel counters: sender/reader threads vs. bus snapshots"),
+    LockSpec("dist event condition", f"{_PKG}.dist.fabric",
+             frozenset({"Fabric._events_cond"}),
+             "the fabric event queue + worker alive/last_beat: ONE lock "
+             "orders loss detection against reply delivery"),
+    LockSpec("dist outbound condition", f"{_PKG}.dist.fabric",
+             frozenset({"WorkerHandle._out_cond"}),
+             "per-worker outbound frame queue (dispatch appends, the "
+             "sender thread drains)"),
+    LockSpec("dist dispatch stats lock", f"{_PKG}.dist.dispatch",
+             frozenset({"_STATS_LOCK"}),
+             "dispatch/breaker counters vs. bus snapshots"),
+    # worker-process side: replies (main loop) and heartbeats (beacon
+    # thread) serialize on the one frame stream
+    LockSpec("dist worker write lock", f"{_PKG}.dist.worker",
+             frozenset({"_WRITE_LOCK"}),
+             "outbound frame stream: a beat must never tear a reply"),
 )
 
 
@@ -289,6 +311,37 @@ SHARED: Tuple[SharedSpec, ...] = (
     SharedSpec("query counters", f"{_PKG}.query",
                module_globals=frozenset({"stats"}),
                roles=frozenset({"query-reader"})),
+    # -- the cross-process execution fabric (ISSUE 20) ------------------------
+    SharedSpec("dist fabric counters", f"{_PKG}.dist.fabric",
+               module_globals=frozenset({"stats"}),
+               lock="dist fabric stats lock"),
+    # the reply queue + per-worker liveness: reader threads write, the
+    # dispatch loop reads — mark_lost orders alive=False BEFORE the lost
+    # event under this one lock, which is what makes stale-incarnation
+    # events detectable
+    SharedSpec("dist fabric channel state", f"{_PKG}.dist.fabric",
+               instance_attrs=frozenset({"Fabric._events",
+                                         "WorkerHandle.alive",
+                                         "WorkerHandle.last_beat",
+                                         "WorkerHandle.popen"}),
+               lock="dist event condition"),
+    SharedSpec("dist worker outbound queue", f"{_PKG}.dist.fabric",
+               instance_attrs=frozenset({"WorkerHandle._outbound"}),
+               lock="dist outbound condition"),
+    SharedSpec("dist dispatch counters", f"{_PKG}.dist.dispatch",
+               module_globals=frozenset({"stats"}),
+               lock="dist dispatch stats lock"),
+    # the in-flight task table is single-threaded by construction: only
+    # the dispatch loop's thread touches it, reader threads communicate
+    # through the fabric event queue (the declared seam above)
+    SharedSpec("dist in-flight task table", f"{_PKG}.dist.dispatch",
+               instance_attrs=frozenset({"_DispatchRun._inflight",
+                                         "_DispatchRun._results",
+                                         "_DispatchRun._done"})),
+    # the worker-side frame stream handle: bound once in serve() before
+    # the beacon thread exists; writes THROUGH it hold the write lock
+    SharedSpec("dist worker frame stream", f"{_PKG}.dist.worker",
+               module_globals=frozenset({"_OUT"})),
 )
 
 
@@ -318,6 +371,15 @@ ROLE_SEEDS: Tuple[RoleSeed, ...] = (
     RoleSeed(f"{_PKG}.query.harness.query_reader", "query-reader",
              "historical-query reader threads against the live engine "
              "(ISSUE 16)"),
+    # the dist fabric's channel threads (ISSUE 20): one sender + one
+    # reader per worker subprocess on the coordinator, one heartbeat
+    # beacon inside each worker process
+    RoleSeed(f"{_PKG}.dist.fabric.WorkerHandle._send_loop", "dist-io",
+             "per-worker outbound pipe writer (coordinator side)"),
+    RoleSeed(f"{_PKG}.dist.fabric.Fabric._read_loop", "dist-io",
+             "per-worker reply/heartbeat reader (coordinator side)"),
+    RoleSeed(f"{_PKG}.dist.worker._heartbeat_loop", "dist-worker",
+             "worker-process liveness beacon (ISSUE 20)"),
     # producer-facing API: gossip readers enqueue from their own threads
     RoleSeed(f"{_PKG}.node.ingest.IngestQueue.put", "producer",
              "the multi-producer enqueue surface (node/ingest.py)"),
